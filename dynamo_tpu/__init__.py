@@ -1,0 +1,23 @@
+"""dynamo_tpu — a TPU-native distributed LLM inference serving framework.
+
+A ground-up reimplementation of the capabilities of NVIDIA Dynamo
+(reference: vickiegpt/dynamo, see SURVEY.md) designed for TPU hardware:
+
+- A first-party JAX/XLA inference engine (``dynamo_tpu.engine``) with paged
+  KV cache, continuous batching, Pallas paged attention, and GSPMD sharding
+  over a ``jax.sharding.Mesh`` — filling the role the reference delegates to
+  vLLM/SGLang/TRT-LLM.
+- A distributed runtime (``dynamo_tpu.runtime``) with the reference's
+  Namespace→Component→Endpoint model, discovery with leases, a push request
+  plane and a direct-TCP response plane (reference: lib/runtime/src/).
+- KV-cache-aware routing over a global radix index fed by worker block
+  events (``dynamo_tpu.router``; reference: lib/llm/src/kv_router.rs).
+- Disaggregated prefill/decode with KV block handoff over ICI/DCN
+  (``dynamo_tpu.disagg``; reference NIXL path: lib/llm/src/block_manager/).
+- A tiered KV block manager (``dynamo_tpu.kvbm``).
+- An OpenAI-compatible HTTP frontend (``dynamo_tpu.frontend``).
+- An SLA planner (``dynamo_tpu.planner``) and a mocker engine
+  (``dynamo_tpu.mocker``) for accelerator-free testing.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from dynamo_tpu.backend.detokenizer import DetokenizerBackend
+
+__all__ = ["DetokenizerBackend"]

@@ -1,0 +1,93 @@
+"""Streaming detokenizer backend with stop-sequence jail.
+
+Fills the role of the reference's ``Backend`` operator
+(reference: lib/llm/src/backend.rs:4-60): sits between the engine's token
+stream and the OpenAI response path, incrementally detokenizes, and
+implements the *hidden stop sequence jail* — when the tail of the generated
+text could be the start of a stop string, output is withheld ("jailed")
+until the ambiguity resolves, so a stop sequence never leaks to the client
+and partial matches stream correctly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from dynamo_tpu.protocols.common import BackendOutput, FinishReason, LLMEngineOutput
+from dynamo_tpu.tokenizer import BaseTokenizer, DecodeStream
+
+
+def _longest_partial_suffix(text: str, stops: list[str]) -> int:
+    """Length of the longest suffix of ``text`` that is a proper prefix of
+    any stop string (the amount of text to jail)."""
+    best = 0
+    for stop in stops:
+        upper = min(len(stop) - 1, len(text))
+        for k in range(upper, 0, -1):
+            if stop.startswith(text[-k:]):
+                best = max(best, k)
+                break
+    return best
+
+
+@dataclass
+class _StreamState:
+    decode: DecodeStream
+    jailed: str = ""       # emitted-by-decoder but withheld text
+    finished: bool = False
+
+
+class DetokenizerBackend:
+    """Per-request streaming state machine. Feed ``LLMEngineOutput`` deltas,
+    receive ``BackendOutput`` text deltas with stop handling applied."""
+
+    def __init__(self, tokenizer: BaseTokenizer, stops: list[str] | None = None):
+        self.tokenizer = tokenizer
+        self.stops = [s for s in (stops or []) if s]
+        self._st = _StreamState(decode=DecodeStream(tokenizer))
+
+    def step(self, out: LLMEngineOutput) -> BackendOutput:
+        st = self._st
+        if st.finished:
+            return BackendOutput(finish_reason=out.finish_reason)
+        new_text = "".join(st.decode.step(t) for t in out.token_ids)
+        buf = st.jailed + new_text
+
+        # 1. full stop-string match → truncate there, finish
+        if self.stops:
+            hit_at = None
+            for stop in self.stops:
+                idx = buf.find(stop)
+                if idx != -1 and (hit_at is None or idx < hit_at):
+                    hit_at = idx
+            if hit_at is not None:
+                st.finished = True
+                st.jailed = ""
+                return BackendOutput(
+                    text=buf[:hit_at],
+                    token_ids=list(out.token_ids),
+                    finish_reason=FinishReason.STOP,
+                    cum_log_probs=out.cum_log_probs,
+                )
+
+        # 2. stream end → flush the jail (no stop hit)
+        if out.finish_reason is not None:
+            tail = st.decode.flush()
+            st.finished = True
+            st.jailed = ""
+            return BackendOutput(
+                text=buf + tail,
+                token_ids=list(out.token_ids),
+                finish_reason=out.finish_reason,
+                cum_log_probs=out.cum_log_probs,
+            )
+
+        # 3. jail any suffix that could grow into a stop string
+        k = _longest_partial_suffix(buf, self.stops) if self.stops else 0
+        st.jailed = buf[len(buf) - k :] if k else ""
+        emit = buf[: len(buf) - k] if k else buf
+        return BackendOutput(text=emit, token_ids=list(out.token_ids), cum_log_probs=out.cum_log_probs)
+
+    @property
+    def hit_stop(self) -> bool:
+        return self._st.finished

@@ -1,0 +1,3 @@
+from dynamo_tpu.engine.engine import AsyncJaxEngine, EngineCore
+
+__all__ = ["AsyncJaxEngine", "EngineCore"]

@@ -1,0 +1,93 @@
+"""Paged KV cache storage + device block allocator.
+
+The device tier (G1) of the KV block story: cache tensors are
+``[layers, num_blocks, block_size, kv_heads, head_dim]`` jax.Arrays, sharded
+over the mesh "model" axis on kv_heads. Block 0 is reserved as the trash
+block for padding writes (models/llama.py). Host/disk tiers and offload live
+in dynamo_tpu.kvbm (reference: lib/llm/src/block_manager/).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.parallel.mesh import kv_cache_spec
+
+
+@dataclass
+class KVCacheSpec:
+    num_blocks: int
+    block_size: int
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def for_model(cls, cfg: ModelConfig, num_blocks: int, block_size: int) -> "KVCacheSpec":
+        return cls(
+            num_blocks=num_blocks,
+            block_size=block_size,
+            num_layers=cfg.num_layers,
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim,
+            dtype=cfg.dtype,
+        )
+
+    @property
+    def shape(self) -> tuple[int, int, int, int, int]:
+        return (self.num_layers, self.num_blocks, self.block_size, self.num_kv_heads, self.head_dim)
+
+    def bytes_per_block(self) -> int:
+        itemsize = jnp.dtype(self.dtype).itemsize
+        # k + v, all layers
+        return 2 * self.num_layers * self.block_size * self.num_kv_heads * self.head_dim * itemsize
+
+
+def allocate_cache(spec: KVCacheSpec, mesh: Mesh | None = None) -> tuple[jax.Array, jax.Array]:
+    """Allocate zeroed K and V cache arrays (sharded if a mesh is given)."""
+    if mesh is not None:
+        sharding = NamedSharding(mesh, kv_cache_spec())
+        zeros = jax.jit(
+            lambda: jnp.zeros(spec.shape, jnp.dtype(spec.dtype)), out_shardings=sharding
+        )
+        return zeros(), zeros()
+    z = jnp.zeros(spec.shape, jnp.dtype(spec.dtype))
+    return z, jnp.zeros_like(z)
+
+
+class NoFreeBlocks(Exception):
+    pass
+
+
+@dataclass
+class BlockAllocator:
+    """Free-list allocator over device block ids. Block 0 (trash) is never
+    handed out. Eviction/reuse decisions live above (kvbm); this is the raw
+    device pool (reference: block_manager/pool)."""
+
+    num_blocks: int
+    _free: list[int] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        self._free = list(range(self.num_blocks - 1, 0, -1))  # pop() yields 1,2,3..
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise NoFreeBlocks(f"need {n} blocks, {len(self._free)} free")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if not 0 < b < self.num_blocks:
+                raise ValueError(f"bad block id {b}")
+        self._free.extend(blocks)

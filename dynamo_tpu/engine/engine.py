@@ -1,0 +1,464 @@
+"""The first-party JAX engine: model runner + engine core + async facade.
+
+Fills the role vLLM's AsyncLLM plays under the reference framework
+(reference worker wrapper: components/src/dynamo/vllm/main.py,
+handlers.py) — but the engine itself is ours, TPU-first:
+
+- ``ModelRunner``: owns params, paged KV cache, and per-slot sampling state
+  on device; compiles one XLA program per (batch, chunk, blocktable) bucket;
+  cache/state buffers are donated so steps update in place.
+- ``EngineCore``: synchronous scheduler + step loop (directly testable).
+- ``AsyncJaxEngine``: thread-hosted step loop bridging to asyncio streams —
+  the object a worker process serves via serve_endpoint.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import queue as thread_queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import AsyncIterator, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.cache import KVCacheSpec, allocate_cache
+from dynamo_tpu.engine.prefix_pool import PrefixPool
+from dynamo_tpu.engine.sampling import SamplingState, record_tokens, sample
+from dynamo_tpu.engine.scheduler import Phase, PrefillWork, Scheduler, Seq, StepPlan
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig, resolve_model_config
+from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.router.events import KvCacheEvent
+from dynamo_tpu.utils.config import EngineConfig
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("engine")
+
+
+def _bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return n
+
+
+def _pow2_bucket(n: int, lo: int, hi: int) -> int:
+    b = lo
+    while b < n and b < hi:
+        b *= 2
+    return b
+
+
+@dataclass
+class EngineMetrics:
+    """Engine-side stats published to the router/planner
+    (reference: ForwardPassMetrics, lib/llm/src/kv_router/publisher.rs:686)."""
+
+    num_steps: int = 0
+    num_prefill_tokens: int = 0
+    num_decode_tokens: int = 0
+    num_requests_finished: int = 0
+    num_preemptions: int = 0
+    prefix_hit_blocks: int = 0
+    prefix_lookup_blocks: int = 0
+
+    def snapshot(self, sched: Scheduler, pool: PrefixPool) -> dict:
+        return {
+            "num_waiting": sched.num_waiting,
+            "num_running": sched.num_running,
+            "kv_usage": pool.usage,
+            "kv_total_blocks": pool.num_blocks,
+            "num_steps": self.num_steps,
+            "prefill_tokens": self.num_prefill_tokens,
+            "decode_tokens": self.num_decode_tokens,
+            "requests_finished": self.num_requests_finished,
+            "preemptions": self.num_preemptions,
+            "prefix_hit_rate": self.prefix_hit_blocks / max(self.prefix_lookup_blocks, 1),
+        }
+
+
+class ModelRunner:
+    """Device-state owner + bucketed compiled step functions."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        engine_cfg: EngineConfig,
+        mesh=None,
+        params=None,
+        rng_seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.engine_cfg = engine_cfg
+        self.mesh = mesh
+        key = jax.random.key(rng_seed)
+        self.params = params if params is not None else llama.init_params(cfg, key)
+        num_blocks = engine_cfg.num_blocks or self._auto_num_blocks()
+        self.spec = KVCacheSpec.for_model(cfg, num_blocks, engine_cfg.block_size)
+        self.cache_k, self.cache_v = allocate_cache(self.spec, mesh)
+        maxb = engine_cfg.max_batch_size
+        # Row maxb is the trash row: padding/non-sampling rows write their
+        # sampling-state updates there so real slots are never clobbered by
+        # duplicate scatter indices and PRNG keys only advance on real samples.
+        self.counts = jnp.zeros((maxb + 1, cfg.vocab_size), jnp.int32)
+        base = jax.random.split(jax.random.key(engine_cfg.seed), maxb + 1)
+        self.keys = jax.vmap(jax.random.key_data)(base).astype(jnp.uint32)
+        self._step_fns: dict[tuple[int, int, int], Callable] = {}
+        self.max_nblk = -(-engine_cfg.max_model_len // engine_cfg.block_size)
+
+    def _auto_num_blocks(self) -> int:
+        """Size the device KV pool from free memory (TPU) or a small default."""
+        ec = self.engine_cfg
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            limit = stats.get("bytes_limit", 0)
+            in_use = stats.get("bytes_in_use", 0)
+            budget = int((limit - in_use) * 0.85)
+        except Exception:
+            budget = 0
+        spec = KVCacheSpec.for_model(self.cfg, 1, ec.block_size)
+        if budget > 0:
+            n = max(budget // spec.bytes_per_block(), 16)
+        else:
+            n = 512
+        cap = (ec.max_model_len // ec.block_size) * ec.max_batch_size + 1
+        return int(min(n, cap))
+
+    # ------------------------------------------------------------------
+    def _build_step_fn(self, b: int, t: int, nblk: int):
+        cfg = self.cfg
+        trash_row = self.engine_cfg.max_batch_size
+
+        def step(params, ck, cv, counts, keys, tokens, q_start, q_len, bt, slots,
+                 temp, top_k, top_p, fp, pp, rp, do_sample):
+            hidden, ck, cv = llama.forward(params, cfg, tokens, q_start, q_len, bt, ck, cv)
+            logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
+            st = SamplingState(
+                temperature=temp, top_k=top_k, top_p=top_p,
+                frequency_penalty=fp, presence_penalty=pp, repetition_penalty=rp,
+                keys=keys[slots], token_counts=counts[slots],
+            )
+            toks, lps, new_keys = sample(logits, st)
+            new_counts = record_tokens(st.token_counts, toks, do_sample)
+            # Only sampling rows persist state; others write to the trash row.
+            write_slots = jnp.where(do_sample, slots, trash_row)
+            counts = counts.at[write_slots].set(new_counts)
+            keys = keys.at[write_slots].set(new_keys)
+            return ck, cv, counts, keys, toks, lps
+
+        return jax.jit(step, donate_argnums=(1, 2, 3, 4))
+
+    def step_fn(self, b: int, t: int, nblk: int):
+        key = (b, t, nblk)
+        if key not in self._step_fns:
+            log.info("compiling step fn B=%d T=%d NBLK=%d", b, t, nblk)
+            self._step_fns[key] = self._build_step_fn(b, t, nblk)
+        return self._step_fns[key]
+
+    def reset_slot(self, slot: int, seed: int | None) -> None:
+        self.counts = self.counts.at[slot].set(0)
+        if seed is not None:
+            k = jax.random.key_data(jax.random.key(seed)).astype(jnp.uint32)
+            self.keys = self.keys.at[slot].set(k)
+
+    def run(
+        self,
+        rows: list[tuple[Seq, int, int]],  # (seq, start, length) per row
+        sample_rows: list[bool],
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Execute one bucketed step; returns (tokens [B], logprobs [B]) on host."""
+        ec = self.engine_cfg
+        n = len(rows)
+        t_max = max(length for _, _, length in rows)
+        if t_max == 1:
+            b, t = _bucket(n, ec.decode_bucket), 1
+        else:
+            b, t = _bucket(n, (1, 2, 4, 8)), _pow2_bucket(t_max, 16, ec.prefill_chunk)
+        nblk_need = max(len(s.block_ids) for s, _, _ in rows)
+        nblk = min(_pow2_bucket(max(nblk_need, 1), 4, self.max_nblk), self.max_nblk)
+
+        tokens = np.zeros((b, t), np.int32)
+        q_start = np.zeros((b,), np.int32)
+        q_len = np.zeros((b,), np.int32)
+        bt = np.zeros((b, nblk), np.int32)
+        slots = np.zeros((b,), np.int32)
+        temp = np.zeros((b,), np.float32)
+        top_k = np.zeros((b,), np.int32)
+        top_p = np.ones((b,), np.float32)
+        fp = np.zeros((b,), np.float32)
+        pp = np.zeros((b,), np.float32)
+        rp = np.ones((b,), np.float32)
+        do_sample = np.zeros((b,), bool)
+
+        for i, (seq, start, length) in enumerate(rows):
+            chunk = seq.tokens[start : start + length]
+            tokens[i, : len(chunk)] = chunk
+            q_start[i] = start
+            q_len[i] = length
+            bt[i, : len(seq.block_ids)] = seq.block_ids
+            slots[i] = max(seq.slot, 0)
+            so = seq.req.sampling_options
+            temp[i] = so.temperature if so.temperature is not None else 1.0
+            top_k[i] = so.top_k or 0
+            top_p[i] = so.top_p if so.top_p is not None else 1.0
+            fp[i] = so.frequency_penalty or 0.0
+            pp[i] = so.presence_penalty or 0.0
+            rp[i] = so.repetition_penalty or 1.0
+            do_sample[i] = sample_rows[i]
+
+        fn = self.step_fn(b, t, nblk)
+        (self.cache_k, self.cache_v, self.counts, self.keys, toks, lps) = fn(
+            self.params, self.cache_k, self.cache_v, self.counts, self.keys,
+            jnp.asarray(tokens), jnp.asarray(q_start), jnp.asarray(q_len),
+            jnp.asarray(bt), jnp.asarray(slots), jnp.asarray(temp),
+            jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(fp),
+            jnp.asarray(pp), jnp.asarray(rp), jnp.asarray(do_sample),
+        )
+        return np.asarray(toks)[:n], np.asarray(lps)[:n]
+
+
+class EngineCore:
+    """Synchronous engine: scheduler + runner + output assembly."""
+
+    def __init__(
+        self,
+        engine_cfg: EngineConfig,
+        mesh=None,
+        params=None,
+        event_sink: Callable[[KvCacheEvent], None] | None = None,
+    ):
+        self.engine_cfg = engine_cfg
+        self.model_cfg = resolve_model_config(engine_cfg.model)
+        self.runner = ModelRunner(self.model_cfg, engine_cfg, mesh=mesh, params=params,
+                                  rng_seed=engine_cfg.seed)
+        self.pool = PrefixPool(
+            self.runner.spec.num_blocks,
+            engine_cfg.block_size,
+            event_sink=event_sink,
+            enable_prefix_caching=engine_cfg.enable_prefix_caching,
+        )
+        self.sched = Scheduler(
+            pool=self.pool,
+            max_batch_size=engine_cfg.max_batch_size,
+            prefill_chunk=engine_cfg.prefill_chunk,
+            max_model_len=engine_cfg.max_model_len,
+            max_tokens_per_step=engine_cfg.max_tokens_per_step,
+        )
+        self.metrics = EngineMetrics()
+        self._seqs: dict[str, Seq] = {}
+        self.default_eos: list[int] = []
+
+    # ------------------------------------------------------------------
+    def add_request(self, req: PreprocessedRequest) -> LLMEngineOutput | None:
+        """Queue a request; returns an immediate error output if rejected."""
+        if not req.token_ids:
+            return LLMEngineOutput(
+                finish_reason=FinishReason.ERROR, error="empty prompt (no token_ids)"
+            )
+        seq = Seq(req=req, block_size=self.engine_cfg.block_size)
+        self.sched.add(seq)
+        if seq.phase is Phase.FINISHED:  # rejected (too long)
+            return LLMEngineOutput(
+                finish_reason=FinishReason.ERROR,
+                error=f"prompt of {seq.prompt_len} tokens exceeds max_model_len="
+                      f"{self.engine_cfg.max_model_len}",
+            )
+        self._seqs[req.request_id] = seq
+        self.metrics.prefix_lookup_blocks += max(len(seq.tokens) // seq.block_size, 1)
+        return None
+
+    def abort(self, request_id: str) -> None:
+        seq = self._seqs.get(request_id)
+        if seq is None or seq.phase is Phase.FINISHED:
+            return
+        self.sched.finish(seq, FinishReason.CANCELLED)
+
+    def has_work(self) -> bool:
+        return self.sched.has_work()
+
+    # ------------------------------------------------------------------
+    def _check_stop(self, seq: Seq, token: int) -> FinishReason | None:
+        sc = seq.req.stop_conditions
+        n_out = seq.num_output_tokens
+        eos_ids = set(seq.req.eos_token_ids or self.default_eos)
+        if token in (sc.stop_token_ids or []):
+            return FinishReason.STOP
+        if token in eos_ids and not sc.ignore_eos and (sc.min_tokens or 0) <= n_out:
+            return FinishReason.STOP
+        if sc.max_tokens is not None and n_out >= sc.max_tokens:
+            return FinishReason.LENGTH
+        if len(seq.tokens) >= self.engine_cfg.max_model_len:
+            return FinishReason.LENGTH
+        return None
+
+    def step(self) -> dict[str, LLMEngineOutput]:
+        """Run one engine step; returns per-request output deltas."""
+        plan = self.sched.plan()
+        self.metrics.num_preemptions = self.sched.preemption_count
+        if plan.empty:
+            return {}
+        outputs: dict[str, LLMEngineOutput] = {}
+        self.metrics.num_steps += 1
+
+        for seq in [w.seq for w in plan.prefill] + plan.decode:
+            if not seq.slot_initialized and seq.slot >= 0:
+                self.runner.reset_slot(seq.slot, seq.req.sampling_options.seed)
+                seq.slot_initialized = True
+
+        if plan.prefill:
+            rows = [(w.seq, w.start, w.length) for w in plan.prefill]
+            # Sample only on the chunk completing a *fresh* prompt; a
+            # preempt-resumed seq already holds its next token (the resume
+            # prefill just rebuilds KV) so sampling would duplicate output.
+            sample_rows = [
+                w.start + w.length >= w.seq.prefill_target()
+                and len(w.seq.tokens) == w.seq.prompt_len
+                for w in plan.prefill
+            ]
+            self.metrics.num_prefill_tokens += sum(w.length for w in plan.prefill)
+        else:
+            rows = [(s, s.num_computed, 1) for s in plan.decode]
+            sample_rows = [True] * len(rows)
+            self.metrics.num_decode_tokens += len(rows)
+
+        toks, lps = self.runner.run(rows, sample_rows)
+
+        for i, (seq, start, length) in enumerate(rows):
+            seq.num_computed = start + length
+            self.sched.commit_computed_blocks(seq)
+            if not sample_rows[i]:
+                continue  # intermediate prefill chunk: no token emitted
+            token = int(toks[i])
+            seq.tokens.append(token)
+            seq.block_seq.append(token)
+            if seq.prefix_hit_blocks:
+                self.metrics.prefix_hit_blocks += seq.prefix_hit_blocks
+                seq.prefix_hit_blocks = 0
+            reason = self._check_stop(seq, token)
+            out = LLMEngineOutput(token_ids=[token], cum_log_probs=float(lps[i]))
+            if reason is not None:
+                out.finish_reason = reason
+                self.sched.finish(seq, reason)
+                self.metrics.num_requests_finished += 1
+                del self._seqs[seq.request_id]
+            outputs[seq.request_id] = out
+        return outputs
+
+    def fail_all(self, error: str) -> list[str]:
+        """Abort every in-flight request (engine-fatal path). Returns the
+        request ids that were failed so callers can notify their streams."""
+        rids = list(self._seqs)
+        for rid in rids:
+            self.abort(rid)
+        self._seqs.clear()
+        return rids
+
+
+class AsyncJaxEngine:
+    """Async facade: background step-loop thread + asyncio output streams.
+
+    This is what a worker process serves via ``serve_endpoint`` — the analog
+    of vLLM's AsyncLLM under the reference (components/src/dynamo/vllm/
+    handlers.py generate())."""
+
+    def __init__(self, core: EngineCore):
+        self.core = core
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._inbox: thread_queue.Queue = thread_queue.Queue()
+        self._streams: dict[str, asyncio.Queue] = {}
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, name="engine-core", daemon=True)
+        self._started = False
+
+    def start(self) -> None:
+        if not self._started:
+            self._loop = asyncio.get_running_loop()
+            self._thread.start()
+            self._started = True
+
+    async def shutdown(self) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._started:
+            await asyncio.get_running_loop().run_in_executor(None, self._thread.join, 5.0)
+
+    # ------------------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop:
+            moved = False
+            while True:
+                try:
+                    kind, payload = self._inbox.get_nowait()
+                except thread_queue.Empty:
+                    break
+                moved = True
+                if kind == "add":
+                    err = self.core.add_request(payload)
+                    if err is not None:
+                        self._post(payload.request_id, err)
+                elif kind == "abort":
+                    self.core.abort(payload)
+                    self._post(payload, LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
+            if not self.core.has_work():
+                if not moved:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                continue
+            try:
+                outputs = self.core.step()
+            except Exception as exc:
+                # Engine-fatal: fail + drain all in-flight state so the loop
+                # doesn't spin hot retrying the same failing step.
+                log.exception("engine step failed; failing all in-flight requests")
+                self.core.fail_all(str(exc))
+                for rid in list(self._streams):
+                    self._post(rid, LLMEngineOutput(finish_reason=FinishReason.ERROR, error=str(exc)))
+                continue
+            for rid, out in outputs.items():
+                self._post(rid, out)
+
+    def _post(self, rid: str, out: LLMEngineOutput) -> None:
+        loop, q = self._loop, self._streams.get(rid)
+        if loop is None or q is None:
+            return
+        loop.call_soon_threadsafe(q.put_nowait, out)
+
+    # ------------------------------------------------------------------
+    async def generate(self, req: PreprocessedRequest) -> AsyncIterator[LLMEngineOutput]:
+        self.start()
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[req.request_id] = q
+        self._inbox.put(("add", req))
+        self._wake.set()
+        out: LLMEngineOutput | None = None
+        try:
+            while True:
+                out = await q.get()
+                yield out
+                if out.finish_reason is not None:
+                    break
+        finally:
+            self._streams.pop(req.request_id, None)
+            if out is None or out.finish_reason is None:  # client bailed early
+                self._inbox.put(("abort", req.request_id))
+                self._wake.set()
+
+    def stats(self) -> dict:
+        return self.core.metrics.snapshot(self.core.sched, self.core.pool)
+
+
+def build_engine(engine_cfg: EngineConfig, mesh=None, params=None,
+                 event_sink=None) -> AsyncJaxEngine:
+    if mesh is None and engine_cfg.mesh_shape() != {"data": 1, "model": 1, "expert": 1, "seq": 1}:
+        mesh = make_mesh(MeshConfig(dp=engine_cfg.dp, sp=engine_cfg.sp,
+                                    tp=engine_cfg.tp, ep=engine_cfg.ep))
+    core = EngineCore(engine_cfg, mesh=mesh, params=params, event_sink=event_sink)
+    return AsyncJaxEngine(core)

@@ -1,0 +1,83 @@
+"""Batched, jittable token sampling.
+
+All sampling params are per-request arrays so one compiled function serves a
+mixed batch (greedy + temperature + top-k/p + penalties). Greedy is
+``temperature <= 0``. Per-request PRNG keys make seeded requests reproducible
+regardless of batch composition.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@dataclass
+class SamplingState:
+    """Device-side per-slot sampling params (batch-indexed)."""
+
+    temperature: jax.Array   # [B] f32; <=0 → greedy
+    top_k: jax.Array         # [B] i32; 0 → disabled
+    top_p: jax.Array         # [B] f32; 1.0 → disabled
+    frequency_penalty: jax.Array  # [B] f32
+    presence_penalty: jax.Array   # [B] f32
+    repetition_penalty: jax.Array  # [B] f32; 1.0 → disabled
+    keys: jax.Array          # [B, 2] uint32 per-request PRNG key data
+    token_counts: jax.Array  # [B, V] i32 counts of emitted tokens (penalties)
+
+
+def apply_penalties(logits: jax.Array, st: SamplingState) -> jax.Array:
+    counts = st.token_counts.astype(jnp.float32)
+    seen = counts > 0
+    logits = logits - st.frequency_penalty[:, None] * counts
+    logits = logits - st.presence_penalty[:, None] * seen
+    rp = st.repetition_penalty[:, None]
+    rep = jnp.where(logits > 0, logits / rp, logits * rp)
+    logits = jnp.where(seen & (rp != 1.0), rep, logits)
+    return logits
+
+
+def sample(logits: jax.Array, st: SamplingState) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Sample one token per row.
+
+    Returns (tokens [B] i32, logprobs [B] f32, new_keys [B,2]).
+    """
+    b, v = logits.shape
+    logits = logits.astype(jnp.float32)
+    logits = apply_penalties(logits, st)
+    greedy = st.temperature <= 0.0
+
+    temp = jnp.maximum(st.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+    sort_idx = jnp.argsort(scaled, axis=-1, descending=True)
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs  # mass strictly before this rank
+    rank = jnp.arange(v)[None, :]
+    k = jnp.where(st.top_k <= 0, v, st.top_k)[:, None]
+    keep = (rank < k) & (cum < st.top_p[:, None])
+    keep = keep.at[:, 0].set(True)
+    masked = jnp.where(keep, sorted_logits, NEG_INF)
+
+    keys = jax.vmap(jax.random.wrap_key_data)(st.keys)
+    def draw(key, row):
+        new_key, sub = jax.random.split(key)
+        return jax.random.categorical(sub, row), jax.random.key_data(new_key)
+
+    sampled_rank, new_keys = jax.vmap(draw)(keys, masked)
+    sampled = jnp.take_along_axis(sort_idx, sampled_rank[:, None], axis=-1)[:, 0]
+    tokens = jnp.where(greedy, jnp.argmax(logits, axis=-1), sampled).astype(jnp.int32)
+
+    logprobs_all = jax.nn.log_softmax(logits, axis=-1)
+    lp = jnp.take_along_axis(logprobs_all, tokens[:, None], axis=-1)[:, 0]
+    return tokens, lp, new_keys
+
+
+def record_tokens(token_counts: jax.Array, tokens: jax.Array, active: jax.Array) -> jax.Array:
+    """Scatter-add sampled tokens into the penalty counts (inactive rows skipped)."""
+    inc = active.astype(jnp.int32)
+    return token_counts.at[jnp.arange(tokens.shape[0]), tokens].add(inc)

@@ -1,0 +1,3 @@
+from dynamo_tpu.frontend.service import HttpService
+
+__all__ = ["HttpService"]

@@ -1,0 +1,89 @@
+"""Backend output → OpenAI response assembly (streaming deltas + aggregates).
+
+Fills the role of the reference's DeltaGenerator + aggregators
+(reference: lib/llm/src/protocols/openai/*/aggregator.rs and the
+preprocessor's response edge).
+"""
+
+from __future__ import annotations
+
+import uuid
+
+from dynamo_tpu.protocols.common import BackendOutput
+from dynamo_tpu.protocols.openai import (
+    ChatChoice,
+    ChatChoiceDelta,
+    ChatChunkChoice,
+    ChatCompletionChunk,
+    ChatCompletionResponse,
+    ChatMessage,
+    CompletionChoice,
+    CompletionResponse,
+    Usage,
+)
+
+
+class ChatDeltaGenerator:
+    """Builds chat.completion.chunk SSE events from backend deltas."""
+
+    def __init__(self, model: str, request_id: str | None = None):
+        self.id = f"chatcmpl-{request_id or uuid.uuid4().hex}"
+        self.model = model
+        self._first = True
+        self.completion_tokens = 0
+        self.prompt_tokens = 0
+
+    def role_chunk(self) -> ChatCompletionChunk:
+        return ChatCompletionChunk(
+            id=self.id, model=self.model,
+            choices=[ChatChunkChoice(delta=ChatChoiceDelta(role="assistant", content=""))],
+        )
+
+    def chunk(self, out: BackendOutput) -> ChatCompletionChunk | None:
+        self.completion_tokens += len(out.token_ids)
+        if not out.text and out.finish_reason is None:
+            return None  # jailed/empty delta — emit nothing
+        return ChatCompletionChunk(
+            id=self.id, model=self.model,
+            choices=[ChatChunkChoice(
+                delta=ChatChoiceDelta(content=out.text or None),
+                finish_reason=str(out.finish_reason) if out.finish_reason else None,
+            )],
+        )
+
+    def usage(self) -> Usage:
+        return Usage(
+            prompt_tokens=self.prompt_tokens,
+            completion_tokens=self.completion_tokens,
+            total_tokens=self.prompt_tokens + self.completion_tokens,
+        )
+
+
+def aggregate_chat(model: str, outs: list[BackendOutput], prompt_tokens: int) -> ChatCompletionResponse:
+    text = "".join(o.text for o in outs)
+    finish = next((str(o.finish_reason) for o in outs if o.finish_reason), None)
+    completion_tokens = sum(len(o.token_ids) for o in outs)
+    return ChatCompletionResponse(
+        model=model,
+        choices=[ChatChoice(message=ChatMessage(role="assistant", content=text), finish_reason=finish)],
+        usage=Usage(
+            prompt_tokens=prompt_tokens,
+            completion_tokens=completion_tokens,
+            total_tokens=prompt_tokens + completion_tokens,
+        ),
+    )
+
+
+def aggregate_completion(model: str, outs: list[BackendOutput], prompt_tokens: int) -> CompletionResponse:
+    text = "".join(o.text for o in outs)
+    finish = next((str(o.finish_reason) for o in outs if o.finish_reason), None)
+    completion_tokens = sum(len(o.token_ids) for o in outs)
+    return CompletionResponse(
+        model=model,
+        choices=[CompletionChoice(text=text, finish_reason=finish)],
+        usage=Usage(
+            prompt_tokens=prompt_tokens,
+            completion_tokens=completion_tokens,
+            total_tokens=prompt_tokens + completion_tokens,
+        ),
+    )

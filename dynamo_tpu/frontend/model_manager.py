@@ -1,0 +1,73 @@
+"""Model registry for the frontend.
+
+Fills the role of the reference's ModelManager + ModelWatcher
+(reference: lib/llm/src/discovery/model_manager.rs:35, watcher.rs:50):
+models appear/disappear at runtime (static registration here; the
+discovery-watcher wires into this in runtime/), each carrying its
+preprocessor, detokenizer config, and an engine-facing generate function.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import AsyncIterator, Awaitable, Callable, Protocol
+
+from dynamo_tpu.preprocessor.preprocessor import ModelDefaults, OpenAIPreprocessor
+from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.tokenizer import BaseTokenizer
+
+# An engine entry point: PreprocessedRequest -> async stream of outputs.
+GenerateFn = Callable[[PreprocessedRequest], AsyncIterator[LLMEngineOutput]]
+
+
+@dataclass
+class ModelEntry:
+    """One servable model (reference: discovery/model_entry.rs ModelEntry +
+    model card)."""
+
+    name: str
+    tokenizer: BaseTokenizer
+    generate: GenerateFn
+    defaults: ModelDefaults
+    preprocessor: OpenAIPreprocessor
+    stats: Callable[[], dict] | None = None
+    clear_kv: Callable[[], Awaitable[None]] | None = None
+
+
+class ModelManager:
+    def __init__(self) -> None:
+        self._models: dict[str, ModelEntry] = {}
+
+    def register(
+        self,
+        name: str,
+        tokenizer: BaseTokenizer,
+        generate: GenerateFn,
+        defaults: ModelDefaults | None = None,
+        stats: Callable[[], dict] | None = None,
+        clear_kv: Callable[[], Awaitable[None]] | None = None,
+    ) -> ModelEntry:
+        defaults = defaults or ModelDefaults()
+        entry = ModelEntry(
+            name=name,
+            tokenizer=tokenizer,
+            generate=generate,
+            defaults=defaults,
+            preprocessor=OpenAIPreprocessor(name, tokenizer, defaults),
+            stats=stats,
+            clear_kv=clear_kv,
+        )
+        self._models[name] = entry
+        return entry
+
+    def unregister(self, name: str) -> None:
+        self._models.pop(name, None)
+
+    def get(self, name: str) -> ModelEntry | None:
+        return self._models.get(name)
+
+    def names(self) -> list[str]:
+        return sorted(self._models)
+
+    def __len__(self) -> int:
+        return len(self._models)

@@ -1,0 +1,241 @@
+"""OpenAI-compatible HTTP frontend (aiohttp).
+
+Fills the role of the reference's axum HttpService
+(reference: lib/llm/src/http/service/openai.rs /v1/* routes,
+service_v2.rs HttpService, metrics.rs TTFT/ITL observations,
+disconnect.rs SSE disconnect detection):
+
+- POST /v1/chat/completions, /v1/completions (SSE streaming + aggregate)
+- GET  /v1/models
+- GET  /health, /live, /metrics
+- POST /clear_kv_blocks (admin)
+
+Client disconnects cancel the underlying generation (the engine abort path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+
+from aiohttp import web
+
+from dynamo_tpu.backend import DetokenizerBackend
+from dynamo_tpu.frontend.delta import (
+    ChatDeltaGenerator,
+    aggregate_chat,
+    aggregate_completion,
+)
+from dynamo_tpu.frontend.model_manager import ModelEntry, ModelManager
+from dynamo_tpu.protocols.common import BackendOutput
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    ErrorInfo,
+    ErrorResponse,
+    ModelInfo,
+    ModelList,
+)
+from dynamo_tpu.protocols.sse import DONE_EVENT, encode_sse_json
+from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.metrics import MetricsRegistry
+
+log = get_logger("frontend")
+
+
+def _error(status: int, message: str) -> web.Response:
+    body = ErrorResponse(error=ErrorInfo(message=message, code=status)).model_dump_json()
+    return web.Response(status=status, text=body, content_type="application/json")
+
+
+class HttpService:
+    def __init__(self, models: ModelManager | None = None, metrics: MetricsRegistry | None = None):
+        self.models = models or ModelManager()
+        self.metrics = metrics or MetricsRegistry()
+        m = self.metrics
+        self._requests = m.counter("frontend_requests_total", "HTTP requests by route/status")
+        self._inflight = m.gauge("frontend_inflight", "in-flight requests")
+        self._ttft = m.histogram("frontend_time_to_first_token_seconds", "TTFT")
+        self._itl = m.histogram("frontend_inter_token_latency_seconds", "ITL")
+        self._req_dur = m.histogram("frontend_request_duration_seconds", "request duration")
+        self._output_tokens = m.counter("frontend_output_tokens_total", "output tokens")
+        self.app = web.Application()
+        self.app.router.add_post("/v1/chat/completions", self.chat_completions)
+        self.app.router.add_post("/v1/completions", self.completions)
+        self.app.router.add_get("/v1/models", self.list_models)
+        self.app.router.add_get("/health", self.health)
+        self.app.router.add_get("/live", self.live)
+        self.app.router.add_get("/metrics", self.metrics_handler)
+        self.app.router.add_post("/clear_kv_blocks", self.clear_kv_blocks)
+        self._runner: web.AppRunner | None = None
+        self.port: int = 0
+
+    # ------------------------------------------------------------------
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        log.info("http service listening on %s:%d", host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # ------------------------------------------------------------------
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy", "models": self.models.names()})
+
+    async def live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def metrics_handler(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.expose(), content_type="text/plain")
+
+    async def list_models(self, request: web.Request) -> web.Response:
+        data = ModelList(data=[ModelInfo(id=n) for n in self.models.names()])
+        return web.Response(text=data.model_dump_json(), content_type="application/json")
+
+    async def clear_kv_blocks(self, request: web.Request) -> web.Response:
+        results = {}
+        for name in self.models.names():
+            entry = self.models.get(name)
+            if entry and entry.clear_kv:
+                await entry.clear_kv()
+                results[name] = "cleared"
+            else:
+                results[name] = "unsupported"
+        return web.json_response(results)
+
+    # ------------------------------------------------------------------
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, chat=True)
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, chat=False)
+
+    async def _serve(self, request: web.Request, chat: bool) -> web.StreamResponse:
+        route = "chat" if chat else "completions"
+        try:
+            payload = await request.json()
+        except json.JSONDecodeError:
+            self._requests.inc(route=route, status="400")
+            return _error(400, "invalid JSON body")
+        try:
+            req = ChatCompletionRequest(**payload) if chat else CompletionRequest(**payload)
+        except Exception as exc:
+            self._requests.inc(route=route, status="400")
+            return _error(400, f"invalid request: {exc}")
+        entry = self.models.get(req.model)
+        if entry is None:
+            self._requests.inc(route=route, status="404")
+            return _error(404, f"model '{req.model}' not found (have: {self.models.names()})")
+
+        request_id = request.headers.get("x-request-id") or uuid.uuid4().hex
+        try:
+            if chat:
+                pre = entry.preprocessor.preprocess_chat(req, request_id)
+            else:
+                pre = entry.preprocessor.preprocess_completion(req, request_id)
+        except Exception as exc:
+            self._requests.inc(route=route, status="400")
+            return _error(400, f"preprocessing failed: {exc}")
+
+        self._inflight.inc(model=req.model)
+        t_start = time.monotonic()
+        try:
+            if req.stream:
+                return await self._stream_response(request, req, entry, pre, chat, t_start)
+            return await self._aggregate_response(req, entry, pre, chat, t_start, route)
+        finally:
+            self._inflight.inc(-1, model=req.model)
+            self._req_dur.observe(time.monotonic() - t_start, model=req.model)
+
+    # ------------------------------------------------------------------
+    async def _aggregate_response(self, req, entry: ModelEntry, pre, chat: bool,
+                                  t_start: float, route: str) -> web.Response:
+        backend = DetokenizerBackend(entry.tokenizer, stops=pre.stop_conditions.stop)
+        outs: list[BackendOutput] = []
+        first = True
+        prev = t_start
+        async for eo in entry.generate(pre):
+            now = time.monotonic()
+            if first and eo.token_ids:
+                self._ttft.observe(now - t_start, model=req.model)
+                first = False
+            elif eo.token_ids:
+                self._itl.observe(now - prev, model=req.model)
+            prev = now
+            if eo.error:
+                self._requests.inc(route=route, status="500")
+                return _error(500, eo.error)
+            out = backend.step(eo)
+            outs.append(out)
+            if backend.hit_stop:
+                break
+        self._output_tokens.inc(sum(len(o.token_ids) for o in outs), model=req.model)
+        resp = (aggregate_chat if chat else aggregate_completion)(req.model, outs, len(pre.token_ids))
+        self._requests.inc(route=route, status="200")
+        return web.Response(text=resp.model_dump_json(exclude_none=True), content_type="application/json")
+
+    async def _stream_response(self, request: web.Request, req, entry: ModelEntry, pre,
+                               chat: bool, t_start: float) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache",
+                     "x-request-id": pre.request_id},
+        )
+        await resp.prepare(request)
+        backend = DetokenizerBackend(entry.tokenizer, stops=pre.stop_conditions.stop)
+        gen = ChatDeltaGenerator(req.model, pre.request_id)
+        gen.prompt_tokens = len(pre.token_ids)
+        first = True
+        prev = t_start
+        ntokens = 0
+        try:
+            if chat:
+                await resp.write(encode_sse_json(gen.role_chunk()))
+            async for eo in entry.generate(pre):
+                now = time.monotonic()
+                if eo.token_ids:
+                    if first:
+                        self._ttft.observe(now - t_start, model=req.model)
+                        first = False
+                    else:
+                        self._itl.observe(now - prev, model=req.model)
+                    prev = now
+                    ntokens += len(eo.token_ids)
+                if eo.error:
+                    await resp.write(encode_sse_json({"error": {"message": eo.error, "code": 500}}))
+                    break
+                out = backend.step(eo)
+                if chat:
+                    chunk = gen.chunk(out)
+                    if chunk is not None:
+                        await resp.write(encode_sse_json(chunk))
+                else:
+                    if out.text or out.finish_reason:
+                        from dynamo_tpu.protocols.openai import CompletionChoice, CompletionResponse
+
+                        cr = CompletionResponse(
+                            id=f"cmpl-{pre.request_id}", model=req.model,
+                            choices=[CompletionChoice(
+                                text=out.text,
+                                finish_reason=str(out.finish_reason) if out.finish_reason else None)],
+                        )
+                        await resp.write(encode_sse_json(cr))
+                if backend.hit_stop:
+                    break
+            await resp.write(DONE_EVENT)
+            self._requests.inc(route="chat" if chat else "completions", status="200")
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away — generator cleanup aborts the engine request
+            log.info("client disconnected request_id=%s", pre.request_id)
+            self._requests.inc(route="chat" if chat else "completions", status="499")
+        finally:
+            self._output_tokens.inc(ntokens, model=req.model)
+        return resp

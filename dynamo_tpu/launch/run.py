@@ -1,0 +1,162 @@
+"""Single-binary launcher: ``python -m dynamo_tpu.launch.run in=<mode> out=<engine>``.
+
+Fills the role of the reference's dynamo-run CLI
+(reference: launch/dynamo-run/src/main.rs `in=http|text|batch out=engine`):
+one process, no external infra (the StaticFull pipeline,
+reference: lib/llm/src/entrypoint.rs:58): frontend → preprocessor → engine
+→ detokenizer, all in-process.
+
+Examples:
+    python -m dynamo_tpu.launch.run in=http out=jax --model tiny-llama --port 8080
+    python -m dynamo_tpu.launch.run in=text out=jax --model tiny-llama
+    python -m dynamo_tpu.launch.run in=batch out=jax --model tiny-llama --input-jsonl prompts.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from dynamo_tpu.engine.engine import AsyncJaxEngine, EngineCore
+from dynamo_tpu.frontend.model_manager import ModelManager
+from dynamo_tpu.frontend.service import HttpService
+from dynamo_tpu.preprocessor.preprocessor import ModelDefaults
+from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+from dynamo_tpu.tokenizer import DecodeStream, load_tokenizer
+from dynamo_tpu.utils.config import EngineConfig
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+log = get_logger("launch")
+
+
+def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    in_mode, out_mode = "text", "jax"
+    rest = []
+    for a in argv:
+        if a.startswith("in="):
+            in_mode = a[3:]
+        elif a.startswith("out="):
+            out_mode = a[4:]
+        else:
+            rest.append(a)
+    p = argparse.ArgumentParser("dynamo-run")
+    p.add_argument("--model", default="tiny-llama")
+    p.add_argument("--tokenizer", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--max-batch-size", type=int, default=64)
+    p.add_argument("--max-model-len", type=int, default=8192)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-blocks", type=int, default=0)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--max-tokens", type=int, default=256, help="default max output tokens")
+    p.add_argument("--input-jsonl", default=None)
+    ns = p.parse_args(rest)
+    ns.in_mode, ns.out_mode = in_mode, out_mode
+    return ns
+
+
+def build_local_engine(ns: argparse.Namespace) -> tuple[AsyncJaxEngine, EngineConfig]:
+    cfg = EngineConfig(
+        model=ns.model,
+        max_batch_size=ns.max_batch_size,
+        max_model_len=ns.max_model_len,
+        block_size=ns.block_size,
+        num_blocks=ns.num_blocks,
+        tp=ns.tp,
+    )
+    from dynamo_tpu.engine.engine import build_engine
+
+    return build_engine(cfg), cfg
+
+
+async def run_http(ns: argparse.Namespace) -> None:
+    engine, cfg = build_local_engine(ns)
+    tok = load_tokenizer(ns.tokenizer or ns.model)
+    models = ModelManager()
+    models.register(
+        ns.model, tok, engine.generate,
+        defaults=ModelDefaults(max_model_len=cfg.max_model_len, default_max_tokens=ns.max_tokens),
+        stats=engine.stats,
+    )
+    svc = HttpService(models)
+    await svc.start(ns.host, ns.port)
+    log.info("serving %s on http://%s:%d/v1", ns.model, ns.host, svc.port)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await svc.stop()
+        await engine.shutdown()
+
+
+async def run_text(ns: argparse.Namespace) -> None:
+    engine, cfg = build_local_engine(ns)
+    tok = load_tokenizer(ns.tokenizer or ns.model)
+    print(f"dynamo_tpu REPL — model={ns.model} (ctrl-d to exit)")
+    loop = asyncio.get_running_loop()
+    while True:
+        try:
+            line = await loop.run_in_executor(None, lambda: input("> "))
+        except (EOFError, KeyboardInterrupt):
+            break
+        req = PreprocessedRequest(
+            token_ids=tok.encode(tok.apply_chat_template([{"role": "user", "content": line}]), add_bos=True),
+            stop_conditions=StopConditions(max_tokens=ns.max_tokens),
+            sampling_options=SamplingOptions(temperature=0.7),
+            eos_token_ids=[tok.eos_id],
+        )
+        stream = DecodeStream(tok)
+        async for out in engine.generate(req):
+            for t in out.token_ids:
+                sys.stdout.write(stream.step(t))
+                sys.stdout.flush()
+        sys.stdout.write(stream.flush() + "\n")
+    await engine.shutdown()
+
+
+async def run_batch(ns: argparse.Namespace) -> None:
+    """Batch mode: JSONL of {"prompt": ...} → JSONL of completions."""
+    engine, cfg = build_local_engine(ns)
+    tok = load_tokenizer(ns.tokenizer or ns.model)
+
+    async def one(line: str) -> dict:
+        obj = json.loads(line)
+        req = PreprocessedRequest(
+            token_ids=tok.encode(obj["prompt"], add_bos=True),
+            stop_conditions=StopConditions(max_tokens=obj.get("max_tokens", ns.max_tokens)),
+            sampling_options=SamplingOptions(temperature=obj.get("temperature", 0.0)),
+            eos_token_ids=[tok.eos_id],
+        )
+        toks: list[int] = []
+        async for out in engine.generate(req):
+            toks.extend(out.token_ids)
+        return {"prompt": obj["prompt"], "text": tok.decode(toks), "tokens": len(toks)}
+
+    src = open(ns.input_jsonl) if ns.input_jsonl else sys.stdin
+    lines = [ln for ln in src.read().splitlines() if ln.strip()]
+    results = await asyncio.gather(*(one(ln) for ln in lines))
+    for r in results:
+        print(json.dumps(r))
+    await engine.shutdown()
+
+
+def main() -> None:
+    configure_logging()
+    ns = parse_args()
+    if ns.out_mode not in ("jax",):
+        raise SystemExit(f"unknown out={ns.out_mode} (supported: jax)")
+    if ns.in_mode == "http":
+        asyncio.run(run_http(ns))
+    elif ns.in_mode == "text":
+        asyncio.run(run_text(ns))
+    elif ns.in_mode == "batch":
+        asyncio.run(run_batch(ns))
+    else:
+        raise SystemExit(f"unknown in={ns.in_mode} (supported: http, text, batch)")
+
+
+if __name__ == "__main__":
+    main()

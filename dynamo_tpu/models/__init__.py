@@ -1,0 +1,3 @@
+from dynamo_tpu.models.config import MODEL_PRESETS, ModelConfig
+
+__all__ = ["ModelConfig", "MODEL_PRESETS"]

@@ -1,0 +1,306 @@
+"""Llama-family transformer in pure JAX over a paged KV cache.
+
+This is the engine's model math — the part the reference delegates to
+vLLM/SGLang/TRT-LLM (SURVEY.md §7: first-party JAX engine). Design points:
+
+- **One forward for prefill and decode.** A step processes ``T`` query
+  tokens per sequence (T=chunk for prefill, T=1 for decode) against a paged
+  KV cache addressed by per-request block tables. Static shapes per
+  (batch-bucket, T-bucket) so XLA compiles once per bucket.
+- **Layers are scanned** (``lax.scan`` over stacked layer params) so 80-layer
+  models trace/compile in constant time, with the per-layer KV cache slices
+  threaded through the scan.
+- **Paged attention via gather** in the portable path: context KV is gathered
+  from cache blocks by block table then attended densely with position
+  masking (XLA fuses this well); a Pallas kernel (ops/) replaces it on TPU.
+- **Block 0 is the trash block**: padding tokens scatter their KV there, so
+  no dynamic control flow is needed for ragged batches.
+
+Sharding: logical axes annotated per param (parallel/mesh.py rules) — heads
+and MLP intermediate on the "model" mesh axis, experts on "expert".
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dynamo_tpu.models.config import ModelConfig
+
+Params = dict[str, Any]
+
+
+def _dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Parameter init (shapes + logical sharding axes)
+# ---------------------------------------------------------------------------
+
+def param_logical_axes(cfg: ModelConfig) -> Params:
+    """Logical axis names per parameter leaf (for mesh sharding rules)."""
+    layer = {
+        "wq": ("layers", None, "heads"),
+        "wk": ("layers", None, "kv_heads"),
+        "wv": ("layers", None, "kv_heads"),
+        "wo": ("layers", "heads", None),
+        "attn_norm": ("layers", None),
+        "mlp_norm": ("layers", None),
+    }
+    if cfg.is_moe:
+        layer.update(
+            router=("layers", None, "expert"),
+            w_gate=("layers", "expert", None, "moe_mlp"),
+            w_up=("layers", "expert", None, "moe_mlp"),
+            w_down=("layers", "expert", "moe_mlp", None),
+        )
+        if cfg.num_shared_experts:
+            layer.update(
+                shared_gate=("layers", None, "mlp"),
+                shared_up=("layers", None, "mlp"),
+                shared_down=("layers", "mlp", None),
+            )
+    else:
+        layer.update(
+            w_gate=("layers", None, "mlp"),
+            w_up=("layers", None, "mlp"),
+            w_down=("layers", "mlp", None),
+        )
+    axes: Params = {"embed": ("vocab", None), "final_norm": (None,), "layers": layer}
+    if not cfg.tie_word_embeddings:
+        axes["lm_head"] = (None, "vocab")
+    return axes
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Random-init params (tests/tiny models; real weights come from loaders)."""
+    dt = _dtype(cfg)
+    k = iter(jax.random.split(key, 24))
+    h, L = cfg.hidden_size, cfg.num_layers
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (fan_in**-0.5)).astype(dt)
+
+    layer: Params = {
+        "wq": dense(next(k), (L, h, cfg.q_size), h),
+        "wk": dense(next(k), (L, h, cfg.kv_size), h),
+        "wv": dense(next(k), (L, h, cfg.kv_size), h),
+        "wo": dense(next(k), (L, cfg.q_size, h), cfg.q_size),
+        "attn_norm": jnp.ones((L, h), dt),
+        "mlp_norm": jnp.ones((L, h), dt),
+    }
+    if cfg.is_moe:
+        E, m = cfg.num_experts, cfg.moe_intermediate_size
+        layer.update(
+            router=dense(next(k), (L, h, E), h),
+            w_gate=dense(next(k), (L, E, h, m), h),
+            w_up=dense(next(k), (L, E, h, m), h),
+            w_down=dense(next(k), (L, E, m, h), m),
+        )
+        if cfg.num_shared_experts:
+            sm = cfg.moe_intermediate_size * cfg.num_shared_experts
+            layer.update(
+                shared_gate=dense(next(k), (L, h, sm), h),
+                shared_up=dense(next(k), (L, h, sm), h),
+                shared_down=dense(next(k), (L, sm, h), sm),
+            )
+    else:
+        i = cfg.intermediate_size
+        layer.update(
+            w_gate=dense(next(k), (L, h, i), h),
+            w_up=dense(next(k), (L, h, i), h),
+            w_down=dense(next(k), (L, i, h), i),
+        )
+    params: Params = {
+        "embed": dense(next(k), (cfg.vocab_size, h), h),
+        "final_norm": jnp.ones((h,), dt),
+        "layers": layer,
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = dense(next(k), (h, cfg.vocab_size), h)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * w
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding, half-rotate (HF llama) convention.
+
+    x: [B, T, H, D]; positions: [B, T].
+    """
+    d = x.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,T,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _scatter_kv(cache: jax.Array, new: jax.Array, slot_idx: jax.Array) -> jax.Array:
+    """Write new KV [B,T,KH,D] into paged cache [NB,BS,KH,D] at flat slots.
+
+    slot_idx: [B,T] flat slot index (block*block_size + offset); padding
+    tokens point at the trash block (block 0).
+    """
+    nb, bs, kh, d = cache.shape
+    flat = cache.reshape(nb * bs, kh, d)
+    idx = slot_idx.reshape(-1)
+    vals = new.reshape(-1, kh, d)
+    flat = flat.at[idx].set(vals, mode="drop")
+    return flat.reshape(nb, bs, kh, d)
+
+
+def _gather_kv(cache: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """Gather context KV: cache [NB,BS,KH,D], block_tables [B,NBLK] →
+    [B, NBLK*BS, KH, D] laid out in position order."""
+    g = cache[block_tables]  # [B, NBLK, BS, KH, D]
+    b, nblk, bs, kh, d = g.shape
+    return g.reshape(b, nblk * bs, kh, d)
+
+
+def paged_attention(
+    q: jax.Array,           # [B, T, H, D]
+    ctx_k: jax.Array,       # [B, S, KH, D]
+    ctx_v: jax.Array,       # [B, S, KH, D]
+    q_positions: jax.Array,  # [B, T]
+    kv_lens: jax.Array,      # [B] total valid context length
+) -> jax.Array:
+    """Dense attention over gathered paged context with causal position mask.
+
+    Portable path (CPU + TPU); the Pallas paged-attention kernel
+    (ops/paged_attention.py) is numerically equivalent.
+    """
+    b, t, h, d = q.shape
+    s = ctx_k.shape[1]
+    kh = ctx_k.shape[2]
+    rep = h // kh
+    qf = q.astype(jnp.float32) * (d**-0.5)
+    qf = qf.reshape(b, t, kh, rep, d)
+    scores = jnp.einsum("btkrd,bskd->btkrs", qf, ctx_k.astype(jnp.float32))
+    ctx_idx = jnp.arange(s)[None, None, :]                      # [1,1,S]
+    visible = (ctx_idx <= q_positions[:, :, None]) & (ctx_idx < kv_lens[:, None, None])
+    scores = jnp.where(visible[:, :, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("btkrs,bskd->btkrd", probs, ctx_v.astype(jnp.float32))
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array) -> jax.Array:
+    return (jax.nn.silu(x @ w_gate) * (x @ w_up)) @ w_down
+
+
+def moe_mlp(x: jax.Array, lp: Params, cfg: ModelConfig) -> jax.Array:
+    """MoE FFN, dense-dispatch formulation (every expert computed, combined by
+    top-k router weights). Exact for any E; the EP-sharded ragged-dispatch
+    version lives in models/moe.py and is numerically equivalent.
+
+    x: [B, T, H]
+    """
+    b, t, h = x.shape
+    xt = x.reshape(-1, h)                                     # [N, H]
+    logits = (xt.astype(jnp.float32)) @ lp["router"].astype(jnp.float32)  # [N, E]
+    k = cfg.num_experts_per_tok
+    topv, topi = lax.top_k(logits, k)
+    weights = jax.nn.softmax(topv, axis=-1)                   # [N, k]
+    e = cfg.num_experts
+    gate_mask = jnp.zeros((xt.shape[0], e), jnp.float32)
+    gate_mask = gate_mask.at[jnp.arange(xt.shape[0])[:, None], topi].add(weights)  # [N, E]
+    # all-experts compute: [N,E,m]
+    up = jnp.einsum("nh,ehm->nem", xt, lp["w_up"])
+    gate = jnp.einsum("nh,ehm->nem", xt, lp["w_gate"])
+    act = jax.nn.silu(gate) * up
+    per_expert = jnp.einsum("nem,emh->neh", act, lp["w_down"])
+    out = jnp.einsum("neh,ne->nh", per_expert.astype(jnp.float32), gate_mask).astype(x.dtype)
+    if cfg.num_shared_experts:
+        out = out + swiglu(xt, lp["shared_gate"], lp["shared_up"], lp["shared_down"])
+    return out.reshape(b, t, h)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    token_ids: jax.Array,    # [B, T] int32
+    q_start: jax.Array,      # [B] position of first query token
+    q_len: jax.Array,        # [B] number of valid query tokens (≤ T)
+    block_tables: jax.Array,  # [B, NBLK] int32 block ids into the cache
+    cache_k: jax.Array,      # [L, NB, BS, KH, D]
+    cache_v: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One engine step. Returns (last_hidden [B,H], cache_k, cache_v).
+
+    Query token t of sequence b sits at position q_start[b]+t; its KV is
+    written into the cache slot named by the block table; attention sees all
+    cache positions ≤ its own. Works unchanged for prefill chunks (T>1) and
+    decode (T=1).
+    """
+    b, t = token_ids.shape
+    bs = cache_k.shape[2]
+    positions = q_start[:, None] + jnp.arange(t)[None, :]          # [B, T]
+    valid = jnp.arange(t)[None, :] < q_len[:, None]                # [B, T]
+    kv_lens = q_start + q_len                                      # [B]
+
+    # Flat cache slot per query token; padding → trash block 0.
+    blk = jnp.take_along_axis(
+        block_tables, jnp.clip(positions // bs, 0, block_tables.shape[1] - 1), axis=1
+    )                                                              # [B, T]
+    slot = jnp.where(valid, blk * bs + positions % bs, 0)
+
+    h = params["embed"][token_ids].astype(_dtype(cfg))             # [B, T, H]
+
+    def layer_fn(carry, xs):
+        hid = carry
+        lp, ck, cv = xs
+        x = rms_norm(hid, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(b, t, cfg.num_heads, cfg.head_dim)
+        k = (x @ lp["wk"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        v = (x @ lp["wv"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        ck = _scatter_kv(ck, k, slot)
+        cv = _scatter_kv(cv, v, slot)
+        ctx_k = _gather_kv(ck, block_tables)
+        ctx_v = _gather_kv(cv, block_tables)
+        attn = paged_attention(q, ctx_k, ctx_v, positions, kv_lens)
+        attn = attn.reshape(b, t, cfg.q_size) @ lp["wo"]
+        hid = hid + attn
+        x = rms_norm(hid, lp["mlp_norm"], cfg.rms_norm_eps)
+        if cfg.is_moe:
+            mlp_out = moe_mlp(x, lp, cfg)
+        else:
+            mlp_out = swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+        hid = hid + mlp_out
+        return hid, (ck, cv)
+
+    h, (cache_k, cache_v) = lax.scan(layer_fn, h, (params["layers"], cache_k, cache_v))
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+
+    # Hidden state at each sequence's last valid query token.
+    last_idx = jnp.clip(q_len - 1, 0, t - 1)                       # [B]
+    last_h = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [B, H]
+    return last_h, cache_k, cache_v
+
+
+def logits_from_hidden(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
+    """Project hidden [B,H] → logits [B,V] (tied or separate lm head)."""
+    if cfg.tie_word_embeddings:
+        return hidden @ params["embed"].T
+    return hidden @ params["lm_head"]

@@ -1,0 +1,3 @@
+from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh, param_sharding_rules
+
+__all__ = ["MeshConfig", "make_mesh", "param_sharding_rules"]

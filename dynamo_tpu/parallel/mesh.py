@@ -1,0 +1,87 @@
+"""Device mesh + sharding layout for the JAX engine.
+
+The reference passes TP/PP/EP sizes through to vLLM/SGLang (SURVEY.md §2.7);
+here parallelism is first-party: a ``jax.sharding.Mesh`` with axes
+
+    ("data", "seq", "model", "expert")
+
+- **model**: tensor parallel — attention heads and MLP intermediate sharded;
+  collectives (psum in the down-projections) ride ICI.
+- **expert**: expert parallel for MoE layers (experts split across devices,
+  tokens routed via ragged all-to-all).
+- **seq**: sequence/context parallel for long-context prefill (ring
+  attention over the sequence axis — absent in the reference, greenfield
+  here per SURVEY.md §2.7).
+- **data**: replica axis inside one engine (dp>1 engines also exist at the
+  framework level as separate workers, like the reference's DP).
+
+Shardings are expressed as PartitionSpec rules over logical param axes, GSPMD
+inserts the collectives (scaling-book recipe: mesh + annotations + let XLA
+do the rest).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("data", "seq", "model", "expert")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.sp * self.tp * self.ep
+
+
+def make_mesh(cfg: MeshConfig | None = None, devices: list | None = None) -> Mesh:
+    """Build the engine mesh. With no config, all local devices go on "model"."""
+    devices = devices if devices is not None else jax.devices()
+    if cfg is None:
+        cfg = MeshConfig(tp=len(devices))
+    if cfg.size > len(devices):
+        raise ValueError(f"mesh needs {cfg.size} devices, have {len(devices)}")
+    dev = np.asarray(devices[: cfg.size]).reshape(cfg.dp, cfg.sp, cfg.tp, cfg.ep)
+    return Mesh(dev, AXES)
+
+
+# Logical→mesh axis rules for model parameters. Keys are logical axis names
+# used by the model code; values are mesh axes (None = replicate).
+PARAM_RULES: dict[str, str | None] = {
+    "vocab": "model",          # embedding/lm_head vocab-sharded
+    "hidden": None,            # activations' hidden axis replicated in params
+    "heads": "model",          # attention heads sharded (TP)
+    "kv_heads": "model",
+    "head_dim": None,
+    "mlp": "model",            # MLP intermediate sharded (TP)
+    "expert": "expert",        # MoE experts sharded (EP)
+    "moe_mlp": "model",        # per-expert intermediate (TEP)
+    "layers": None,
+}
+
+
+def param_sharding_rules(mesh: Mesh, logical_axes: tuple[str | None, ...]) -> NamedSharding:
+    spec = P(*(PARAM_RULES.get(ax) if ax else None for ax in logical_axes))
+    return NamedSharding(mesh, spec)
+
+
+def kv_cache_spec() -> P:
+    """KV cache [layers, blocks, block_size, kv_heads, head_dim]: heads TP-sharded."""
+    return P(None, None, None, "model", None)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(MeshConfig(), devices=jax.devices()[:1])

@@ -1,0 +1,3 @@
+from dynamo_tpu.preprocessor.preprocessor import OpenAIPreprocessor
+
+__all__ = ["OpenAIPreprocessor"]

@@ -1,0 +1,104 @@
+"""OpenAI → internal request translation (tokenize, template, defaults).
+
+Fills the role of the reference's OpenAIPreprocessor
+(reference: lib/llm/src/preprocessor.rs:4-66): apply the model card's
+defaults, render the prompt template (chat messages → text), tokenize, and
+produce a ``PreprocessedRequest``; the reverse edge builds OpenAI deltas
+from backend output (see frontend/delta.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+from dynamo_tpu.protocols.openai import ChatCompletionRequest, CompletionRequest
+from dynamo_tpu.tokenizer import BaseTokenizer
+
+
+@dataclass
+class ModelDefaults:
+    """Per-model generation defaults (subset of the reference's
+    ModelDeploymentCard, lib/llm/src/model_card.rs:91)."""
+
+    max_model_len: int = 8192
+    default_max_tokens: int = 1024
+    eos_token_ids: list[int] | None = None
+    temperature: float | None = None
+    top_p: float | None = None
+
+
+class OpenAIPreprocessor:
+    def __init__(self, model_name: str, tokenizer: BaseTokenizer, defaults: ModelDefaults | None = None):
+        self.model_name = model_name
+        self.tokenizer = tokenizer
+        self.defaults = defaults or ModelDefaults()
+        if self.defaults.eos_token_ids is None:
+            eos = getattr(tokenizer, "eos_id", None)
+            self.defaults.eos_token_ids = [eos] if eos is not None else []
+
+    # ------------------------------------------------------------------
+    def _sampling(self, req: ChatCompletionRequest | CompletionRequest) -> SamplingOptions:
+        d = self.defaults
+        return SamplingOptions(
+            temperature=req.temperature if req.temperature is not None else d.temperature,
+            top_p=req.top_p if req.top_p is not None else d.top_p,
+            top_k=getattr(req, "top_k", None),
+            frequency_penalty=req.frequency_penalty,
+            presence_penalty=req.presence_penalty,
+            repetition_penalty=getattr(req, "repetition_penalty", None),
+            seed=req.seed,
+            n=req.n or 1,
+        )
+
+    def _stops(self, req: ChatCompletionRequest | CompletionRequest, max_tokens: int | None,
+               prompt_len: int) -> StopConditions:
+        cap = self.defaults.max_model_len - prompt_len
+        mt = max_tokens if max_tokens is not None else self.defaults.default_max_tokens
+        return StopConditions(
+            max_tokens=max(min(mt, cap), 0),
+            stop=req.stop_list(),
+            min_tokens=getattr(req, "min_tokens", None),
+            ignore_eos=bool(getattr(req, "ignore_eos", False)),
+        )
+
+    # ------------------------------------------------------------------
+    def preprocess_chat(self, req: ChatCompletionRequest, request_id: str | None = None) -> PreprocessedRequest:
+        use_raw = bool(req.nvext and req.nvext.use_raw_prompt)
+        messages = [m.model_dump(exclude_none=True) for m in req.messages]
+        if use_raw and messages and isinstance(messages[-1].get("content"), str):
+            prompt = messages[-1]["content"]
+        else:
+            prompt = self.tokenizer.apply_chat_template(messages, add_generation_prompt=True)
+        token_ids = self.tokenizer.encode(prompt, add_bos=True)
+        out = PreprocessedRequest(
+            token_ids=token_ids,
+            model=req.model,
+            stop_conditions=self._stops(req, req.effective_max_tokens(), len(token_ids)),
+            sampling_options=self._sampling(req),
+            eos_token_ids=list(self.defaults.eos_token_ids or []),
+            annotations={"formatted_prompt": prompt} if (req.nvext and req.nvext.annotations) else {},
+        )
+        if request_id:
+            out.request_id = request_id
+        return out
+
+    def preprocess_completion(self, req: CompletionRequest, request_id: str | None = None) -> PreprocessedRequest:
+        prompt = req.prompt
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            token_ids = list(prompt)  # pre-tokenized
+        elif isinstance(prompt, list):
+            token_ids = self.tokenizer.encode("".join(str(p) for p in prompt), add_bos=True)
+        else:
+            token_ids = self.tokenizer.encode(str(prompt), add_bos=True)
+        out = PreprocessedRequest(
+            token_ids=token_ids,
+            model=req.model,
+            stop_conditions=self._stops(req, req.max_tokens, len(token_ids)),
+            sampling_options=self._sampling(req),
+            eos_token_ids=list(self.defaults.eos_token_ids or []),
+        )
+        if request_id:
+            out.request_id = request_id
+        return out

@@ -1,0 +1,46 @@
+"""Server-Sent Events codec (reference: lib/llm/src/protocols/codec.rs)."""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator
+
+DONE_EVENT = b"data: [DONE]\n\n"
+
+
+def encode_sse(data: str) -> bytes:
+    return f"data: {data}\n\n".encode()
+
+
+def encode_sse_json(obj: Any) -> bytes:
+    # pydantic models expose model_dump_json; fall back to json.dumps
+    if hasattr(obj, "model_dump_json"):
+        payload = obj.model_dump_json(exclude_none=True)
+    else:
+        import json
+
+        payload = json.dumps(obj, separators=(",", ":"))
+    return encode_sse(payload)
+
+
+class SseDecoder:
+    """Incremental SSE parser (client side — used by tests and the batch input)."""
+
+    def __init__(self) -> None:
+        self._buf = b""
+
+    def feed(self, chunk: bytes) -> list[str]:
+        self._buf += chunk
+        events: list[str] = []
+        while b"\n\n" in self._buf:
+            raw, self._buf = self._buf.split(b"\n\n", 1)
+            data_lines = [ln[5:].strip() for ln in raw.split(b"\n") if ln.startswith(b"data:")]
+            if data_lines:
+                events.append(b"\n".join(data_lines).decode())
+        return events
+
+
+async def decode_sse_stream(byte_iter: AsyncIterator[bytes]) -> AsyncIterator[str]:
+    dec = SseDecoder()
+    async for chunk in byte_iter:
+        for ev in dec.feed(chunk):
+            yield ev

@@ -1,0 +1,3 @@
+from dynamo_tpu.router.events import BlockStored, BlockRemoved, KvCacheEvent, RouterEvent
+
+__all__ = ["BlockStored", "BlockRemoved", "KvCacheEvent", "RouterEvent"]

@@ -1,0 +1,9 @@
+from dynamo_tpu.tokenizer.base import (
+    BaseTokenizer,
+    ByteTokenizer,
+    DecodeStream,
+    HFTokenizer,
+    load_tokenizer,
+)
+
+__all__ = ["BaseTokenizer", "ByteTokenizer", "DecodeStream", "HFTokenizer", "load_tokenizer"]

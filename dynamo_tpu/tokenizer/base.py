@@ -1,0 +1,151 @@
+"""Tokenizer abstraction + incremental streaming decode.
+
+Fills the role of the reference's tokenizer wrapper
+(reference: lib/llm/src/tokenizers.rs, tokenizers/hf.rs:72): a uniform
+encode/decode interface over HF tokenizers, plus a ``DecodeStream`` that
+incrementally detokenizes a token stream without re-emitting text (the
+per-token hot loop in the response path).
+
+``ByteTokenizer`` is a deterministic, dependency- and network-free tokenizer
+(UTF-8 bytes + special tokens) used by tests, the mocker, and the tiny
+reference models — filling the role llama.cpp/GGUF vocab plays for the
+reference's zero-GPU test path (reference: lib/llm/src/gguf.rs).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Protocol, Sequence
+
+
+class BaseTokenizer(Protocol):
+    bos_id: int | None
+    eos_id: int
+    vocab_size: int
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]: ...
+    def decode(self, ids: Sequence[int], skip_special: bool = True) -> str: ...
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids = byte + 4; specials pad=0 bos=1 eos=2 unk=3."""
+
+    PAD, BOS, EOS, UNK = 0, 1, 2, 3
+    OFFSET = 4
+
+    def __init__(self, vocab_size: int = 512):
+        self.bos_id: int | None = self.BOS
+        self.eos_id = self.EOS
+        self.pad_id = self.PAD
+        self.vocab_size = max(vocab_size, 256 + self.OFFSET)
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids = [b + self.OFFSET for b in text.encode("utf-8")]
+        return ([self.BOS] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int], skip_special: bool = True) -> str:
+        return self.decode_bytes(ids).decode("utf-8", errors="replace")
+
+    def decode_bytes(self, ids: Sequence[int]) -> bytes:
+        # ids beyond the byte range are vocab padding (models may round the
+        # vocab up for sharding) — they decode to nothing.
+        return bytes(i - self.OFFSET for i in ids if self.OFFSET <= i < self.OFFSET + 256)
+
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+        # Minimal ChatML-style template (reference: minijinja templating in
+        # lib/llm/src/preprocessor/prompt/; real models use their HF template).
+        parts = []
+        for m in messages:
+            content = m.get("content") or ""
+            if isinstance(content, list):
+                content = "".join(p.get("text", "") for p in content if isinstance(p, dict))
+            parts.append(f"<|{m.get('role', 'user')}|>\n{content}\n")
+        if add_generation_prompt:
+            parts.append("<|assistant|>\n")
+        return "".join(parts)
+
+
+class HFTokenizer:
+    """HuggingFace tokenizer wrapper (local files only; zero-egress env)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True, trust_remote_code=False)
+        self.bos_id = self._tok.bos_token_id
+        self.eos_id = self._tok.eos_token_id if self._tok.eos_token_id is not None else 0
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if add_bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int], skip_special: bool = True) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special)
+
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+        try:
+            return self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=add_generation_prompt
+            )
+        except Exception:
+            return ByteTokenizer.apply_chat_template(self, messages, add_generation_prompt)  # type: ignore[arg-type]
+
+
+def load_tokenizer(name_or_path: str | None) -> BaseTokenizer:
+    """Resolve a tokenizer: local HF dir if it exists, else built-in byte tokenizer."""
+    if name_or_path and (
+        Path(name_or_path).is_dir() or os.path.exists(os.path.join(str(name_or_path), "tokenizer.json"))
+    ):
+        return HFTokenizer(str(name_or_path))
+    return ByteTokenizer()
+
+
+class DecodeStream:
+    """Incremental detokenizer for one response stream.
+
+    Reference: DecodeStream in lib/llm/src/tokenizers.rs — the per-token hot
+    loop of the response path.
+
+    Algorithm: decode a *segment* of recent token ids and emit the text grown
+    since the last emission. Emission is withheld while the segment's decode
+    ends in U+FFFD (incomplete multi-byte sequence split across tokens). The
+    segment is compacted at whitespace boundaries so cost stays O(segment),
+    not O(stream), without risking tokenizer context-dependence (e.g.
+    sentencepiece leading-space rules) splitting a word across segments.
+    """
+
+    _COMPACT_AFTER = 48  # tokens
+
+    def __init__(self, tokenizer: BaseTokenizer, skip_special: bool = True):
+        self._tok = tokenizer
+        self._skip_special = skip_special
+        self._seg_ids: list[int] = []
+        self._seg_emitted = 0  # chars of decode(_seg_ids) already emitted
+
+    def step(self, token_id: int) -> str:
+        """Feed one token; return the new text to emit ("" if withheld)."""
+        self._seg_ids.append(token_id)
+        text = self._tok.decode(self._seg_ids, skip_special=self._skip_special)
+        if text.endswith("�"):
+            return ""  # incomplete multi-byte sequence — wait for more tokens
+        delta = text[self._seg_emitted :]
+        self._seg_emitted = len(text)
+        if len(self._seg_ids) >= self._COMPACT_AFTER and delta[-1:].isspace():
+            self._seg_ids.clear()
+            self._seg_emitted = 0
+        return delta
+
+    def flush(self) -> str:
+        """Emit any withheld tail (e.g. trailing invalid bytes) at stream end."""
+        if not self._seg_ids:
+            return ""
+        text = self._tok.decode(self._seg_ids, skip_special=self._skip_special)
+        delta = text[self._seg_emitted :]
+        self._seg_ids.clear()
+        self._seg_emitted = 0
+        return delta

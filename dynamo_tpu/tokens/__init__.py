@@ -1,0 +1,141 @@
+"""Token block sequences and content hashing.
+
+Fills the role of the reference's ``lib/tokens`` crate
+(reference: lib/tokens/src/lib.rs:16-60): fixed-size token blocks with
+xxh3-based *block hashes* (local content) and chained *sequence hashes*
+(prefix identity), shared by the KV router and the KV block manager so a
+block of tokens has one global identity everywhere.
+
+Hash scheme (kept simple and documented so fixtures are reproducible):
+  block_hash(block)    = xxh3_64(le_u32_bytes(tokens in block))
+  seq_hash(block_0)    = block_hash(block_0)
+  seq_hash(block_i)    = xxh3_64(le_u64(seq_hash(block_{i-1})) || le_u64(block_hash(block_i)))
+
+A C++ fast path (csrc/) is used when built; the Python fallback is exact.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Iterable, Sequence
+
+import xxhash
+
+Token = int
+BlockHash = int
+SequenceHash = int
+
+__all__ = [
+    "Token",
+    "BlockHash",
+    "SequenceHash",
+    "compute_block_hash",
+    "compute_seq_hashes",
+    "compute_block_hashes_for_tokens",
+    "TokenBlock",
+    "TokenBlockSequence",
+]
+
+
+def compute_block_hash(tokens: Sequence[int]) -> BlockHash:
+    """Hash the raw token contents of one block (no chaining)."""
+    return xxhash.xxh3_64_intdigest(struct.pack(f"<{len(tokens)}I", *tokens))
+
+
+def _chain(parent: SequenceHash, block_hash: BlockHash) -> SequenceHash:
+    return xxhash.xxh3_64_intdigest(struct.pack("<QQ", parent, block_hash))
+
+
+def compute_seq_hashes(block_hashes: Sequence[BlockHash]) -> list[SequenceHash]:
+    """Chain block hashes into prefix-identifying sequence hashes."""
+    out: list[SequenceHash] = []
+    parent: SequenceHash | None = None
+    for bh in block_hashes:
+        parent = bh if parent is None else _chain(parent, bh)
+        out.append(parent)
+    return out
+
+
+def compute_block_hashes_for_tokens(tokens: Sequence[int], block_size: int) -> list[SequenceHash]:
+    """Sequence hashes for every *complete* block of ``tokens``.
+
+    This is the router's request-time hash path
+    (reference: lib/llm/src/kv_router/indexer.rs:125 compute_block_hash_for_seq).
+    """
+    n_full = len(tokens) // block_size
+    hashes = [compute_block_hash(tokens[i * block_size : (i + 1) * block_size]) for i in range(n_full)]
+    return compute_seq_hashes(hashes)
+
+
+@dataclass(frozen=True)
+class TokenBlock:
+    tokens: tuple[int, ...]
+    block_hash: BlockHash
+    sequence_hash: SequenceHash
+    position: int  # block index within the sequence
+
+
+@dataclass
+class TokenBlockSequence:
+    """A token sequence chunked into fixed-size blocks with incremental hashing.
+
+    Reference: lib/tokens/src/lib.rs (TokenBlockSequence). Supports appending
+    tokens one at a time (decode) or in bulk (prefill); complete blocks are
+    frozen with their hashes, the partial tail is kept mutable.
+    """
+
+    block_size: int
+    blocks: list[TokenBlock] = field(default_factory=list)
+    partial: list[int] = field(default_factory=list)
+
+    @classmethod
+    def from_tokens(cls, tokens: Iterable[int], block_size: int) -> "TokenBlockSequence":
+        seq = cls(block_size=block_size)
+        seq.extend(tokens)
+        return seq
+
+    def __len__(self) -> int:
+        return len(self.blocks) * self.block_size + len(self.partial)
+
+    @property
+    def tokens(self) -> list[int]:
+        out: list[int] = []
+        for b in self.blocks:
+            out.extend(b.tokens)
+        out.extend(self.partial)
+        return out
+
+    def append(self, token: int) -> TokenBlock | None:
+        """Append one token; returns the newly-completed block, if any."""
+        self.partial.append(token)
+        if len(self.partial) == self.block_size:
+            return self._seal()
+        return None
+
+    def extend(self, tokens: Iterable[int]) -> list[TokenBlock]:
+        sealed = []
+        for t in tokens:
+            blk = self.append(t)
+            if blk is not None:
+                sealed.append(blk)
+        return sealed
+
+    def _seal(self) -> TokenBlock:
+        bh = compute_block_hash(self.partial)
+        parent = self.blocks[-1].sequence_hash if self.blocks else None
+        sh = bh if parent is None else _chain(parent, bh)
+        blk = TokenBlock(
+            tokens=tuple(self.partial), block_hash=bh, sequence_hash=sh, position=len(self.blocks)
+        )
+        self.blocks.append(blk)
+        self.partial.clear()
+        return blk
+
+    def sequence_hashes(self) -> list[SequenceHash]:
+        return [b.sequence_hash for b in self.blocks]
+
+    def truncate_blocks(self, n_blocks: int) -> None:
+        """Drop blocks beyond ``n_blocks`` and any partial tail."""
+        del self.blocks[n_blocks:]
+        self.partial.clear()

@@ -1,0 +1,4 @@
+from dynamo_tpu.utils.config import RuntimeConfig
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+__all__ = ["RuntimeConfig", "configure_logging", "get_logger"]

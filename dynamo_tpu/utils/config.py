@@ -1,0 +1,103 @@
+"""Layered runtime configuration.
+
+Mirrors the reference's figment-layered ``RuntimeConfig``
+(reference: lib/runtime/src/config.rs) — values resolve, in order of
+precedence: explicit kwargs > ``DYN_*`` environment variables > config file
+(TOML-like JSON/YAML) > defaults.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+ENV_PREFIX = "DYN_"
+
+
+def _coerce(value: str, typ: type) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    return value
+
+
+@dataclass
+class RuntimeConfig:
+    """Process-level runtime settings (reference: lib/runtime/src/config.rs)."""
+
+    # Worker threads for the compute pool (reference: compute/pool.rs).
+    num_worker_threads: int = 0  # 0 = os.cpu_count()
+    # Coordination service address (our consolidated etcd/NATS equivalent).
+    coordinator_url: str = "tcp://127.0.0.1:6650"
+    # Namespace this process operates in.
+    namespace: str = "dynamo"
+    # System status server (health/metrics) — reference: system_status_server.rs.
+    system_enabled: bool = False
+    system_port: int = 0  # 0 = ephemeral
+    # Logging.
+    log_level: str = "info"
+    log_jsonl: bool = False
+    # Request plane.
+    request_timeout_s: float = 600.0
+    # Graceful shutdown drain deadline.
+    drain_timeout_s: float = 30.0
+
+    @classmethod
+    def from_settings(cls, path: str | os.PathLike | None = None, **overrides: Any) -> "RuntimeConfig":
+        """Build config from defaults < file < DYN_* env < explicit overrides."""
+        values: dict[str, Any] = {}
+        candidate = path or os.environ.get(ENV_PREFIX + "CONFIG")
+        if candidate and Path(candidate).exists():
+            text = Path(candidate).read_text()
+            try:
+                values.update(json.loads(text))
+            except json.JSONDecodeError:
+                try:
+                    import yaml
+
+                    values.update(yaml.safe_load(text) or {})
+                except Exception as exc:  # pragma: no cover - malformed config
+                    raise ValueError(f"could not parse config file {candidate}") from exc
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        for name, f in fields.items():
+            env_key = ENV_PREFIX + name.upper()
+            if env_key in os.environ:
+                values[name] = _coerce(os.environ[env_key], f.type if isinstance(f.type, type) else type(f.default))
+        values.update({k: v for k, v in overrides.items() if v is not None})
+        values = {k: v for k, v in values.items() if k in fields}
+        return cls(**values)
+
+
+@dataclass
+class EngineConfig:
+    """JAX engine settings (fills the role of vLLM EngineArgs in the reference;
+    reference pass-through: components/src/dynamo/vllm/args.py)."""
+
+    model: str = "tiny-llama"           # model name or local path
+    tokenizer: str | None = None          # defaults to model path
+    dtype: str = "bfloat16"
+    block_size: int = 16                  # KV cache tokens per block
+    num_blocks: int = 0                   # 0 = auto-size from HBM budget
+    max_batch_size: int = 64
+    max_model_len: int = 8192
+    max_tokens_per_step: int = 8192       # prefill token budget per step
+    prefill_chunk: int = 512              # chunked-prefill bucket
+    decode_bucket: tuple[int, ...] = (8, 16, 32, 64)
+    # Mesh axes sizes; 1 = unsharded. (data, model, expert, seq)
+    dp: int = 1
+    tp: int = 1
+    ep: int = 1
+    sp: int = 1
+    enable_prefix_caching: bool = True
+    kv_event_publishing: bool = True
+    seed: int = 0
+
+    def mesh_shape(self) -> dict[str, int]:
+        return {"data": self.dp, "model": self.tp, "expert": self.ep, "seq": self.sp}

@@ -1,0 +1,154 @@
+"""Minimal Prometheus-compatible metrics registry.
+
+Fills the role of the reference's hierarchical MetricsRegistry
+(reference: lib/runtime/src/metrics.rs, name constants in
+metrics/prometheus_names.rs): counters/gauges/histograms with labels,
+hierarchical auto-labels (namespace/component/endpoint), and text
+exposition for a /metrics endpoint. Dependency-free.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Iterable
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, const_labels: dict[str, str]):
+        self.name, self.help = name, help_
+        self.const = const_labels
+        self._values: dict[tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, value: float = 1.0, **labels: str) -> None:
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] += value
+
+    def get(self, **labels: str) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        if not self._values:
+            yield f"{self.name}{_fmt_labels(self.const)} 0"
+        for key, v in sorted(self._values.items()):
+            labels = {**self.const, **dict(key)}
+            yield f"{self.name}{_fmt_labels(labels)} {v}"
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] = value
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        if not self._values:
+            yield f"{self.name}{_fmt_labels(self.const)} 0"
+        for key, v in sorted(self._values.items()):
+            labels = {**self.const, **dict(key)}
+            yield f"{self.name}{_fmt_labels(labels)} {v}"
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, const_labels: dict[str, str],
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+        self.name, self.help = name, help_
+        self.const = const_labels
+        self.buckets = tuple(buckets) + (math.inf,)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sum: dict[tuple, float] = defaultdict(float)
+        self._n: dict[tuple, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+            self._sum[key] += value
+            self._n[key] += 1
+
+    def percentile(self, q: float, **labels: str) -> float:
+        """Approximate percentile from bucket counts (for planner/tests)."""
+        key = tuple(sorted(labels.items()))
+        counts = self._counts.get(key)
+        if not counts or self._n[key] == 0:
+            return 0.0
+        target = q * self._n[key]
+        for i, c in enumerate(counts):
+            if c >= target:
+                return self.buckets[i] if self.buckets[i] != math.inf else self.buckets[i - 1]
+        return self.buckets[-2]
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        for key in sorted(self._counts):
+            labels = {**self.const, **dict(key)}
+            for i, ub in enumerate(self.buckets):
+                lb = {**labels, "le": "+Inf" if ub == math.inf else repr(ub)}
+                yield f"{self.name}_bucket{_fmt_labels(lb)} {self._counts[key][i]}"
+            yield f"{self.name}_sum{_fmt_labels(labels)} {self._sum[key]}"
+            yield f"{self.name}_count{_fmt_labels(labels)} {self._n[key]}"
+
+
+@dataclass
+class MetricsRegistry:
+    """Hierarchical registry: child registries inherit const labels
+    (reference: drt→namespace→component→endpoint hierarchy)."""
+
+    prefix: str = "dynamo"
+    const_labels: dict[str, str] = field(default_factory=dict)
+    _metrics: dict[str, object] = field(default_factory=dict)
+    _children: list["MetricsRegistry"] = field(default_factory=list)
+
+    def child(self, **labels: str) -> "MetricsRegistry":
+        c = MetricsRegistry(prefix=self.prefix, const_labels={**self.const_labels, **labels})
+        self._children.append(c)
+        return c
+
+    def _full(self, name: str) -> str:
+        return f"{self.prefix}_{name}"
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        key = "c:" + name
+        if key not in self._metrics:
+            self._metrics[key] = Counter(self._full(name), help_, self.const_labels)
+        return self._metrics[key]  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        key = "g:" + name
+        if key not in self._metrics:
+            self._metrics[key] = Gauge(self._full(name), help_, self.const_labels)
+        return self._metrics[key]  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_: str = "", buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
+        key = "h:" + name
+        if key not in self._metrics:
+            self._metrics[key] = Histogram(self._full(name), help_, self.const_labels, buckets)
+        return self._metrics[key]  # type: ignore[return-value]
+
+    def expose(self) -> str:
+        lines: list[str] = []
+        for m in self._metrics.values():
+            lines.extend(m.expose())  # type: ignore[attr-defined]
+        for c in self._children:
+            lines.append(c.expose().rstrip("\n"))
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,40 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+All sharding/mesh tests run against 8 virtual CPU devices
+(xla_force_host_platform_device_count), mirroring how the reference tests
+its framework logic with zero GPUs (SURVEY.md §4: mocker-based e2e).
+This must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Keep the axon TPU tunnel plugin out of CPU test runs entirely: its PJRT
+# init dials the device relay even under JAX_PLATFORMS=cpu and can hang the
+# whole interpreter if the tunnel is busy/wedged.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DYN_LOG", "warning")
+
+# The axon plugin registers a backend factory at interpreter start (via
+# sitecustomize) before this conftest runs; drop it so jax never initializes
+# that backend during tests.
+try:  # pragma: no cover - environment-specific
+    from jax._src import xla_bridge as _xb
+
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name != "cpu":
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+
+    return jax.devices()

@@ -1,0 +1,71 @@
+"""Detokenizer backend / stop-jail tests.
+
+Reference test model: jail semantics per JAILED_STREAM_README and
+lib/llm tests for Backend (SURVEY.md §2.2 Backend row).
+"""
+
+from dynamo_tpu.backend import DetokenizerBackend
+from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput
+from dynamo_tpu.tokenizer import ByteTokenizer
+
+
+def feed_text(backend: DetokenizerBackend, tok: ByteTokenizer, text: str, finish=None):
+    """Feed text one token at a time; return list of emitted deltas."""
+    ids = tok.encode(text)
+    outs = []
+    for i, t in enumerate(ids):
+        fr = finish if i == len(ids) - 1 else None
+        outs.append(backend.step(LLMEngineOutput(token_ids=[t], finish_reason=fr)))
+    return outs
+
+
+def test_plain_stream_passthrough():
+    tok = ByteTokenizer()
+    b = DetokenizerBackend(tok)
+    outs = feed_text(b, tok, "hello world", finish=FinishReason.LENGTH)
+    assert "".join(o.text for o in outs) == "hello world"
+    assert outs[-1].finish_reason == FinishReason.LENGTH
+
+
+def test_stop_string_truncates():
+    tok = ByteTokenizer()
+    b = DetokenizerBackend(tok, stops=["STOP"])
+    outs = feed_text(b, tok, "abc STOP def")
+    full = "".join(o.text for o in outs)
+    assert full == "abc "
+    assert any(o.finish_reason == FinishReason.STOP for o in outs)
+
+
+def test_partial_stop_jailed_then_released():
+    tok = ByteTokenizer()
+    b = DetokenizerBackend(tok, stops=["STOP"])
+    # "ST" could begin "STOP" → jailed; "STale" resolves → all released
+    outs = feed_text(b, tok, "xSTale", finish=FinishReason.LENGTH)
+    emitted = "".join(o.text for o in outs)
+    assert emitted == "xSTale"
+    # while ambiguous, the 'ST' must NOT have been emitted yet
+    after_t = "".join(o.text for o in outs[:3])  # fed 'x','S','T'
+    assert "ST" not in after_t
+
+
+def test_stop_never_leaks_even_at_finish():
+    tok = ByteTokenizer()
+    b = DetokenizerBackend(tok, stops=["<END>"])
+    outs = feed_text(b, tok, "data<END>")
+    assert "".join(o.text for o in outs) == "data"
+    assert "<" not in "".join(o.text for o in outs)
+
+
+def test_finish_flushes_partial_jail():
+    tok = ByteTokenizer()
+    b = DetokenizerBackend(tok, stops=["STOP"])
+    # stream ends while 'ST' is jailed → must flush it (no stop hit)
+    outs = feed_text(b, tok, "xyST", finish=FinishReason.STOP)
+    assert "".join(o.text for o in outs) == "xyST"
+
+
+def test_multiple_stops_earliest_wins():
+    tok = ByteTokenizer()
+    b = DetokenizerBackend(tok, stops=["ZZZ", "B"])
+    outs = feed_text(b, tok, "aBcZZZ")
+    assert "".join(o.text for o in outs) == "a"

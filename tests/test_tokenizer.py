@@ -1,0 +1,55 @@
+"""Tokenizer + streaming-decode tests (reference model: lib/llm tokenizer tests)."""
+
+from dynamo_tpu.tokenizer import ByteTokenizer, DecodeStream, load_tokenizer
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    for text in ["hello world", "héllo — ünïcode ✓", "日本語テスト", ""]:
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_byte_tokenizer_specials():
+    tok = ByteTokenizer()
+    ids = tok.encode("hi", add_bos=True)
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == "hi"
+
+
+def test_decode_stream_ascii():
+    tok = ByteTokenizer()
+    stream = DecodeStream(tok)
+    text = "the quick brown fox"
+    out = "".join(stream.step(t) for t in tok.encode(text)) + stream.flush()
+    assert out == text
+
+
+def test_decode_stream_multibyte_never_splits():
+    tok = ByteTokenizer()
+    stream = DecodeStream(tok)
+    text = "héllo ✓ 日本"
+    pieces = [stream.step(t) for t in tok.encode(text)]
+    # no piece may contain a replacement char
+    assert all("�" not in p for p in pieces)
+    assert "".join(pieces) + stream.flush() == text
+
+
+def test_decode_stream_long_compaction():
+    tok = ByteTokenizer()
+    stream = DecodeStream(tok)
+    text = ("word " * 100).strip() + " ünïcode tail"
+    out = "".join(stream.step(t) for t in tok.encode(text)) + stream.flush()
+    assert out == text
+
+
+def test_chat_template():
+    tok = ByteTokenizer()
+    s = tok.apply_chat_template(
+        [{"role": "system", "content": "be brief"}, {"role": "user", "content": "hi"}]
+    )
+    assert "<|system|>" in s and "<|user|>" in s and s.endswith("<|assistant|>\n")
+
+
+def test_load_tokenizer_fallback():
+    tok = load_tokenizer("definitely-not-a-local-path")
+    assert isinstance(tok, ByteTokenizer)

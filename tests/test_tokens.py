@@ -1,0 +1,52 @@
+"""Tests for token block hashing (reference test model: lib/tokens unit tests)."""
+
+from dynamo_tpu.tokens import (
+    TokenBlockSequence,
+    compute_block_hash,
+    compute_block_hashes_for_tokens,
+    compute_seq_hashes,
+)
+
+
+def test_block_hash_deterministic():
+    a = compute_block_hash([1, 2, 3, 4])
+    b = compute_block_hash([1, 2, 3, 4])
+    assert a == b
+    assert a != compute_block_hash([1, 2, 3, 5])
+
+
+def test_seq_hash_chains_depend_on_prefix():
+    h1 = compute_block_hashes_for_tokens([1, 2, 3, 4, 5, 6, 7, 8], block_size=4)
+    h2 = compute_block_hashes_for_tokens([9, 2, 3, 4, 5, 6, 7, 8], block_size=4)
+    assert len(h1) == len(h2) == 2
+    # same second block contents, different prefix → different seq hash
+    assert h1[1] != h2[1]
+
+
+def test_partial_blocks_excluded():
+    h = compute_block_hashes_for_tokens([1, 2, 3, 4, 5], block_size=4)
+    assert len(h) == 1
+
+
+def test_token_block_sequence_incremental_matches_bulk():
+    toks = list(range(37))
+    seq = TokenBlockSequence(block_size=8)
+    for t in toks:
+        seq.append(t)
+    bulk = compute_block_hashes_for_tokens(toks, block_size=8)
+    assert seq.sequence_hashes() == bulk
+    assert len(seq) == 37
+    assert seq.tokens == toks
+    assert len(seq.blocks) == 4 and len(seq.partial) == 5
+
+
+def test_truncate():
+    seq = TokenBlockSequence.from_tokens(range(32), block_size=8)
+    seq.truncate_blocks(2)
+    assert len(seq) == 16
+    assert seq.sequence_hashes() == compute_block_hashes_for_tokens(list(range(16)), 8)
+
+
+def test_seq_hash_first_block_equals_block_hash():
+    bh = compute_block_hash([5, 6, 7, 8])
+    assert compute_seq_hashes([bh])[0] == bh
