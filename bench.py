@@ -1,0 +1,114 @@
+"""Benchmark: decode throughput of the JAX engine on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tok/s/chip", "vs_baseline": N}
+
+Workload: llama-3-8b-lite (real llama-3-8b layer shapes, 8 layers), batch 32,
+prompt 128, 64 greedy decode tokens each, prefix caching off. Throughput is
+measured over decode steps after the first (compile excluded).
+
+``vs_baseline`` is the fraction of the chip's HBM-bandwidth roofline for
+batched decode (reading every param byte once per step):
+    roofline tok/s = batch * HBM_BW / param_bytes
+(v5e: 819 GB/s). The reference publishes no absolute tok/s (BASELINE.md), so
+the roofline is the honest fixed yardstick; 1.0 = bandwidth-bound perfection.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+MODEL = os.environ.get("DYN_BENCH_MODEL", "llama-3-8b-lite")
+BATCH = int(os.environ.get("DYN_BENCH_BATCH", "32"))
+PROMPT_LEN = int(os.environ.get("DYN_BENCH_PROMPT", "128"))
+DECODE_TOKENS = int(os.environ.get("DYN_BENCH_DECODE", "64"))
+HBM_BW = {"tpu v5": 819e9, "tpu v4": 1228e9, "cpu": 50e9}
+
+
+def probe_devices() -> bool:
+    """Check jax device init in a subprocess so a wedged TPU tunnel can't
+    hang the bench itself."""
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, timeout=120, text=True
+        )
+        return out.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_bench() -> dict:
+    import jax
+
+    from dynamo_tpu.engine.engine import EngineCore
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.utils.config import EngineConfig
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "cpu").lower()
+
+    core = EngineCore(EngineConfig(
+        model=MODEL,
+        block_size=16,
+        num_blocks=BATCH * ((PROMPT_LEN + DECODE_TOKENS) // 16 + 2) + 1,
+        max_batch_size=BATCH,
+        max_model_len=PROMPT_LEN + DECODE_TOKENS + 16,
+        prefill_chunk=PROMPT_LEN,
+        decode_bucket=(BATCH,),
+        enable_prefix_caching=False,
+    ))
+    for i in range(BATCH):
+        toks = [(7 * i + 11 * j) % 32000 + 5 for j in range(PROMPT_LEN)]
+        core.add_request(PreprocessedRequest(
+            token_ids=toks,
+            stop_conditions=StopConditions(max_tokens=DECODE_TOKENS, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+        ))
+
+    # prefill + first decode step (includes both compiles)
+    while core.metrics.num_decode_tokens == 0 and core.has_work():
+        core.step()
+    base_tokens = core.metrics.num_decode_tokens
+    t0 = time.perf_counter()
+    while core.has_work():
+        core.step()
+    dt = time.perf_counter() - t0
+    measured = core.metrics.num_decode_tokens - base_tokens
+    tok_s = measured / dt if dt > 0 else 0.0
+
+    # roofline
+    param_count = sum(x.size for x in jax.tree.leaves(core.runner.params))
+    param_bytes = param_count * 2  # bf16
+    bw = next((v for k, v in HBM_BW.items() if k in kind), HBM_BW["cpu"])
+    roofline = BATCH * bw / param_bytes
+    return {
+        "metric": f"decode_throughput_{MODEL.replace('-', '_')}_bs{BATCH}",
+        "value": round(tok_s, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s / roofline, 4),
+    }
+
+
+def main() -> None:
+    if not probe_devices():
+        print(json.dumps({
+            "metric": f"decode_throughput_{MODEL.replace('-', '_')}_bs{BATCH}",
+            "value": 0,
+            "unit": "tok/s/chip",
+            "vs_baseline": 0.0,
+        }))
+        return
+    print(json.dumps(run_bench()))
+
+
+if __name__ == "__main__":
+    main()

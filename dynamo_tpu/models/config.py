@@ -109,6 +109,21 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         max_position_embeddings=8192,
         tie_word_embeddings=False,
     ),
+    # 8-layer cut of llama-3-8b: real layer shapes, fits one v5e chip with
+    # ample KV cache headroom — used by bench.py and the compile-check entry.
+    "llama-3-8b-lite": ModelConfig(
+        name="llama-3-8b-lite",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=8,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=500000.0,
+        max_position_embeddings=8192,
+        tie_word_embeddings=True,
+    ),
     "llama-3-70b": ModelConfig(
         name="llama-3-70b",
         vocab_size=128256,

@@ -83,5 +83,21 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def shard_params(params, logical_axes, mesh: Mesh):
+    """Place a params pytree on the mesh per its logical-axis annotations.
+
+    ``logical_axes`` mirrors the params tree with tuples of logical axis
+    names (models.llama.param_logical_axes). GSPMD then propagates these
+    shardings through the jitted step and inserts the TP/EP collectives.
+    """
+    import jax
+
+    def place(leaf, axes):
+        return jax.device_put(leaf, param_sharding_rules(mesh, axes))
+
+    return jax.tree.map(place, params, logical_axes, is_leaf=lambda x: isinstance(x, tuple) and all(
+        isinstance(a, (str, type(None))) for a in x))
+
+
 def single_device_mesh() -> Mesh:
     return make_mesh(MeshConfig(), devices=jax.devices()[:1])
