@@ -1,0 +1,12 @@
+from dynamo_tpu.runtime.runtime import DistributedRuntime, Endpoint, Component, Namespace
+from dynamo_tpu.runtime.client import EndpointClient, PushRouter, RouterMode
+
+__all__ = [
+    "DistributedRuntime",
+    "Namespace",
+    "Component",
+    "Endpoint",
+    "EndpointClient",
+    "PushRouter",
+    "RouterMode",
+]

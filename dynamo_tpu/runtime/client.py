@@ -1,0 +1,187 @@
+"""Endpoint client: discovery-watching instance source + push router.
+
+Fills the role of the reference's Client/InstanceSource + PushRouter
+(reference: lib/runtime/src/component/client.rs InstanceSource;
+pipeline/network/egress/push_router.rs Random/RoundRobin/Direct/KV modes
+with busy-threshold): a prefix watch keeps the live instance set current
+(lease expiry ⇒ DELETE event ⇒ instance drops out), and ``generate`` opens
+a response stream over a pooled duplex connection straight to the chosen
+worker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import random
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.protocols import EndpointId, Instance
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.transports.wire import Frame, MsgpackConnection
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("runtime.client")
+
+
+class RouterMode(str, Enum):
+    RANDOM = "random"
+    ROUND_ROBIN = "round_robin"
+    DIRECT = "direct"
+    KV = "kv"
+
+
+class NoInstancesError(RuntimeError):
+    pass
+
+
+class StreamError(RuntimeError):
+    pass
+
+
+class _WorkerConnection:
+    """Multiplexed duplex connection to one worker address."""
+
+    def __init__(self, conn: MsgpackConnection):
+        self.conn = conn
+        self._ids = itertools.count(1)
+        self._streams: dict[int, asyncio.Queue] = {}
+        self._reader = asyncio.create_task(self._read_loop())
+        self.alive = True
+
+    async def _read_loop(self) -> None:
+        while True:
+            msg = await self.conn.recv()
+            if msg is None:
+                break
+            q = self._streams.get(msg.get("stream_id"))
+            if q is not None:
+                q.put_nowait(msg)
+        self.alive = False
+        for q in self._streams.values():
+            q.put_nowait({"t": Frame.ERR, "error": "connection lost"})
+
+    async def call(self, endpoint: str, payload: Any, request_id: str,
+                   headers: dict | None = None) -> AsyncIterator[Any]:
+        sid = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[sid] = q
+        await self.conn.send({
+            "t": Frame.CALL, "stream_id": sid, "endpoint": endpoint,
+            "request_id": request_id, "payload": payload, "headers": headers or {},
+        })
+        try:
+            while True:
+                msg = await q.get()
+                t = msg.get("t")
+                if t == Frame.DATA:
+                    yield msg.get("payload")
+                elif t == Frame.END:
+                    return
+                elif t == Frame.ERR:
+                    raise StreamError(msg.get("error", "stream error"))
+        finally:
+            self._streams.pop(sid, None)
+            if self.alive:
+                try:
+                    await self.conn.send({"t": Frame.CANCEL, "stream_id": sid})
+                except Exception:
+                    pass
+
+    def close(self) -> None:
+        self._reader.cancel()
+        self.conn.close()
+
+
+class EndpointClient:
+    """Watches instances of one endpoint and routes requests to them."""
+
+    def __init__(self, runtime: DistributedRuntime, endpoint: EndpointId):
+        self.runtime = runtime
+        self.endpoint = endpoint
+        self.instances: dict[int, Instance] = {}
+        self._conns: dict[str, _WorkerConnection] = {}
+        self._watch_task: asyncio.Task | None = None
+        self._rr = itertools.count()
+        self._ready = asyncio.Event()
+
+    @classmethod
+    async def create(cls, runtime: DistributedRuntime, endpoint: EndpointId) -> "EndpointClient":
+        self = cls(runtime, endpoint)
+        assert runtime.client is not None
+        watch = await runtime.client.watch_prefix(endpoint.instance_prefix)
+        self._watch_task = asyncio.create_task(self._watch_loop(watch))
+        return self
+
+    async def _watch_loop(self, watch) -> None:
+        async for ev in watch:
+            if ev.op == "put" and ev.value:
+                inst = Instance.from_bytes(ev.value)
+                self.instances[inst.instance_id] = inst
+                self._ready.set()
+            elif ev.op == "delete":
+                iid = int(ev.key.rsplit("/", 1)[-1], 16)
+                inst = self.instances.pop(iid, None)
+                if inst is not None:
+                    log.info("instance %x of %s vanished", iid, self.endpoint)
+            if not self.instances:
+                self._ready.clear()
+
+    async def wait_for_instances(self, timeout: float = 10.0) -> None:
+        await asyncio.wait_for(self._ready.wait(), timeout)
+
+    def instance_ids(self) -> list[int]:
+        return sorted(self.instances)
+
+    # ------------------------------------------------------------------
+    async def _connect(self, inst: Instance) -> _WorkerConnection:
+        wc = self._conns.get(inst.address)
+        if wc is not None and wc.alive:
+            return wc
+        host, _, port = inst.address.rpartition(":")
+        wc = _WorkerConnection(await MsgpackConnection.connect(host, int(port)))
+        self._conns[inst.address] = wc
+        return wc
+
+    async def generate_direct(self, payload: Any, instance_id: int,
+                              request_id: str | None = None) -> AsyncIterator[Any]:
+        inst = self.instances.get(instance_id)
+        if inst is None:
+            raise NoInstancesError(f"instance {instance_id:x} not found for {self.endpoint}")
+        wc = await self._connect(inst)
+        target = f"{self.endpoint.namespace}.{self.endpoint.component}.{self.endpoint.endpoint}"
+        async for item in wc.call(target, payload, request_id or uuid.uuid4().hex):
+            yield item
+
+    async def close(self) -> None:
+        if self._watch_task:
+            self._watch_task.cancel()
+        for wc in self._conns.values():
+            wc.close()
+
+
+@dataclass
+class PushRouter:
+    """Instance selection policies over an EndpointClient
+    (reference: push_router.rs RouterMode + busy-threshold fallback)."""
+
+    client: EndpointClient
+    mode: RouterMode = RouterMode.ROUND_ROBIN
+    # KV mode is provided by dynamo_tpu.router.KvPushRouter (subclass wiring)
+
+    def _pick(self) -> int:
+        ids = self.client.instance_ids()
+        if not ids:
+            raise NoInstancesError(f"no instances for {self.client.endpoint}")
+        if self.mode is RouterMode.RANDOM:
+            return random.choice(ids)
+        return ids[next(self.client._rr) % len(ids)]
+
+    async def generate(self, payload: Any, request_id: str | None = None,
+                       instance_id: int | None = None) -> AsyncIterator[Any]:
+        iid = instance_id if instance_id is not None else self._pick()
+        async for item in self.client.generate_direct(payload, iid, request_id):
+            yield item

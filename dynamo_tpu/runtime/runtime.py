@@ -1,0 +1,224 @@
+"""DistributedRuntime: process node handle + component model + endpoint serving.
+
+Fills the role of the reference's runtime core
+(reference: lib/runtime/src/lib.rs DistributedRuntime, component.rs
+Namespace→Component→Endpoint, component/endpoint.rs serve_endpoint,
+ingress/push_endpoint.rs):
+
+- One coordinator connection, one primary lease (liveness: lease drop ⇒
+  instances vanish ⇒ clients re-route), one data-plane TCP server per
+  process serving all endpoints.
+- ``serve_endpoint(handler)`` registers the instance in the coordinator KV
+  and dispatches incoming CALL frames to the handler — an async generator
+  ``handler(request: dict, ctx) -> yields response dicts`` streamed back as
+  DATA/END/ERR frames. Cancellation arrives as a CANCEL frame and cancels
+  the handler task (graceful drain on shutdown).
+
+Unlike the reference there is no broker hop: callers dial the instance's
+advertised address directly (the reference's NATS-push + TCP-callback pair
+collapses into one duplex connection — fewer hops, same semantics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import socket
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable
+
+from dynamo_tpu.transports.client import CoordinatorClient, Lease
+from dynamo_tpu.transports.wire import Frame, MsgpackConnection
+from dynamo_tpu.runtime.protocols import EndpointId, Instance
+from dynamo_tpu.utils.config import RuntimeConfig
+from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.metrics import MetricsRegistry
+
+log = get_logger("runtime")
+
+# handler(request, context) -> async iterator of response payloads
+Handler = Callable[[dict, "RequestContext"], AsyncIterator[Any]]
+
+
+@dataclass
+class RequestContext:
+    """Per-request context (reference: pipeline/context.rs Context)."""
+
+    request_id: str
+    endpoint: str
+    cancelled: asyncio.Event = field(default_factory=asyncio.Event)
+    trace_headers: dict[str, str] = field(default_factory=dict)
+
+    def is_cancelled(self) -> bool:
+        return self.cancelled.is_set()
+
+
+@dataclass
+class _Served:
+    endpoint: EndpointId
+    handler: Handler
+    instance: Instance
+
+
+class DistributedRuntime:
+    """Node handle: coordinator client + lease + data-plane server."""
+
+    def __init__(self, config: RuntimeConfig | None = None):
+        self.config = config or RuntimeConfig.from_settings()
+        self.client: CoordinatorClient | None = None
+        self.primary_lease: Lease | None = None
+        self.instance_id: int = (int(time.time() * 1000) << 16) | (os.getpid() & 0xFFFF)
+        self.metrics = MetricsRegistry()
+        self._served: dict[str, _Served] = {}   # "ns.comp.ep" -> served
+        self._server: asyncio.Server | None = None
+        self._advertise_host = "127.0.0.1"
+        self.data_port: int = 0
+        self._inflight = self.metrics.gauge("runtime_inflight_requests", "in-flight handler streams")
+        self._tasks: set[asyncio.Task] = set()
+        self._draining = False
+
+    # ------------------------------------------------------------------
+    @classmethod
+    async def create(cls, config: RuntimeConfig | None = None) -> "DistributedRuntime":
+        rt = cls(config)
+        rt.client = await CoordinatorClient.connect(rt.config.coordinator_url)
+        rt.primary_lease = await rt.client.lease_grant(ttl=3.0)
+        rt._server = await asyncio.start_server(rt._on_conn, "0.0.0.0", 0)
+        rt.data_port = rt._server.sockets[0].getsockname()[1]
+        rt._advertise_host = os.environ.get("DYN_ADVERTISE_HOST", "127.0.0.1")
+        return rt
+
+    async def shutdown(self) -> None:
+        """Graceful: deregister instances, drain in-flight, drop lease."""
+        self._draining = True
+        if self.client:
+            for served in self._served.values():
+                await self.client.delete(
+                    served.endpoint.instance_key(self.instance_id))
+        deadline = time.monotonic() + self.config.drain_timeout_s
+        while self._tasks and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        for t in self._tasks:
+            t.cancel()
+        if self.primary_lease and self.client:
+            await self.primary_lease.revoke(self.client)
+        if self._server:
+            self._server.close()
+        if self.client:
+            await self.client.close()
+
+    def namespace(self, name: str) -> "Namespace":
+        return Namespace(self, name)
+
+    # ------------------------------------------------------------------
+    async def _register(self, endpoint: EndpointId, handler: Handler) -> Instance:
+        assert self.client and self.primary_lease
+        inst = Instance(
+            endpoint=endpoint,
+            instance_id=self.instance_id,
+            address=f"{self._advertise_host}:{self.data_port}",
+            lease_id=self.primary_lease.id,
+        )
+        key = str(endpoint)[len("dyn://"):]
+        self._served[key] = _Served(endpoint=endpoint, handler=handler, instance=inst)
+        await self.client.put(
+            endpoint.instance_key(self.instance_id), inst.to_bytes(),
+            lease_id=self.primary_lease.id)
+        log.info("serving %s instance=%x at %s", endpoint, self.instance_id, inst.address)
+        return inst
+
+    # ------------------------------------------------------------------
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        conn = MsgpackConnection(reader, writer)
+        streams: dict[int, asyncio.Task] = {}
+        try:
+            while True:
+                msg = await conn.recv()
+                if msg is None:
+                    break
+                t = msg.get("t")
+                if t == Frame.PING:
+                    await conn.send({"t": Frame.PONG})
+                elif t == Frame.CALL:
+                    sid = msg["stream_id"]
+                    task = asyncio.create_task(self._run_stream(conn, sid, msg))
+                    streams[sid] = task
+                    self._tasks.add(task)
+                    task.add_done_callback(
+                        lambda t_, sid=sid: (self._tasks.discard(t_), streams.pop(sid, None)))
+                elif t == Frame.CANCEL:
+                    task = streams.get(msg.get("stream_id"))
+                    if task:
+                        task.cancel()
+        finally:
+            for task in streams.values():
+                task.cancel()
+            conn.close()
+
+    async def _run_stream(self, conn: MsgpackConnection, sid: int, msg: dict) -> None:
+        target = msg.get("endpoint", "")
+        served = self._served.get(target)
+        if served is None or self._draining:
+            await conn.send({"t": Frame.ERR, "stream_id": sid,
+                             "error": f"no such endpoint {target!r}"})
+            return
+        ctx = RequestContext(
+            request_id=msg.get("request_id", ""),
+            endpoint=target,
+            trace_headers=msg.get("headers") or {},
+        )
+        self._inflight.inc(endpoint=target)
+        try:
+            async for item in served.handler(msg.get("payload"), ctx):
+                await conn.send({"t": Frame.DATA, "stream_id": sid, "payload": item})
+            await conn.send({"t": Frame.END, "stream_id": sid})
+        except asyncio.CancelledError:
+            ctx.cancelled.set()
+            try:
+                await conn.send({"t": Frame.END, "stream_id": sid, "cancelled": True})
+            except Exception:
+                pass
+        except Exception as exc:
+            log.exception("handler error endpoint=%s", target)
+            try:
+                await conn.send({"t": Frame.ERR, "stream_id": sid, "error": str(exc)})
+            except Exception:
+                pass
+        finally:
+            self._inflight.inc(-1, endpoint=target)
+
+
+@dataclass
+class Namespace:
+    runtime: DistributedRuntime
+    name: str
+
+    def component(self, name: str) -> "Component":
+        return Component(self.runtime, self.name, name)
+
+
+@dataclass
+class Component:
+    runtime: DistributedRuntime
+    namespace: str
+    name: str
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self.runtime, EndpointId(self.namespace, self.name, name))
+
+
+@dataclass
+class Endpoint:
+    runtime: DistributedRuntime
+    id: EndpointId
+
+    async def serve(self, handler: Handler) -> Instance:
+        """Register and serve this endpoint (reference: serve_endpoint)."""
+        return await self.runtime._register(self.id, handler)
+
+    async def client(self) -> "EndpointClient":
+        from dynamo_tpu.runtime.client import EndpointClient
+
+        return await EndpointClient.create(self.runtime, self.id)

@@ -1,0 +1,5 @@
+from dynamo_tpu.transports.wire import Frame, MsgpackConnection
+from dynamo_tpu.transports.coordinator import CoordinatorServer
+from dynamo_tpu.transports.client import CoordinatorClient
+
+__all__ = ["Frame", "MsgpackConnection", "CoordinatorServer", "CoordinatorClient"]

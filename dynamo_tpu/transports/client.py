@@ -1,0 +1,245 @@
+"""Async client for the coordinator service.
+
+Fills the role of the reference's etcd + NATS client wrappers
+(reference: lib/runtime/src/transports/{etcd,nats}.rs): KV with leases and
+auto keep-alive, prefix watches with callback or queue delivery, pub/sub,
+and shared work queues — over one multiplexed connection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+from dynamo_tpu.transports.wire import Frame, MsgpackConnection
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("coordinator.client")
+
+
+def parse_url(url: str) -> tuple[str, int]:
+    url = url.removeprefix("tcp://")
+    host, _, port = url.partition(":")
+    return host or "127.0.0.1", int(port or 6650)
+
+
+class CoordinatorError(RuntimeError):
+    pass
+
+
+@dataclass
+class WatchEvent:
+    op: str            # "put" | "delete"
+    key: str
+    value: bytes | None = None
+    initial: bool = False
+
+
+class Watch:
+    """A prefix watch delivering events through an async queue."""
+
+    def __init__(self, client: "CoordinatorClient", watch_id: int):
+        self._client = client
+        self.watch_id = watch_id
+        self.queue: asyncio.Queue[WatchEvent] = asyncio.Queue()
+
+    def __aiter__(self) -> AsyncIterator[WatchEvent]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[WatchEvent]:
+        while True:
+            yield await self.queue.get()
+
+    async def cancel(self) -> None:
+        await self._client._request({"op": "unwatch", "watch_id": self.watch_id})
+        self._client._watches.pop(self.watch_id, None)
+
+
+class Subscription:
+    def __init__(self, client: "CoordinatorClient", sub_id: int):
+        self._client = client
+        self.sub_id = sub_id
+        self.queue: asyncio.Queue[tuple[str, bytes]] = asyncio.Queue()
+
+    def __aiter__(self):
+        return self._iter()
+
+    async def _iter(self):
+        while True:
+            yield await self.queue.get()
+
+    async def cancel(self) -> None:
+        await self._client._request({"op": "unsubscribe", "sub_id": self.sub_id})
+        self._client._subs.pop(self.sub_id, None)
+
+
+@dataclass
+class Lease:
+    """A lease with background keep-alive (reference: etcd.rs Lease)."""
+
+    id: int
+    ttl: float
+    _task: asyncio.Task | None = None
+
+    async def revoke(self, client: "CoordinatorClient") -> None:
+        if self._task:
+            self._task.cancel()
+        await client._request({"op": "lease_revoke", "lease_id": self.id})
+
+
+class CoordinatorClient:
+    def __init__(self, url: str):
+        self.url = url
+        self._conn: MsgpackConnection | None = None
+        self._ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._watches: dict[int, Watch] = {}
+        self._subs: dict[int, Subscription] = {}
+        self._reader_task: asyncio.Task | None = None
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    @classmethod
+    async def connect(cls, url: str, retries: int = 30, delay: float = 0.2) -> "CoordinatorClient":
+        client = cls(url)
+        host, port = parse_url(url)
+        last: Exception | None = None
+        for _ in range(retries):
+            try:
+                client._conn = await MsgpackConnection.connect(host, port)
+                break
+            except OSError as exc:
+                last = exc
+                await asyncio.sleep(delay)
+        else:
+            raise CoordinatorError(f"cannot reach coordinator at {url}: {last}")
+        client._reader_task = asyncio.create_task(client._read_loop())
+        return client
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._conn:
+            self._conn.close()
+
+    # ------------------------------------------------------------------
+    async def _read_loop(self) -> None:
+        assert self._conn is not None
+        while True:
+            msg = await self._conn.recv()
+            if msg is None:
+                if not self._closed:
+                    log.warning("coordinator connection lost")
+                    for fut in self._pending.values():
+                        if not fut.done():
+                            fut.set_exception(CoordinatorError("connection lost"))
+                return
+            t = msg.get("t")
+            if t == Frame.RESPONSE:
+                fut = self._pending.pop(msg.get("id"), None)
+                if fut and not fut.done():
+                    fut.set_result(msg)
+            elif t == Frame.WATCH_EVENT:
+                # initial replay events can arrive before watch_prefix() sees
+                # the response — create the Watch on demand
+                wid = msg.get("watch_id")
+                w = self._watches.get(wid)
+                if w is None:
+                    w = self._watches[wid] = Watch(self, wid)
+                w.queue.put_nowait(WatchEvent(
+                    op=msg["op"], key=msg["key"], value=msg.get("value"),
+                    initial=bool(msg.get("initial"))))
+            elif t == Frame.PUBSUB_MSG:
+                sid = msg.get("sub_id")
+                s = self._subs.get(sid)
+                if s is None:
+                    s = self._subs[sid] = Subscription(self, sid)
+                s.queue.put_nowait((msg["subject"], msg["payload"]))
+
+    async def _request(self, body: dict) -> dict:
+        assert self._conn is not None, "not connected"
+        rid = next(self._ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        await self._conn.send({"t": Frame.REQUEST, "id": rid, **body})
+        resp = await fut
+        if not resp.get("ok"):
+            raise CoordinatorError(resp.get("error", "unknown error"))
+        return resp
+
+    # -- kv ----------------------------------------------------------------
+    async def put(self, key: str, value: bytes, lease_id: int = 0) -> None:
+        await self._request({"op": "put", "key": key, "value": value, "lease_id": lease_id})
+
+    async def create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        resp = await self._request(
+            {"op": "create", "key": key, "value": value, "lease_id": lease_id})
+        return bool(resp.get("created"))
+
+    async def get(self, key: str) -> bytes | None:
+        return (await self._request({"op": "get", "key": key})).get("value")
+
+    async def get_prefix(self, prefix: str) -> dict[str, bytes]:
+        return (await self._request({"op": "get_prefix", "prefix": prefix})).get("items", {})
+
+    async def delete(self, key: str) -> bool:
+        return bool((await self._request({"op": "delete", "key": key})).get("deleted"))
+
+    async def watch_prefix(self, prefix: str) -> Watch:
+        resp = await self._request({"op": "watch", "prefix": prefix, "watch_id": 0})
+        # events for this watch may already be queued in _read_loop order;
+        # register before returning (watch_id assigned server-side)
+        wid = resp["watch_id"]
+        w = self._watches.get(wid)
+        if w is None:
+            w = Watch(self, wid)
+            self._watches[wid] = w
+        return w
+
+    # -- leases ------------------------------------------------------------
+    async def lease_grant(self, ttl: float = 5.0, keepalive: bool = True) -> Lease:
+        resp = await self._request({"op": "lease_grant", "ttl": ttl})
+        lease = Lease(id=resp["lease_id"], ttl=ttl)
+        if keepalive:
+            lease._task = asyncio.create_task(self._keepalive_loop(lease))
+        return lease
+
+    async def _keepalive_loop(self, lease: Lease) -> None:
+        interval = max(lease.ttl / 3.0, 0.1)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                ok = (await self._request(
+                    {"op": "lease_keepalive", "lease_id": lease.id})).get("alive")
+                if not ok:
+                    log.warning("lease %d no longer alive", lease.id)
+                    return
+            except CoordinatorError:
+                return
+
+    # -- pubsub ------------------------------------------------------------
+    async def subscribe(self, subject: str) -> Subscription:
+        resp = await self._request({"op": "subscribe", "subject": subject, "sub_id": 0})
+        sid = resp["sub_id"]
+        s = self._subs.get(sid)
+        if s is None:
+            s = Subscription(self, sid)
+            self._subs[sid] = s
+        return s
+
+    async def publish(self, subject: str, payload: bytes) -> int:
+        resp = await self._request({"op": "publish", "subject": subject, "payload": payload})
+        return resp.get("receivers", 0)
+
+    # -- queues ------------------------------------------------------------
+    async def queue_push(self, name: str, item: bytes) -> None:
+        await self._request({"op": "queue_push", "name": name, "item": item})
+
+    async def queue_pop(self, name: str) -> bytes | None:
+        return (await self._request({"op": "queue_pop", "name": name})).get("item")
+
+    async def queue_len(self, name: str) -> int:
+        return (await self._request({"op": "queue_len", "name": name})).get("len", 0)

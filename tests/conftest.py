@@ -30,7 +30,25 @@ try:  # pragma: no cover - environment-specific
 except Exception:
     pass
 
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
 import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal async test support (pytest-asyncio is not in the image):
+    coroutine test functions run under asyncio.run."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {name: pyfuncitem.funcargs[name] for name in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: async test (handled by conftest)")
 
 
 @pytest.fixture(scope="session")

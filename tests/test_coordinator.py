@@ -1,0 +1,111 @@
+"""Coordinator service tests (reference test model: etcd/NATS transport
+tests in lib/runtime; lease-liveness semantics of component.rs)."""
+
+import asyncio
+import contextlib
+
+import pytest
+
+from dynamo_tpu.transports.client import CoordinatorClient
+from dynamo_tpu.transports.coordinator import CoordinatorServer
+
+pytestmark = pytest.mark.asyncio
+
+
+@contextlib.asynccontextmanager
+async def coord_pair():
+    server = CoordinatorServer()
+    await server.start()
+    client = await CoordinatorClient.connect(server.url)
+    try:
+        yield server, client
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_kv_roundtrip():
+  async with coord_pair() as (_, c):
+    await c.put("a/b", b"v1")
+    assert await c.get("a/b") == b"v1"
+    assert await c.get("a/missing") is None
+    await c.put("a/c", b"v2")
+    items = await c.get_prefix("a/")
+    assert items == {"a/b": b"v1", "a/c": b"v2"}
+    assert await c.delete("a/b") is True
+    assert await c.delete("a/b") is False
+
+
+async def test_create_or_validate():
+  async with coord_pair() as (_, c):
+    assert await c.create("lock/x", b"me") is True
+    assert await c.create("lock/x", b"other") is False
+
+
+async def test_watch_sees_put_and_delete():
+  async with coord_pair() as (_, c):
+    await c.put("w/pre", b"existing")
+    watch = await c.watch_prefix("w/")
+    ev = await asyncio.wait_for(watch.queue.get(), 2)
+    assert ev.op == "put" and ev.key == "w/pre" and ev.initial
+
+    await c.put("w/new", b"x")
+    ev = await asyncio.wait_for(watch.queue.get(), 2)
+    assert ev.op == "put" and ev.key == "w/new" and not ev.initial
+
+    await c.delete("w/new")
+    ev = await asyncio.wait_for(watch.queue.get(), 2)
+    assert ev.op == "delete" and ev.key == "w/new"
+
+
+async def test_lease_expiry_deletes_keys_and_notifies():
+  async with coord_pair() as (server, c):
+    lease = await c.lease_grant(ttl=0.5, keepalive=False)
+    await c.put("inst/1", b"alive", lease_id=lease.id)
+    watch = await c.watch_prefix("inst/")
+    ev = await asyncio.wait_for(watch.queue.get(), 2)
+    assert ev.op == "put" and ev.initial
+    # no keepalive → expires
+    ev = await asyncio.wait_for(watch.queue.get(), 3)
+    assert ev.op == "delete" and ev.key == "inst/1"
+    assert await c.get("inst/1") is None
+
+
+async def test_lease_keepalive_keeps_key():
+  async with coord_pair() as (_, c):
+    lease = await c.lease_grant(ttl=0.6, keepalive=True)
+    await c.put("ka/1", b"x", lease_id=lease.id)
+    await asyncio.sleep(1.5)  # several ttl periods
+    assert await c.get("ka/1") == b"x"
+    await lease.revoke(c)
+    await asyncio.sleep(0.1)
+    assert await c.get("ka/1") is None
+
+
+async def test_pubsub_fanout_and_wildcard():
+  async with coord_pair() as (server, c):
+    c2 = await CoordinatorClient.connect(server.url)
+    try:
+        s1 = await c.subscribe("events.kv.*")
+        s2 = await c2.subscribe("events.kv.worker1")
+        n = await c.publish("events.kv.worker1", b"payload")
+        assert n == 2
+        subj, data = await asyncio.wait_for(s1.queue.get(), 2)
+        assert subj == "events.kv.worker1" and data == b"payload"
+        subj, data = await asyncio.wait_for(s2.queue.get(), 2)
+        assert data == b"payload"
+        # non-matching subject
+        await c.publish("events.load.worker1", b"nope")
+        assert s2.queue.empty()
+    finally:
+        await c2.close()
+
+
+async def test_work_queue():
+  async with coord_pair() as (_, c):
+    await c.queue_push("prefill", b"req1")
+    await c.queue_push("prefill", b"req2")
+    assert await c.queue_len("prefill") == 2
+    assert await c.queue_pop("prefill") == b"req1"
+    assert await c.queue_pop("prefill") == b"req2"
+    assert await c.queue_pop("prefill") is None

@@ -1,0 +1,213 @@
+"""Distributed runtime tests: endpoint serving, routing, streams, failover.
+
+Reference test model: lib/runtime pipeline + network tests (SURVEY.md §4
+runtime integration row) — here over the consolidated coordinator.
+"""
+
+import asyncio
+import contextlib
+
+import pytest
+
+from dynamo_tpu.runtime.client import EndpointClient, NoInstancesError, PushRouter, RouterMode, StreamError
+from dynamo_tpu.runtime.protocols import EndpointId
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.transports.coordinator import CoordinatorServer
+from dynamo_tpu.utils.config import RuntimeConfig
+
+pytestmark = pytest.mark.asyncio
+
+
+@contextlib.asynccontextmanager
+async def cluster(n_workers: int = 1, handler_factory=None):
+    """Coordinator + n worker runtimes serving ns.backend.generate."""
+    server = CoordinatorServer()
+    await server.start()
+    cfg = RuntimeConfig(coordinator_url=server.url)
+    runtimes = []
+
+    def default_factory(i):
+        async def handler(payload, ctx):
+            for tok in range(3):
+                yield {"worker": i, "tok": tok, "echo": payload}
+        return handler
+
+    handler_factory = handler_factory or default_factory
+    for i in range(n_workers):
+        rt = await DistributedRuntime.create(cfg)
+        ep = rt.namespace("ns").component("backend").endpoint("generate")
+        await ep.serve(handler_factory(i))
+        runtimes.append(rt)
+    try:
+        yield server, cfg, runtimes
+    finally:
+        for rt in runtimes:
+            with contextlib.suppress(Exception):
+                await rt.shutdown()
+        await server.stop()
+
+
+async def make_client(cfg) -> tuple[DistributedRuntime, EndpointClient]:
+    rt = await DistributedRuntime.create(cfg)
+    client = await EndpointClient.create(rt, EndpointId("ns", "backend", "generate"))
+    await client.wait_for_instances()
+    return rt, client
+
+
+async def test_endpoint_stream_roundtrip():
+    async with cluster(1) as (_, cfg, _rts):
+        rt, client = await make_client(cfg)
+        try:
+            router = PushRouter(client=client, mode=RouterMode.ROUND_ROBIN)
+            items = [x async for x in router.generate({"prompt": "hello"})]
+            assert len(items) == 3
+            assert items[0]["echo"] == {"prompt": "hello"}
+            assert [x["tok"] for x in items] == [0, 1, 2]
+        finally:
+            await client.close()
+            await rt.shutdown()
+
+
+async def test_round_robin_spreads_load():
+    async with cluster(3) as (_, cfg, _rts):
+        rt, client = await make_client(cfg)
+        try:
+            # wait until all 3 instances discovered
+            for _ in range(50):
+                if len(client.instance_ids()) == 3:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(client.instance_ids()) == 3
+            router = PushRouter(client=client, mode=RouterMode.ROUND_ROBIN)
+            seen = set()
+            for _ in range(6):
+                items = [x async for x in router.generate({"q": 1})]
+                seen.add(items[0]["worker"])
+            assert len(seen) == 3
+        finally:
+            await client.close()
+            await rt.shutdown()
+
+
+async def test_direct_routing():
+    async with cluster(2) as (_, cfg, _rts):
+        rt, client = await make_client(cfg)
+        try:
+            for _ in range(50):
+                if len(client.instance_ids()) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            target = client.instance_ids()[1]
+            items = [x async for x in client.generate_direct({"q": 1}, target)]
+            # all streams come from the same chosen instance
+            items2 = [x async for x in client.generate_direct({"q": 2}, target)]
+            assert items[0]["worker"] == items2[0]["worker"]
+        finally:
+            await client.close()
+            await rt.shutdown()
+
+
+async def test_handler_error_propagates():
+    def factory(i):
+        async def handler(payload, ctx):
+            yield {"ok": 1}
+            raise RuntimeError("engine exploded")
+        return handler
+
+    async with cluster(1, factory) as (_, cfg, _rts):
+        rt, client = await make_client(cfg)
+        try:
+            router = PushRouter(client=client)
+            with pytest.raises(StreamError, match="engine exploded"):
+                async for _ in router.generate({}):
+                    pass
+        finally:
+            await client.close()
+            await rt.shutdown()
+
+
+async def test_unknown_endpoint_errors():
+    async with cluster(1) as (_, cfg, rts):
+        rt, client = await make_client(cfg)
+        try:
+            # dial the live worker address but name a bogus endpoint
+            inst = list(client.instances.values())[0]
+            wc = await client._connect(inst)
+            with pytest.raises(StreamError, match="no such endpoint"):
+                async for _ in wc.call("ns.backend.nope", {}, "rid"):
+                    pass
+        finally:
+            await client.close()
+            await rt.shutdown()
+
+
+async def test_worker_death_removes_instance():
+    async with cluster(2) as (server, cfg, rts):
+        rt, client = await make_client(cfg)
+        try:
+            for _ in range(50):
+                if len(client.instance_ids()) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            # hard-kill one worker's lease (simulates process death)
+            dead = rts[0]
+            assert dead.primary_lease is not None
+            dead.primary_lease._task.cancel()
+            server.state.leases[dead.primary_lease.id].deadline = 0  # force expiry
+            for _ in range(60):
+                if len(client.instance_ids()) == 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(client.instance_ids()) == 1
+            # remaining instance still serves
+            router = PushRouter(client=client)
+            items = [x async for x in router.generate({})]
+            assert len(items) == 3
+        finally:
+            await client.close()
+            await rt.shutdown()
+
+
+async def test_cancellation_reaches_handler():
+    cancelled = asyncio.Event()
+
+    def factory(i):
+        async def handler(payload, ctx):
+            try:
+                for tok in range(1000):
+                    yield {"tok": tok}
+                    await asyncio.sleep(0.01)
+            finally:
+                cancelled.set()
+        return handler
+
+    async with cluster(1, factory) as (_, cfg, _rts):
+        rt, client = await make_client(cfg)
+        try:
+            router = PushRouter(client=client)
+            n = 0
+            async for _ in router.generate({}):
+                n += 1
+                if n >= 3:
+                    break  # client walks away mid-stream
+            await asyncio.wait_for(cancelled.wait(), 3)
+        finally:
+            await client.close()
+            await rt.shutdown()
+
+
+async def test_no_instances_error():
+    server = CoordinatorServer()
+    await server.start()
+    cfg = RuntimeConfig(coordinator_url=server.url)
+    rt = await DistributedRuntime.create(cfg)
+    client = await EndpointClient.create(rt, EndpointId("ns", "nothing", "here"))
+    try:
+        router = PushRouter(client=client)
+        with pytest.raises(NoInstancesError):
+            async for _ in router.generate({}):
+                pass
+    finally:
+        await client.close()
+        await rt.shutdown()
+        await server.stop()
