@@ -1,0 +1,162 @@
+"""Frontend process: HTTP ingress + model discovery + routed pipeline.
+
+Fills the role of the reference's ``python -m dynamo.frontend``
+(reference: components/src/dynamo/frontend/main.py + the ModelWatcher flow,
+lib/llm/src/discovery/watcher.rs:50 and build_routed_pipeline,
+entrypoint/input/common.rs:259): watch the model registry; when a model
+appears, build preprocessor → migration → (kv|round-robin) router pipeline
+and expose it at /v1/*; when its last instance vanishes, remove it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+
+from dynamo_tpu.frontend.migration import Migration
+from dynamo_tpu.frontend.model_manager import ModelManager
+from dynamo_tpu.frontend.service import HttpService
+from dynamo_tpu.preprocessor.preprocessor import ModelDefaults
+from dynamo_tpu.protocols.common import LLMEngineOutput
+from dynamo_tpu.router.kv_router import KvPushRouter, KvRouterConfig
+from dynamo_tpu.runtime.client import EndpointClient, PushRouter, RouterMode
+from dynamo_tpu.runtime.protocols import MODEL_PREFIX, EndpointId
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.tokenizer import load_tokenizer
+from dynamo_tpu.utils.config import RuntimeConfig
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+log = get_logger("frontend.main")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("dynamo-frontend")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--router-mode", choices=["kv", "round_robin", "random"], default="kv")
+    p.add_argument("--kv-overlap-weight", type=float, default=1.0)
+    p.add_argument("--router-temperature", type=float, default=0.0)
+    p.add_argument("--migration-limit", type=int, default=3)
+    return p.parse_args(argv)
+
+
+class ModelWatcher:
+    """Watches dyn/models/ and (un)registers per-model pipelines."""
+
+    def __init__(self, rt: DistributedRuntime, models: ModelManager, ns: argparse.Namespace):
+        self.rt = rt
+        self.models = models
+        self.args = ns
+        self._instances: dict[str, set[str]] = {}   # model -> instance keys
+        self._pipelines: dict[str, tuple] = {}       # model -> (client, router)
+        self._task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        assert self.rt.client is not None
+        watch = await self.rt.client.watch_prefix(MODEL_PREFIX + "/")
+        self._task = asyncio.create_task(self._loop(watch))
+
+    async def _loop(self, watch) -> None:
+        async for ev in watch:
+            log.debug("model watch event: %s %s", ev.op, ev.key)
+            try:
+                # key: dyn/models/{name}/{instance}
+                _, _, rest = ev.key.partition(MODEL_PREFIX + "/")
+                name, _, inst = rest.partition("/")
+                if ev.op == "put":
+                    card = json.loads(ev.value)
+                    known = self._instances.setdefault(name, set())
+                    known.add(inst)
+                    if name not in self._pipelines:
+                        await self._add_model(name, card)
+                elif ev.op == "delete":
+                    known = self._instances.get(name)
+                    if known:
+                        known.discard(inst)
+                        if not known:
+                            await self._remove_model(name)
+            except Exception:
+                log.exception("model watch event failed: %s", ev)
+
+    async def _add_model(self, name: str, card: dict) -> None:
+        endpoint = EndpointId.parse("dyn://" + card["endpoint"])
+        log.debug("add_model %s: creating endpoint client", name)
+        client = await EndpointClient.create(self.rt, endpoint)
+        log.debug("add_model %s: endpoint client ready", name)
+        mode = self.args.router_mode
+        if mode == "kv" and card.get("kv_events", True):
+            log.debug("add_model %s: creating kv router", name)
+            router = await KvPushRouter.create(client, KvRouterConfig(
+                block_size=card.get("block_size", 16),
+                overlap_weight=self.args.kv_overlap_weight,
+                temperature=self.args.router_temperature,
+            ))
+            routed = router.generate
+        else:
+            push = PushRouter(client=client, mode=RouterMode(
+                mode if mode != "kv" else "round_robin"))
+            router = push
+
+            async def routed(req):
+                async for item in push.generate(req.to_dict(), req.request_id):
+                    yield item
+
+        migration = Migration(routed, migration_limit=self.args.migration_limit,
+                              wait_ready=client.wait_for_instances)
+
+        async def generate(req):
+            async for item in migration.generate(req):
+                yield LLMEngineOutput.from_dict(item)
+
+        tokenizer = load_tokenizer(card.get("tokenizer"))
+        self.models.register(
+            name, tokenizer, generate,
+            defaults=ModelDefaults(max_model_len=card.get("max_model_len", 8192)),
+        )
+        self._pipelines[name] = (client, router)
+        log.info("model added: %s via %s (router=%s)", name, endpoint, mode)
+
+    async def _remove_model(self, name: str) -> None:
+        self.models.unregister(name)
+        pipe = self._pipelines.pop(name, None)
+        if pipe:
+            client, router = pipe
+            if hasattr(router, "close"):
+                await router.close()
+            await client.close()
+        log.info("model removed: %s", name)
+
+
+async def amain(ns: argparse.Namespace) -> None:
+    cfg = RuntimeConfig.from_settings(coordinator_url=ns.coordinator)
+    rt = await DistributedRuntime.create(cfg)
+    models = ModelManager()
+    watcher = ModelWatcher(rt, models, ns)
+    await watcher.start()
+    svc = HttpService(models)
+    port = await svc.start(ns.host, ns.port)
+    log.info("frontend ready on :%d (router=%s)", port, ns.router_mode)
+    print(f"FRONTEND_READY port={port}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await svc.stop()
+    await rt.shutdown()
+
+
+def main() -> None:
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1)  # kill -USR1 <pid> dumps stacks
+    configure_logging()
+    asyncio.run(amain(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

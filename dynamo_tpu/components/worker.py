@@ -1,0 +1,137 @@
+"""Worker process: serve an engine (jax | mocker) on the distributed runtime.
+
+Fills the role of the reference's engine worker components
+(reference: components/src/dynamo/vllm/main.py init flow + mocker/main.py):
+connect runtime → build engine with KV-event publishing → register model
+card → serve_endpoint → publish metrics. ``python -m dynamo_tpu.components.worker``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+
+from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.runtime.protocols import MODEL_PREFIX
+from dynamo_tpu.runtime.runtime import DistributedRuntime, RequestContext
+from dynamo_tpu.utils.config import EngineConfig, RuntimeConfig
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+log = get_logger("worker")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("dynamo-worker")
+    p.add_argument("--engine", choices=["jax", "mocker"], default="jax")
+    p.add_argument("--model", default="tiny-llama")
+    p.add_argument("--served-model-name", default=None)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-blocks", type=int, default=0)
+    p.add_argument("--max-batch-size", type=int, default=32)
+    p.add_argument("--max-model-len", type=int, default=8192)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--tokenizer", default=None)
+    p.add_argument("--speedup-ratio", type=float, default=10.0, help="mocker only")
+    p.add_argument("--no-kv-events", action="store_true")
+    return p.parse_args(argv)
+
+
+def model_card(ns: argparse.Namespace, name: str) -> dict:
+    """ModelDeploymentCard-equivalent (reference: lib/llm/src/model_card.rs:91)."""
+    return {
+        "name": name,
+        "endpoint": f"{ns.namespace}.{ns.component}.{ns.endpoint}",
+        "tokenizer": ns.tokenizer or ns.model,
+        "block_size": ns.block_size,
+        "max_model_len": ns.max_model_len,
+        "kv_events": not ns.no_kv_events,
+    }
+
+
+async def amain(ns: argparse.Namespace) -> None:
+    cfg = RuntimeConfig.from_settings(coordinator_url=ns.coordinator)
+    rt = await DistributedRuntime.create(cfg)
+    assert rt.client is not None and rt.primary_lease is not None
+
+    publisher = None
+    if not ns.no_kv_events:
+        publisher = KvEventPublisher(
+            rt.client, ns.namespace, ns.component, worker_id=rt.instance_id)
+        publisher.start()
+    sink = publisher.sink if publisher else None
+
+    if ns.engine == "mocker":
+        from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
+
+        engine = MockEngine(MockEngineArgs(
+            num_blocks=ns.num_blocks or 512,
+            block_size=ns.block_size,
+            max_batch_size=ns.max_batch_size,
+            max_model_len=ns.max_model_len,
+            speedup_ratio=ns.speedup_ratio,
+        ), event_sink=sink)
+        stats_fn = engine.stats
+    else:
+        from dynamo_tpu.engine.engine import build_engine
+
+        # Engine construction (param init, cache alloc) blocks for seconds —
+        # run off-loop so the lease keep-alive keeps ticking.
+        loop = asyncio.get_running_loop()
+        engine = await loop.run_in_executor(None, lambda: build_engine(EngineConfig(
+            model=ns.model,
+            block_size=ns.block_size,
+            num_blocks=ns.num_blocks,
+            max_batch_size=ns.max_batch_size,
+            max_model_len=ns.max_model_len,
+            tp=ns.tp,
+        ), event_sink=sink))
+        stats_fn = engine.stats
+
+    async def handler(payload: dict, ctx: RequestContext):
+        req = PreprocessedRequest.from_dict(payload)
+        async for out in engine.generate(req):
+            if ctx.is_cancelled():
+                return
+            yield out.to_dict()
+
+    ep = rt.namespace(ns.namespace).component(ns.component).endpoint(ns.endpoint)
+    await ep.serve(handler)
+
+    metrics_pub = WorkerMetricsPublisher(
+        rt.client, ns.namespace, ns.component, rt.instance_id, stats_fn)
+    metrics_pub.start()
+
+    name = ns.served_model_name or ns.model
+    await rt.client.put(
+        f"{MODEL_PREFIX}/{name}/{rt.instance_id:016x}",
+        json.dumps(model_card(ns, name)).encode(),
+        lease_id=rt.primary_lease.id)
+    log.info("worker ready: engine=%s model=%s instance=%x", ns.engine, name, rt.instance_id)
+    print(f"WORKER_READY instance={rt.instance_id:016x}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    log.info("worker draining")
+    await metrics_pub.stop()
+    if publisher:
+        await publisher.stop()
+    await rt.shutdown()
+
+
+def main() -> None:
+    configure_logging()
+    asyncio.run(amain(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

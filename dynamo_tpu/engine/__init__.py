@@ -1,3 +1,14 @@
-from dynamo_tpu.engine.engine import AsyncJaxEngine, EngineCore
+"""JAX engine package. Imports are lazy so jax-free consumers (mocker,
+runtime, router) can use the block-pool/scheduler modules without pulling
+jax into the process."""
 
-__all__ = ["AsyncJaxEngine", "EngineCore"]
+
+def __getattr__(name):
+    if name in ("AsyncJaxEngine", "EngineCore", "build_engine"):
+        from dynamo_tpu.engine import engine
+
+        return getattr(engine, name)
+    raise AttributeError(name)
+
+
+__all__ = ["AsyncJaxEngine", "EngineCore", "build_engine"]

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 
+from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.parallel.mesh import kv_cache_spec
 
@@ -59,10 +60,6 @@ def allocate_cache(spec: KVCacheSpec, mesh: Mesh | None = None) -> tuple[jax.Arr
         return zeros(), zeros()
     z = jnp.zeros(spec.shape, jnp.dtype(spec.dtype))
     return z, jnp.zeros_like(z)
-
-
-class NoFreeBlocks(Exception):
-    pass
 
 
 @dataclass

@@ -1,0 +1,5 @@
+"""Engine error types (jax-free so the mocker/runtime paths import light)."""
+
+
+class NoFreeBlocks(Exception):
+    """Block pool exhausted (caller should preempt, queue, or reject)."""

@@ -17,7 +17,7 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Callable
 
-from dynamo_tpu.engine.cache import NoFreeBlocks
+from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.router.events import BlockRemoved, BlockStored, KvCacheEvent
 
 

@@ -18,7 +18,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Iterable
 
-from dynamo_tpu.engine.cache import NoFreeBlocks
+from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
 from dynamo_tpu.protocols.common import FinishReason, PreprocessedRequest
 from dynamo_tpu.tokens import TokenBlockSequence

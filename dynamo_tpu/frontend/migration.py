@@ -1,0 +1,76 @@
+"""Request migration: resume a broken stream on another worker.
+
+Fills the role of the reference's Migration operator
+(reference: lib/llm/src/migration.rs:26-81 Migration/RetryManager;
+docs/architecture/request_migration.md): if a worker dies mid-generation,
+re-dispatch the request to a new worker with the already-generated tokens
+appended to the prompt (KV rebuilds via prefix cache or recompute), up to
+``migration_limit`` times. The client stream never sees the failure.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Awaitable, Callable, Protocol
+
+from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.runtime.client import NoInstancesError, StreamError
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("migration")
+
+# A routed generate: request -> stream of LLMEngineOutput dicts.
+RoutedGenerate = Callable[[PreprocessedRequest], AsyncIterator[dict]]
+
+
+class Migration:
+    def __init__(self, inner: RoutedGenerate, migration_limit: int = 3,
+                 wait_ready: Callable[[float], Awaitable[None]] | None = None):
+        self.inner = inner
+        self.migration_limit = migration_limit
+        self.wait_ready = wait_ready  # e.g. EndpointClient.wait_for_instances
+
+    async def generate(self, req: PreprocessedRequest) -> AsyncIterator[dict]:
+        attempts = 0
+        generated: list[int] = []
+        current = req
+        while True:
+            finished = False
+            try:
+                async for out in self.inner(current):
+                    toks = out.get("token_ids") or []
+                    generated.extend(toks)
+                    if out.get("finish_reason"):
+                        finished = True
+                    yield out
+                if finished:
+                    return
+                # stream ended without finish_reason → treat as broken
+                raise StreamError("stream ended without finish reason")
+            except (StreamError, NoInstancesError, ConnectionError, OSError) as exc:
+                attempts += 1
+                if attempts > self.migration_limit:
+                    log.warning("migration limit reached for %s: %s", req.request_id, exc)
+                    raise
+                log.info("migrating request %s (attempt %d/%d): %s",
+                         req.request_id, attempts, self.migration_limit, exc)
+                # Back off so retries span the lease-expiry window — dead
+                # instances need a few seconds to vanish from discovery and
+                # replacements to appear (reference: RetryManager re-resolves
+                # instances between attempts).
+                import asyncio
+
+                await asyncio.sleep(min(1.0 * attempts, 2.5))
+                if self.wait_ready is not None:
+                    try:
+                        await self.wait_ready(8.0)
+                    except Exception:
+                        pass  # final attempt will surface NoInstancesError
+                # resume: prompt + tokens generated so far; budget shrinks
+                # (always relative to the ORIGINAL request's budget)
+                new_req = PreprocessedRequest.from_dict(req.to_dict())
+                new_req.request_id = req.request_id
+                new_req.token_ids = list(req.token_ids) + generated
+                orig_max = req.stop_conditions.max_tokens
+                if orig_max is not None:
+                    new_req.stop_conditions.max_tokens = max(orig_max - len(generated), 1)
+                current = new_req

@@ -52,8 +52,10 @@ def _error(status: int, message: str) -> web.Response:
 
 class HttpService:
     def __init__(self, models: ModelManager | None = None, metrics: MetricsRegistry | None = None):
-        self.models = models or ModelManager()
-        self.metrics = metrics or MetricsRegistry()
+        # NOT `models or ...`: ModelManager is empty (falsy by __len__) at
+        # startup and models are registered later by the watcher.
+        self.models = models if models is not None else ModelManager()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         m = self.metrics
         self._requests = m.counter("frontend_requests_total", "HTTP requests by route/status")
         self._inflight = m.gauge("frontend_inflight", "in-flight requests")

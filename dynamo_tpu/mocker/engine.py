@@ -1,0 +1,211 @@
+"""Mocker engine: a timing-accurate engine simulator with zero accelerators.
+
+Fills the role of the reference's mocker
+(reference: lib/llm/src/mocker/{engine.rs,scheduler.rs,kv_manager.rs}):
+simulates a paged-KV continuous-batching engine — real block accounting
+(the SAME PrefixPool the JAX engine uses, so it emits true KV events),
+prefill token budgets, configurable timing (``speedup_ratio`` scales real
+sleeps), deterministic fake tokens — so routers, frontends, planners, and
+fault tolerance are testable on a laptop CPU exactly like the reference
+tests against N mockers (tests/router/test_router_e2e_with_mockers.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Callable
+
+from dynamo_tpu.engine.errors import NoFreeBlocks
+from dynamo_tpu.engine.prefix_pool import PrefixPool
+from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.router.events import KvCacheEvent
+from dynamo_tpu.tokens import TokenBlockSequence
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("mocker")
+
+
+@dataclass
+class MockEngineArgs:
+    """(reference: mocker/protocols.rs MockEngineArgs)"""
+
+    num_blocks: int = 512
+    block_size: int = 16
+    max_batch_size: int = 32
+    max_model_len: int = 8192
+    vocab_size: int = 32000
+    # timing model
+    prefill_us_per_token: float = 300.0
+    decode_itl_ms: float = 8.0
+    speedup_ratio: float = 10.0     # divide all times by this
+    enable_prefix_caching: bool = True
+    watermark: float = 0.01
+
+
+@dataclass
+class _MockSeq:
+    req: PreprocessedRequest
+    block_seq: TokenBlockSequence
+    block_ids: list[int] = field(default_factory=list)
+    committed: int = 0
+    generated: int = 0
+    prefilled: bool = False
+    cached_blocks: int = 0
+    queue: asyncio.Queue = field(default_factory=asyncio.Queue)
+    done: bool = False
+
+
+class MockEngine:
+    def __init__(self, args: MockEngineArgs | None = None,
+                 event_sink: Callable[[KvCacheEvent], None] | None = None):
+        self.args = args or MockEngineArgs()
+        self.pool = PrefixPool(
+            self.args.num_blocks, self.args.block_size,
+            event_sink=event_sink,
+            enable_prefix_caching=self.args.enable_prefix_caching)
+        self.waiting: list[_MockSeq] = []
+        self.running: list[_MockSeq] = []
+        self._task: asyncio.Task | None = None
+        self._wake = asyncio.Event()
+        self.prefix_hits = 0
+        self.prefix_lookups = 0
+        self.steps = 0
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    # ------------------------------------------------------------------
+    def _token_for(self, rid: str, i: int) -> int:
+        digest = hashlib.md5(f"{rid}:{i}".encode()).digest()
+        return int.from_bytes(digest[:4], "little") % self.args.vocab_size
+
+    async def generate(self, req: PreprocessedRequest) -> AsyncIterator[LLMEngineOutput]:
+        self.start()
+        if len(req.token_ids) >= self.args.max_model_len:
+            yield LLMEngineOutput(finish_reason=FinishReason.ERROR,
+                                  error="prompt exceeds max_model_len")
+            return
+        seq = _MockSeq(req=req, block_seq=TokenBlockSequence.from_tokens(
+            req.token_ids, self.args.block_size))
+        self.waiting.append(seq)
+        self._wake.set()
+        try:
+            while True:
+                out = await seq.queue.get()
+                yield out
+                if out.finish_reason is not None:
+                    return
+        finally:
+            if not seq.done:
+                seq.done = True  # client walked away; loop reaps it
+
+    # ------------------------------------------------------------------
+    async def _loop(self) -> None:
+        a = self.args
+        while True:
+            if not self.waiting and not self.running:
+                self._wake.clear()
+                await self._wake.wait()
+            # reap cancelled
+            for seq in [s for s in self.running if s.done]:
+                self._finish(seq, None)
+            # admit
+            while self.waiting and len(self.running) < a.max_batch_size:
+                seq = self.waiting[0]
+                hashes = seq.block_seq.sequence_hashes()
+                matchable = max((len(seq.req.token_ids) - 1) // a.block_size, 0)
+                matched = self.pool.match_prefix(hashes[:matchable])
+                need = -(-len(seq.req.token_ids) // a.block_size) - len(matched)
+                try:
+                    fresh = self.pool.allocate(max(need, 0))
+                except NoFreeBlocks:
+                    self.pool.release(matched)
+                    break
+                seq.block_ids = matched + fresh
+                seq.cached_blocks = len(matched)
+                seq.committed = len(matched)
+                self.prefix_lookups += max(len(hashes), 1)
+                self.prefix_hits += len(matched)
+                self.waiting.pop(0)
+                self.running.append(seq)
+
+            self.steps += 1
+            prefills = [s for s in self.running if not s.prefilled and not s.done]
+            if prefills:
+                seq = prefills[0]
+                new_tokens = len(seq.req.token_ids) - seq.cached_blocks * a.block_size
+                await asyncio.sleep(
+                    new_tokens * a.prefill_us_per_token / 1e6 / a.speedup_ratio)
+                seq.prefilled = True
+                self._commit(seq, len(seq.req.token_ids))
+                self._emit_token(seq)
+                continue
+
+            decodes = [s for s in self.running if s.prefilled and not s.done]
+            if decodes:
+                await asyncio.sleep(a.decode_itl_ms / 1e3 / a.speedup_ratio)
+                for seq in decodes:
+                    # grow blocks as generated tokens fill them
+                    total = len(seq.req.token_ids) + seq.generated + 1
+                    need = -(-total // a.block_size)
+                    if need > len(seq.block_ids):
+                        try:
+                            seq.block_ids.extend(self.pool.allocate(need - len(seq.block_ids)))
+                        except NoFreeBlocks:
+                            continue  # starved this step; retried next step
+                    self._emit_token(seq)
+                    self._commit(seq, total - 1)
+            await asyncio.sleep(0)
+
+    def _emit_token(self, seq: _MockSeq) -> None:
+        tok = self._token_for(seq.req.request_id, seq.generated)
+        seq.generated += 1
+        seq.block_seq.append(tok)
+        sc = seq.req.stop_conditions
+        finish = None
+        if sc.max_tokens is not None and seq.generated >= sc.max_tokens:
+            finish = FinishReason.LENGTH
+        elif len(seq.req.token_ids) + seq.generated >= self.args.max_model_len:
+            finish = FinishReason.LENGTH
+        out = LLMEngineOutput(token_ids=[tok], finish_reason=finish)
+        seq.queue.put_nowait(out)
+        if finish is not None:
+            self._finish(seq, finish)
+
+    def _commit(self, seq: _MockSeq, computed_tokens: int) -> None:
+        hashes = seq.block_seq.sequence_hashes()
+        n_full = computed_tokens // self.args.block_size
+        while seq.committed < n_full and seq.committed < len(seq.block_ids):
+            i = seq.committed
+            self.pool.commit(seq.block_ids[i], hashes[i], hashes[i - 1] if i else None)
+            seq.committed += 1
+
+    def _finish(self, seq: _MockSeq, reason) -> None:
+        seq.done = True
+        if seq in self.running:
+            self.running.remove(seq)
+        if seq.block_ids:
+            self.pool.release(seq.block_ids)
+            seq.block_ids = []
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        """ForwardPassMetrics-shaped stats (reference: publisher.rs:686)."""
+        return {
+            "num_waiting": len(self.waiting),
+            "num_running": len(self.running),
+            "kv_usage": self.pool.usage,
+            "kv_total_blocks": self.pool.num_blocks,
+            "prefix_hit_rate": self.prefix_hits / max(self.prefix_lookups, 1),
+            "num_steps": self.steps,
+        }
+
+    async def clear_kv(self) -> None:
+        self.pool.clear()

@@ -1,0 +1,170 @@
+"""KvRouter + KvPushRouter: KV-cache-aware request routing.
+
+Fills the role of the reference's KvRouter / KvPushRouter
+(reference: lib/llm/src/kv_router.rs module; request-time path
+indexer.rs:125 compute_block_hash_for_seq → find_matches → KvScheduler →
+direct push; background path: kv_events/load_metrics consumers feeding the
+radix index and worker loads; ActiveSequences predictions added on dispatch
+and freed on stream end; dead workers purged when their instances vanish).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator
+
+import msgpack
+
+from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.router.events import RouterEvent
+from dynamo_tpu.router.indexer import ApproxKvIndexer, RadixIndexer, WorkerId
+from dynamo_tpu.router.publisher import kv_events_subject, load_metrics_subject
+from dynamo_tpu.router.scheduler import DefaultWorkerSelector, KvScheduler, WorkerLoad
+from dynamo_tpu.router.sequence import ActiveSequences
+from dynamo_tpu.runtime.client import EndpointClient, NoInstancesError
+from dynamo_tpu.tokens import compute_block_hashes_for_tokens
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("router.kv")
+
+
+@dataclass
+class KvRouterConfig:
+    block_size: int = 16
+    overlap_weight: float = 1.0
+    temperature: float = 0.0
+    use_approx_indexer: bool = False   # engines without KV events
+    approx_ttl_s: float = 120.0
+
+
+class KvRouter:
+    """Routing brain: indexer + scheduler + load tracking (transport-free)."""
+
+    def __init__(self, config: KvRouterConfig | None = None):
+        self.config = config or KvRouterConfig()
+        self.indexer = RadixIndexer()
+        self.approx = ApproxKvIndexer(self.config.approx_ttl_s)
+        self.scheduler = KvScheduler(DefaultWorkerSelector(
+            overlap_weight=self.config.overlap_weight,
+            temperature=self.config.temperature,
+        ))
+        self.active = ActiveSequences()
+        self.worker_metrics: dict[WorkerId, dict] = {}
+
+    # ------------------------------------------------------------------
+    def apply_events(self, events: list[RouterEvent]) -> None:
+        for ev in events:
+            self.indexer.apply_event(ev)
+
+    def update_metrics(self, metrics: dict) -> None:
+        wid = metrics.get("worker_id")
+        if wid is not None:
+            self.worker_metrics[wid] = metrics
+
+    def remove_worker(self, worker_id: WorkerId) -> None:
+        self.indexer.remove_worker(worker_id)
+        self.active.remove_worker(worker_id)
+        self.worker_metrics.pop(worker_id, None)
+
+    # ------------------------------------------------------------------
+    def find_best_match(self, request_id: str, token_ids: list[int],
+                        worker_ids: list[WorkerId]) -> tuple[WorkerId, int]:
+        """Pick a worker; returns (worker_id, overlap_blocks). Registers the
+        decision with the ActiveSequences predictor."""
+        if not worker_ids:
+            raise NoInstancesError("no workers")
+        hashes = compute_block_hashes_for_tokens(token_ids, self.config.block_size)
+        total_blocks = max(len(hashes), 1)
+        overlaps = (self.approx if self.config.use_approx_indexer else self.indexer).find_matches(hashes)
+        loads = {}
+        for wid in worker_ids:
+            m = self.worker_metrics.get(wid, {})
+            loads[wid] = WorkerLoad(
+                worker_id=wid,
+                active_blocks=self.active.active_blocks(wid)
+                + int(m.get("num_waiting", 0)) * total_blocks // 4,
+                total_blocks=int(m.get("kv_total_blocks", 1) or 1),
+                num_waiting=int(m.get("num_waiting", 0)),
+            )
+        wid = self.scheduler.schedule(total_blocks, overlaps, loads)
+        overlap = overlaps.scores.get(wid, 0)
+        self.active.add_request(request_id, wid, total_blocks - overlap, overlap)
+        if self.config.use_approx_indexer:
+            self.approx.note_routed(hashes, wid)
+        return wid, overlap
+
+    def complete(self, request_id: str) -> None:
+        self.active.free(request_id)
+
+
+class KvPushRouter:
+    """Transport wiring: EndpointClient + coordinator subscriptions + KvRouter
+    (the KV mode of PushRouter; reference: push_router.rs KV dispatch)."""
+
+    def __init__(self, client: EndpointClient, config: KvRouterConfig | None = None):
+        self.client = client
+        self.router = KvRouter(config)
+        self._tasks: list[asyncio.Task] = []
+        self._known_workers: set[WorkerId] = set()
+
+    @classmethod
+    async def create(cls, client: EndpointClient,
+                     config: KvRouterConfig | None = None) -> "KvPushRouter":
+        self = cls(client, config)
+        ep = client.endpoint
+        coord = client.runtime.client
+        assert coord is not None
+        ev_sub = await coord.subscribe(kv_events_subject(ep.namespace, ep.component))
+        met_sub = await coord.subscribe(load_metrics_subject(ep.namespace, ep.component))
+        self._tasks.append(asyncio.create_task(self._event_loop(ev_sub)))
+        self._tasks.append(asyncio.create_task(self._metrics_loop(met_sub)))
+        self._tasks.append(asyncio.create_task(self._instance_gc_loop()))
+        return self
+
+    async def _event_loop(self, sub) -> None:
+        async for _subject, payload in sub:
+            try:
+                events = [RouterEvent.from_dict(d) for d in msgpack.unpackb(payload, raw=False)]
+                self.router.apply_events(events)
+            except Exception:
+                log.exception("bad kv event batch")
+
+    async def _metrics_loop(self, sub) -> None:
+        async for _subject, payload in sub:
+            try:
+                self.router.update_metrics(msgpack.unpackb(payload, raw=False))
+            except Exception:
+                log.exception("bad metrics payload")
+
+    async def _instance_gc_loop(self) -> None:
+        """Purge router state for workers whose instances vanished."""
+        while True:
+            await asyncio.sleep(0.5)
+            live = set(self.client.instance_ids())
+            for wid in self._known_workers - live:
+                log.info("purging dead worker %x from router state", wid)
+                self.router.remove_worker(wid)
+            self._known_workers = live
+
+    # ------------------------------------------------------------------
+    async def generate(self, request: PreprocessedRequest | dict) -> AsyncIterator[Any]:
+        req = request if isinstance(request, PreprocessedRequest) else PreprocessedRequest.from_dict(request)
+        worker_ids = self.client.instance_ids()
+        wid, overlap = self.router.find_best_match(req.request_id, req.token_ids, worker_ids)
+        req.estimated_prefix_hit_blocks = overlap
+        first = True
+        try:
+            async for item in self.client.generate_direct(req.to_dict(), wid, req.request_id):
+                if first:
+                    self.router.active.mark_prefill_complete(req.request_id)
+                    first = False
+                else:
+                    self.router.active.note_decode_progress(req.request_id, 0)
+                yield item
+        finally:
+            self.router.complete(req.request_id)
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()

@@ -1,0 +1,91 @@
+"""Router-local active-sequence load prediction.
+
+Fills the role of the reference's ActiveSequences
+(reference: lib/llm/src/kv_router/sequence.rs:53-225 ActiveSequences,
+:283 ActiveSequencesMultiWorker): the router predicts each worker's block
+usage from its own routing decisions — add on dispatch, shrink when prefill
+completes (shared prefix blocks become free), drop on stream end — so
+scheduling doesn't wait on the (slower) metrics feedback loop. Multi-router
+deployments sync decisions over the coordinator pub/sub.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from dynamo_tpu.router.indexer import WorkerId
+
+
+@dataclass
+class _ActiveReq:
+    request_id: str
+    worker_id: WorkerId
+    prefill_blocks: int      # blocks this request must newly compute
+    overlap_blocks: int      # cached blocks it reuses
+    decode_blocks: int = 0   # grown during decode
+    prefill_done: bool = False
+    started: float = field(default_factory=time.monotonic)
+
+
+class ActiveSequences:
+    def __init__(self) -> None:
+        self._reqs: dict[str, _ActiveReq] = {}
+        self._by_worker: dict[WorkerId, set[str]] = {}
+
+    def add_request(self, request_id: str, worker_id: WorkerId,
+                    prefill_blocks: int, overlap_blocks: int) -> None:
+        self._reqs[request_id] = _ActiveReq(
+            request_id=request_id, worker_id=worker_id,
+            prefill_blocks=prefill_blocks, overlap_blocks=overlap_blocks)
+        self._by_worker.setdefault(worker_id, set()).add(request_id)
+
+    def mark_prefill_complete(self, request_id: str) -> None:
+        req = self._reqs.get(request_id)
+        if req:
+            req.prefill_done = True
+
+    def note_decode_progress(self, request_id: str, new_blocks: int = 1) -> None:
+        req = self._reqs.get(request_id)
+        if req:
+            req.decode_blocks += new_blocks
+
+    def free(self, request_id: str) -> None:
+        req = self._reqs.pop(request_id, None)
+        if req:
+            peers = self._by_worker.get(req.worker_id)
+            if peers:
+                peers.discard(request_id)
+
+    # ------------------------------------------------------------------
+    def active_blocks(self, worker_id: WorkerId) -> int:
+        """Predicted blocks in use on a worker from in-flight requests."""
+        total = 0
+        for rid in self._by_worker.get(worker_id, ()):
+            r = self._reqs[rid]
+            total += r.prefill_blocks + r.overlap_blocks + r.decode_blocks
+        return total
+
+    def request_count(self, worker_id: WorkerId) -> int:
+        return len(self._by_worker.get(worker_id, ()))
+
+    def remove_worker(self, worker_id: WorkerId) -> list[str]:
+        """Drop all predictions for a dead worker; returns orphaned request ids."""
+        rids = list(self._by_worker.pop(worker_id, ()))
+        for rid in rids:
+            self._reqs.pop(rid, None)
+        return rids
+
+    def snapshot(self) -> dict:
+        return {
+            "requests": {
+                rid: {
+                    "worker_id": r.worker_id,
+                    "prefill_blocks": r.prefill_blocks,
+                    "overlap_blocks": r.overlap_blocks,
+                    "decode_blocks": r.decode_blocks,
+                    "prefill_done": r.prefill_done,
+                }
+                for rid, r in self._reqs.items()
+            }
+        }

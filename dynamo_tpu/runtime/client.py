@@ -53,16 +53,23 @@ class _WorkerConnection:
         self.alive = True
 
     async def _read_loop(self) -> None:
-        while True:
-            msg = await self.conn.recv()
-            if msg is None:
-                break
-            q = self._streams.get(msg.get("stream_id"))
-            if q is not None:
-                q.put_nowait(msg)
-        self.alive = False
-        for q in self._streams.values():
-            q.put_nowait({"t": Frame.ERR, "error": "connection lost"})
+        try:
+            while True:
+                msg = await self.conn.recv()
+                if msg is None:
+                    break
+                q = self._streams.get(msg.get("stream_id"))
+                if q is not None:
+                    q.put_nowait(msg)
+        except Exception as exc:  # corrupt frame, unpack error, socket error
+            log.warning("worker connection reader failed: %s", exc)
+        finally:
+            # Always mark dead + poison in-flight streams so no caller blocks
+            # forever and _connect() dials a fresh connection next time.
+            self.alive = False
+            self.conn.close()
+            for q in self._streams.values():
+                q.put_nowait({"t": Frame.ERR, "error": "connection lost"})
 
     async def call(self, endpoint: str, payload: Any, request_id: str,
                    headers: dict | None = None) -> AsyncIterator[Any]:
@@ -105,8 +112,10 @@ class EndpointClient:
         self.instances: dict[int, Instance] = {}
         self._conns: dict[str, _WorkerConnection] = {}
         self._watch_task: asyncio.Task | None = None
-        self._rr = itertools.count()
-        self._ready = asyncio.Event()
+        # instance_id -> monotonic time until which it is skipped (connect
+        # failures quarantine an instance until its lease expires or it
+        # re-registers — avoids burning retries on a dead address)
+        self._quarantine: dict[int, float] = {}
 
     @classmethod
     async def create(cls, runtime: DistributedRuntime, endpoint: EndpointId) -> "EndpointClient":
@@ -121,20 +130,25 @@ class EndpointClient:
             if ev.op == "put" and ev.value:
                 inst = Instance.from_bytes(ev.value)
                 self.instances[inst.instance_id] = inst
-                self._ready.set()
+                self._quarantine.pop(inst.instance_id, None)
             elif ev.op == "delete":
                 iid = int(ev.key.rsplit("/", 1)[-1], 16)
                 inst = self.instances.pop(iid, None)
                 if inst is not None:
                     log.info("instance %x of %s vanished", iid, self.endpoint)
-            if not self.instances:
-                self._ready.clear()
+        log.warning("instance watch for %s ended (coordinator lost)", self.endpoint)
 
     async def wait_for_instances(self, timeout: float = 10.0) -> None:
-        await asyncio.wait_for(self._ready.wait(), timeout)
+        """Wait until at least one non-quarantined instance is known."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while not self.instance_ids():
+            if asyncio.get_running_loop().time() >= deadline:
+                raise asyncio.TimeoutError(f"no live instances for {self.endpoint}")
+            await asyncio.sleep(0.05)
 
     def instance_ids(self) -> list[int]:
-        return sorted(self.instances)
+        now = asyncio.get_event_loop().time()
+        return sorted(i for i in self.instances if self._quarantine.get(i, 0.0) <= now)
 
     # ------------------------------------------------------------------
     async def _connect(self, inst: Instance) -> _WorkerConnection:
@@ -151,7 +165,12 @@ class EndpointClient:
         inst = self.instances.get(instance_id)
         if inst is None:
             raise NoInstancesError(f"instance {instance_id:x} not found for {self.endpoint}")
-        wc = await self._connect(inst)
+        try:
+            wc = await self._connect(inst)
+        except OSError:
+            self._quarantine[instance_id] = asyncio.get_running_loop().time() + 10.0
+            log.info("instance %x unreachable; quarantined 10s", instance_id)
+            raise
         target = f"{self.endpoint.namespace}.{self.endpoint.component}.{self.endpoint.endpoint}"
         async for item in wc.call(target, payload, request_id or uuid.uuid4().hex):
             yield item
@@ -166,11 +185,12 @@ class EndpointClient:
 @dataclass
 class PushRouter:
     """Instance selection policies over an EndpointClient
-    (reference: push_router.rs RouterMode + busy-threshold fallback)."""
+    (reference: push_router.rs RouterMode + busy-threshold fallback).
+    KV mode lives in dynamo_tpu.router.KvPushRouter."""
 
     client: EndpointClient
     mode: RouterMode = RouterMode.ROUND_ROBIN
-    # KV mode is provided by dynamo_tpu.router.KvPushRouter (subclass wiring)
+    _rr: "itertools.count" = field(default_factory=itertools.count)
 
     def _pick(self) -> int:
         ids = self.client.instance_ids()
@@ -178,10 +198,13 @@ class PushRouter:
             raise NoInstancesError(f"no instances for {self.client.endpoint}")
         if self.mode is RouterMode.RANDOM:
             return random.choice(ids)
-        return ids[next(self.client._rr) % len(ids)]
+        return ids[next(self._rr) % len(ids)]
 
     async def generate(self, payload: Any, request_id: str | None = None,
                        instance_id: int | None = None) -> AsyncIterator[Any]:
-        iid = instance_id if instance_id is not None else self._pick()
-        async for item in self.client.generate_direct(payload, iid, request_id):
+        if instance_id is None:
+            if self.mode is RouterMode.DIRECT:
+                raise ValueError("RouterMode.DIRECT requires an explicit instance_id")
+            instance_id = self._pick()
+        async for item in self.client.generate_direct(payload, instance_id, request_id):
             yield item

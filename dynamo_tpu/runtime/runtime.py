@@ -84,7 +84,11 @@ class DistributedRuntime:
     async def create(cls, config: RuntimeConfig | None = None) -> "DistributedRuntime":
         rt = cls(config)
         rt.client = await CoordinatorClient.connect(rt.config.coordinator_url)
-        rt.primary_lease = await rt.client.lease_grant(ttl=3.0)
+        rt.primary_lease = await rt.client.lease_grant(ttl=6.0)
+        # Coordinator lease ids are server-unique — mixing one in makes
+        # instance ids collision-free even for runtimes created in the same
+        # millisecond in the same process.
+        rt.instance_id = (int(time.time() * 1000) << 20) | (rt.primary_lease.id & 0xFFFFF)
         rt._server = await asyncio.start_server(rt._on_conn, "0.0.0.0", 0)
         rt.data_port = rt._server.sockets[0].getsockname()[1]
         rt._advertise_host = os.environ.get("DYN_ADVERTISE_HOST", "127.0.0.1")

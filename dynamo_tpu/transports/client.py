@@ -50,11 +50,15 @@ class Watch:
 
     async def _iter(self) -> AsyncIterator[WatchEvent]:
         while True:
-            yield await self.queue.get()
+            ev = await self.queue.get()
+            if ev is None:  # poison: connection lost / watch closed
+                return
+            yield ev
 
     async def cancel(self) -> None:
         await self._client._request({"op": "unwatch", "watch_id": self.watch_id})
         self._client._watches.pop(self.watch_id, None)
+        self.queue.put_nowait(None)
 
 
 class Subscription:
@@ -68,11 +72,15 @@ class Subscription:
 
     async def _iter(self):
         while True:
-            yield await self.queue.get()
+            item = await self.queue.get()
+            if item is None:  # poison: connection lost / unsubscribed
+                return
+            yield item
 
     async def cancel(self) -> None:
         await self._client._request({"op": "unsubscribe", "sub_id": self.sub_id})
         self._client._subs.pop(self.sub_id, None)
+        self.queue.put_nowait(None)
 
 
 @dataclass
@@ -128,36 +136,51 @@ class CoordinatorClient:
     # ------------------------------------------------------------------
     async def _read_loop(self) -> None:
         assert self._conn is not None
-        while True:
-            msg = await self._conn.recv()
-            if msg is None:
-                if not self._closed:
-                    log.warning("coordinator connection lost")
-                    for fut in self._pending.values():
-                        if not fut.done():
-                            fut.set_exception(CoordinatorError("connection lost"))
-                return
-            t = msg.get("t")
-            if t == Frame.RESPONSE:
-                fut = self._pending.pop(msg.get("id"), None)
-                if fut and not fut.done():
-                    fut.set_result(msg)
-            elif t == Frame.WATCH_EVENT:
-                # initial replay events can arrive before watch_prefix() sees
-                # the response — create the Watch on demand
-                wid = msg.get("watch_id")
-                w = self._watches.get(wid)
-                if w is None:
-                    w = self._watches[wid] = Watch(self, wid)
-                w.queue.put_nowait(WatchEvent(
-                    op=msg["op"], key=msg["key"], value=msg.get("value"),
-                    initial=bool(msg.get("initial"))))
-            elif t == Frame.PUBSUB_MSG:
-                sid = msg.get("sub_id")
-                s = self._subs.get(sid)
-                if s is None:
-                    s = self._subs[sid] = Subscription(self, sid)
-                s.queue.put_nowait((msg["subject"], msg["payload"]))
+        try:
+            while True:
+                msg = await self._conn.recv()
+                if msg is None:
+                    return
+                self._dispatch_frame(msg)
+        except Exception as exc:
+            if not self._closed:
+                log.warning("coordinator reader failed: %s", exc)
+        finally:
+            if not self._closed:
+                log.warning("coordinator connection lost")
+            # Fail pending requests and end all watch/subscription streams so
+            # no consumer blocks forever on a dead connection.
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(CoordinatorError("connection lost"))
+            for w in self._watches.values():
+                w.queue.put_nowait(None)
+            for s in self._subs.values():
+                s.queue.put_nowait(None)
+
+    def _dispatch_frame(self, msg: dict) -> None:
+        t = msg.get("t")
+        if t == Frame.RESPONSE:
+            fut = self._pending.pop(msg.get("id"), None)
+            if fut and not fut.done():
+                fut.set_result(msg)
+        elif t == Frame.WATCH_EVENT:
+            # initial replay events can arrive before watch_prefix() sees
+            # the response — create the Watch on demand
+            wid = msg.get("watch_id")
+            w = self._watches.get(wid)
+            if w is None:
+                w = self._watches[wid] = Watch(self, wid)
+            w.queue.put_nowait(WatchEvent(
+                op=msg["op"], key=msg["key"], value=msg.get("value"),
+                initial=bool(msg.get("initial"))))
+        elif t == Frame.PUBSUB_MSG:
+            sid = msg.get("sub_id")
+            s = self._subs.get(sid)
+            if s is None:
+                s = self._subs[sid] = Subscription(self, sid)
+            s.queue.put_nowait((msg["subject"], msg["payload"]))
+
 
     async def _request(self, body: dict) -> dict:
         assert self._conn is not None, "not connected"

@@ -65,7 +65,8 @@ class CoordinatorState:
             raise KeyError(f"no such lease {lease_id}")
         prev = self.kv.get(key)
         if prev is not None and prev.lease_id and prev.lease_id != lease_id:
-            self.leases[prev.lease_id].keys.discard(key) if prev.lease_id in self.leases else None
+            if prev.lease_id in self.leases:
+                self.leases[prev.lease_id].keys.discard(key)
         self.kv[key] = _KvEntry(value=value, lease_id=lease_id,
                                 version=(prev.version + 1 if prev else 1))
         if lease_id:
@@ -154,6 +155,11 @@ class CoordinatorServer:
         self._sessions: set[_Session] = set()
         self._server: asyncio.Server | None = None
         self._expiry_task: asyncio.Task | None = None
+        self._handler_tasks: set[asyncio.Task] = set()  # strong refs (GC safety)
+        # Serializes watch registration+replay against event broadcasts so a
+        # watcher can never see a broadcast reordered before its own replay
+        # of the same key (e.g. delete-then-stale-initial-put).
+        self._watch_lock = asyncio.Lock()
 
     async def start(self) -> int:
         self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
@@ -184,14 +190,15 @@ class CoordinatorServer:
                 await self._broadcast_kv_events(events)
 
     async def _broadcast_kv_events(self, events: list[dict]) -> None:
-        for session in list(self._sessions):
-            for wid, prefix in list(session.watches.items()):
-                hits = [e for e in events if e["key"].startswith(prefix)]
-                for e in hits:
-                    try:
-                        await session.conn.send({"t": Frame.WATCH_EVENT, "watch_id": wid, **e})
-                    except Exception:
-                        self._sessions.discard(session)
+        async with self._watch_lock:
+            for session in list(self._sessions):
+                for wid, prefix in list(session.watches.items()):
+                    hits = [e for e in events if e["key"].startswith(prefix)]
+                    for e in hits:
+                        try:
+                            await session.conn.send({"t": Frame.WATCH_EVENT, "watch_id": wid, **e})
+                        except Exception:
+                            self._sessions.discard(session)
 
     async def _publish(self, subject: str, payload: bytes) -> int:
         n = 0
@@ -219,7 +226,9 @@ class CoordinatorServer:
                 if msg.get("t") == Frame.PING:
                     await session.conn.send({"t": Frame.PONG})
                     continue
-                asyncio.ensure_future(self._handle(session, msg))
+                task = asyncio.ensure_future(self._handle(session, msg))
+                self._handler_tasks.add(task)
+                task.add_done_callback(self._handler_tasks.discard)
         finally:
             self._sessions.discard(session)
             session.conn.close()
@@ -256,14 +265,14 @@ class CoordinatorServer:
             return {"items": st.get_prefix(msg["prefix"])}
         if op == "watch":
             wid = msg.get("watch_id") or session.next_id()
-            session.watches[wid] = msg["prefix"]
-            # replay current state as initial events
-            initial = [
-                {"op": "put", "key": k, "value": v, "initial": True}
-                for k, v in st.get_prefix(msg["prefix"]).items()
-            ]
-            for e in initial:
-                await session.conn.send({"t": Frame.WATCH_EVENT, "watch_id": wid, **e})
+            async with self._watch_lock:  # atomic register+replay vs broadcasts
+                session.watches[wid] = msg["prefix"]
+                initial = [
+                    {"op": "put", "key": k, "value": v, "initial": True}
+                    for k, v in st.get_prefix(msg["prefix"]).items()
+                ]
+                for e in initial:
+                    await session.conn.send({"t": Frame.WATCH_EVENT, "watch_id": wid, **e})
             return {"watch_id": wid}
         if op == "unwatch":
             session.watches.pop(msg.get("watch_id"), None)

@@ -1,0 +1,157 @@
+"""End-to-end: coordinator + mocker workers + frontend as real processes.
+
+Fills the role of the reference's mocker e2e suite
+(reference: tests/router/test_router_e2e_with_mockers.py — the load-bearing
+zero-accelerator test pattern, SURVEY.md §4): drive HTTP through the full
+pipeline and assert routing + fault-tolerance behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from tests.utils_process import ManagedProcess
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def http_json(url: str, payload: dict | None = None, timeout: float = 30.0):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"content-type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    coord_port = free_port()
+    http_port = free_port()
+    coordinator = ManagedProcess(
+        ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
+         "--port", str(coord_port)], name="coordinator").start()
+    time.sleep(1.0)
+    url = f"tcp://127.0.0.1:{coord_port}"
+    workers = [
+        ManagedProcess(
+            ["-m", "dynamo_tpu.components.worker", "--engine", "mocker",
+             "--coordinator", url, "--block-size", "4", "--speedup-ratio", "50",
+             "--max-model-len", "512", "--num-blocks", "128"],
+            name=f"worker{i}").start()
+        for i in range(2)
+    ]
+    for w in workers:
+        w.wait_for_line("WORKER_READY", 30)
+    frontend = ManagedProcess(
+        ["-m", "dynamo_tpu.components.frontend", "--coordinator", url,
+         "--host", "127.0.0.1", "--port", str(http_port), "--router-mode", "kv"],
+        name="frontend").start()
+    frontend.wait_for_line("FRONTEND_READY", 30)
+    base = f"http://127.0.0.1:{http_port}"
+    # wait for model discovery
+    for _ in range(100):
+        models = http_json(base + "/v1/models")["data"]
+        if models:
+            break
+        time.sleep(0.1)
+    yield {"base": base, "coordinator": coordinator, "workers": workers,
+           "frontend": frontend, "coord_url": url}
+    frontend.stop()
+    for w in workers:
+        w.stop()
+    coordinator.stop()
+
+
+def test_model_discovered(cluster):
+    models = http_json(cluster["base"] + "/v1/models")["data"]
+    assert [m["id"] for m in models] == ["tiny-llama"]
+
+
+def test_chat_completion_roundtrip(cluster):
+    resp = http_json(cluster["base"] + "/v1/chat/completions", {
+        "model": "tiny-llama",
+        "messages": [{"role": "user", "content": "hello distributed world"}],
+        "max_tokens": 12,
+    })
+    assert resp["object"] == "chat.completion"
+    assert resp["choices"][0]["finish_reason"] == "length"
+    assert resp["usage"]["completion_tokens"] == 12
+
+
+def test_concurrent_requests_complete(cluster):
+    import concurrent.futures
+
+    def one(i):
+        return http_json(cluster["base"] + "/v1/completions", {
+            "model": "tiny-llama", "prompt": f"prompt {i} " * 10, "max_tokens": 8,
+        })
+
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        results = list(ex.map(one, range(16)))
+    assert all(r["choices"][0]["finish_reason"] == "length" for r in results)
+
+
+def test_kv_routing_prefix_affinity(cluster):
+    """Same long prompt repeatedly → the KV router should send repeats to the
+    worker already holding the prefix (observable as prefix cache hits)."""
+    prompt = "the quick brown fox jumps over the lazy dog " * 8
+    for _ in range(4):
+        http_json(cluster["base"] + "/v1/completions", {
+            "model": "tiny-llama", "prompt": prompt, "max_tokens": 4,
+        })
+    # workers publish prefix_hit_rate in load metrics; scrape via logs is
+    # brittle — ask each worker's stats through the metrics subject instead:
+    import asyncio
+    import msgpack
+
+    from dynamo_tpu.transports.client import CoordinatorClient
+
+    async def collect():
+        c = await CoordinatorClient.connect(cluster["coord_url"])
+        try:
+            sub = await c.subscribe("load_metrics.dynamo.backend")
+            seen = {}
+            deadline = asyncio.get_event_loop().time() + 5
+            while len(seen) < 2 and asyncio.get_event_loop().time() < deadline:
+                subj, payload = await asyncio.wait_for(sub.queue.get(), 5)
+                m = msgpack.unpackb(payload, raw=False)
+                seen[m["worker_id"]] = m
+            return seen
+        finally:
+            await c.close()
+
+    stats = asyncio.run(collect())
+    assert len(stats) == 2
+    total_hit_rate = sum(m.get("prefix_hit_rate", 0) for m in stats.values())
+    assert total_hit_rate > 0, f"no prefix reuse observed: {stats}"
+
+
+def test_worker_death_migration(cluster):
+    """Kill one worker; in-flight and subsequent requests must still finish
+    (reference: tests/fault_tolerance/test_request_migration.py)."""
+    cluster["workers"][0].kill_hard()
+    # requests keep succeeding (instance vanishes after lease expiry ~3s;
+    # during the gap, migration retries on the survivor)
+    ok = 0
+    for i in range(6):
+        try:
+            r = http_json(cluster["base"] + "/v1/completions", {
+                "model": "tiny-llama", "prompt": f"after death {i}", "max_tokens": 6,
+            }, timeout=30)
+            if r["choices"][0]["finish_reason"]:
+                ok += 1
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert ok >= 5, f"only {ok}/6 requests succeeded after worker death"

@@ -1,0 +1,129 @@
+"""KV router unit tests (reference test model: inline tests in
+kv_router/{indexer,scheduler}.rs — radix matching + softmax selection)."""
+
+import random
+
+from dynamo_tpu.router.events import BlockRemoved, BlockStored, RouterEvent
+from dynamo_tpu.router.indexer import ApproxKvIndexer, RadixIndexer
+from dynamo_tpu.router.kv_router import KvRouter, KvRouterConfig
+from dynamo_tpu.router.scheduler import (
+    DefaultWorkerSelector,
+    KvScheduler,
+    WorkerLoad,
+    softmax_sample,
+)
+from dynamo_tpu.router.sequence import ActiveSequences
+from dynamo_tpu.tokens import compute_block_hashes_for_tokens
+
+
+def stored(worker, hashes, parent=None):
+    return RouterEvent(worker_id=worker, event=BlockStored(block_hashes=tuple(hashes), parent_hash=parent))
+
+
+def removed(worker, hashes):
+    return RouterEvent(worker_id=worker, event=BlockRemoved(block_hashes=tuple(hashes)))
+
+
+def test_indexer_contiguous_prefix_scoring():
+    idx = RadixIndexer()
+    h = [100, 101, 102, 103]
+    idx.apply_event(stored(1, h))          # worker 1 holds all 4
+    idx.apply_event(stored(2, h[:2]))      # worker 2 holds first 2
+    idx.apply_event(stored(3, h[1:]))      # worker 3 holds 2..4 but NOT block 1
+    scores = idx.find_matches(h)
+    assert scores.scores[1] == 4
+    assert scores.scores[2] == 2
+    assert 3 not in scores.scores          # no contiguous prefix from start
+
+
+def test_indexer_removal_and_worker_purge():
+    idx = RadixIndexer()
+    h = [7, 8, 9]
+    idx.apply_event(stored(1, h))
+    idx.apply_event(stored(2, h))
+    idx.apply_event(removed(1, [9]))
+    s = idx.find_matches(h)
+    assert s.scores[1] == 2 and s.scores[2] == 3
+    idx.remove_worker(2)
+    s = idx.find_matches(h)
+    assert 2 not in s.scores
+    assert s.scores[1] == 2
+
+
+def test_indexer_snapshot_roundtrip():
+    idx = RadixIndexer()
+    idx.apply_event(stored(1, [1, 2, 3]))
+    idx.apply_event(stored(2, [1, 2]))
+    replica = RadixIndexer()
+    for ev in idx.dump_events():
+        replica.apply_event(ev)
+    q = [1, 2, 3]
+    assert idx.find_matches(q).scores == replica.find_matches(q).scores
+
+
+def test_softmax_sample_greedy_and_stochastic():
+    rng = random.Random(0)
+    costs = {1: 10.0, 2: 1.0, 3: 5.0}
+    assert softmax_sample(costs, 0.0, rng) == 2
+    picks = {softmax_sample(costs, 5.0, rng) for _ in range(200)}
+    assert len(picks) > 1  # temperature spreads choices
+
+
+def test_selector_prefers_overlap_and_low_load():
+    sel = DefaultWorkerSelector(overlap_weight=1.0, temperature=0.0)
+    sched = KvScheduler(sel)
+    from dynamo_tpu.router.indexer import OverlapScores
+
+    overlaps = OverlapScores(scores={1: 8}, total_blocks=10)
+    loads = {
+        1: WorkerLoad(worker_id=1, active_blocks=0, total_blocks=100),
+        2: WorkerLoad(worker_id=2, active_blocks=0, total_blocks=100),
+    }
+    assert sched.schedule(10, overlaps, loads) == 1  # cache hit wins
+    # but a hammered worker loses despite overlap
+    loads[1] = WorkerLoad(worker_id=1, active_blocks=50, total_blocks=100)
+    assert sched.schedule(10, overlaps, loads) == 2
+
+
+def test_active_sequences_predict_and_free():
+    act = ActiveSequences()
+    act.add_request("r1", 1, prefill_blocks=8, overlap_blocks=2)
+    act.add_request("r2", 1, prefill_blocks=4, overlap_blocks=0)
+    assert act.active_blocks(1) == 14
+    act.free("r1")
+    assert act.active_blocks(1) == 4
+    orphans = act.remove_worker(1)
+    assert orphans == ["r2"]
+    assert act.active_blocks(1) == 0
+
+
+def test_approx_indexer_ttl():
+    ax = ApproxKvIndexer(ttl_s=10.0)
+    h = [5, 6, 7]
+    ax.note_routed(h, worker_id=1, now=100.0)
+    s = ax.find_matches(h, now=105.0)
+    assert s.scores.get(1) == 3
+    s = ax.find_matches(h, now=111.0)  # expired
+    assert 1 not in s.scores
+
+
+def test_kv_router_end_to_end_decision():
+    r = KvRouter(KvRouterConfig(block_size=4))
+    tokens = list(range(10, 30))  # 5 blocks
+    hashes = compute_block_hashes_for_tokens(tokens, 4)
+    # worker 7 already has the first 4 blocks
+    r.apply_events([stored(7, hashes[:4])])
+    wid, overlap = r.find_best_match("req1", tokens, worker_ids=[7, 8])
+    assert wid == 7 and overlap == 4
+    # Second identical request while req1 is in flight: worker 7 now carries
+    # 5 predicted active blocks (cost 1+5=6) vs worker 8's cold cost 5 —
+    # the formula load-balances away from the busy cache holder.
+    wid2, _ = r.find_best_match("req2", tokens, worker_ids=[7, 8])
+    assert wid2 == 8
+    r.complete("req1")
+    r.complete("req2")
+    assert r.active.active_blocks(7) == 0
+    # With req1 drained, overlap wins again.
+    wid3, _ = r.find_best_match("req3", tokens, worker_ids=[7, 8])
+    assert wid3 == 7
+    r.complete("req3")
