@@ -112,6 +112,14 @@ class ModelRunner:
         self.keys = jax.vmap(jax.random.key_data)(base).astype(jnp.uint32)
         self._step_fns: dict[tuple[int, int, int], Callable] = {}
         self.max_nblk = -(-engine_cfg.max_model_len // engine_cfg.block_size)
+        from dynamo_tpu.ops.paged_attention import select_attn_impl
+
+        self.attn_impl = select_attn_impl(engine_cfg.attn_impl)
+        if self.attn_impl == "pallas" and mesh is not None and mesh.shape.get("model", 1) > 1:
+            # The kernel is not yet shard_map-wrapped; TP meshes use the
+            # dense path (XLA partitions the gather+matmul over "model").
+            log.info("pallas attention disabled under TP mesh; using dense path")
+            self.attn_impl = "dense"
 
     def _auto_num_blocks(self) -> int:
         """Size the device KV pool from free memory (TPU) or a small default."""
@@ -136,9 +144,12 @@ class ModelRunner:
         cfg = self.cfg
         trash_row = self.engine_cfg.max_batch_size
 
+        attn_impl = self.attn_impl
+
         def step(params, ck, cv, counts, keys, tokens, q_start, q_len, bt, slots,
                  temp, top_k, top_p, fp, pp, rp, do_sample):
-            hidden, ck, cv = llama.forward(params, cfg, tokens, q_start, q_len, bt, ck, cv)
+            hidden, ck, cv = llama.forward(params, cfg, tokens, q_start, q_len, bt, ck, cv,
+                                           attn_impl=attn_impl)
             logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
             st = SamplingState(
                 temperature=temp, top_k=top_k, top_p=top_p,

@@ -47,6 +47,12 @@ class Migration:
                 # stream ended without finish_reason → treat as broken
                 raise StreamError("stream ended without finish reason")
             except (StreamError, NoInstancesError, ConnectionError, OSError) as exc:
+                if finished:
+                    # The final chunk (finish_reason set) already reached the
+                    # client; the failure was only the stream teardown (e.g.
+                    # END frame lost). Re-dispatching would emit duplicate
+                    # tokens after the finish chunk.
+                    return
                 attempts += 1
                 if attempts > self.migration_limit:
                     log.warning("migration limit reached for %s: %s", req.request_id, exc)

@@ -119,6 +119,9 @@ class MockEngine:
             # admit
             while self.waiting and len(self.running) < a.max_batch_size:
                 seq = self.waiting[0]
+                if seq.done:  # client walked away before admission
+                    self.waiting.pop(0)
+                    continue
                 hashes = seq.block_seq.sequence_hashes()
                 matchable = max((len(seq.req.token_ids) - 1) // a.block_size, 0)
                 matched = self.pool.match_prefix(hashes[:matchable])
@@ -127,6 +130,16 @@ class MockEngine:
                     fresh = self.pool.allocate(max(need, 0))
                 except NoFreeBlocks:
                     self.pool.release(matched)
+                    if not self.running:
+                        # Nothing running ⇒ no blocks will ever free up: the
+                        # request is simply too large for the pool. Fail it
+                        # rather than busy-spinning on admission forever.
+                        self.waiting.pop(0)
+                        seq.done = True
+                        seq.queue.put_nowait(LLMEngineOutput(
+                            finish_reason=FinishReason.ERROR,
+                            error="request needs more KV blocks than the pool holds"))
+                        continue
                     break
                 seq.block_ids = matched + fresh
                 seq.cached_blocks = len(matched)
@@ -162,7 +175,11 @@ class MockEngine:
                             continue  # starved this step; retried next step
                     self._emit_token(seq)
                     self._commit(seq, total - 1)
-            await asyncio.sleep(0)
+                continue
+            # Neither prefills nor decodes ran: waiting requests are blocked
+            # on KV blocks held by running-but-stalled sequences. Yield a real
+            # tick so the loop doesn't spin hot.
+            await asyncio.sleep(a.decode_itl_ms / 1e3 / a.speedup_ratio)
 
     def _emit_token(self, seq: _MockSeq) -> None:
         tok = self._token_for(seq.req.request_id, seq.generated)

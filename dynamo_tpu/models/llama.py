@@ -244,6 +244,7 @@ def forward(
     block_tables: jax.Array,  # [B, NBLK] int32 block ids into the cache
     cache_k: jax.Array,      # [L, NB, BS, KH, D]
     cache_v: jax.Array,
+    attn_impl: str = "dense",
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One engine step. Returns (last_hidden [B,H], cache_k, cache_v).
 
@@ -277,9 +278,17 @@ def forward(
         k = rope(k, positions, cfg.rope_theta)
         ck = _scatter_kv(ck, k, slot)
         cv = _scatter_kv(cv, v, slot)
-        ctx_k = _gather_kv(ck, block_tables)
-        ctx_v = _gather_kv(cv, block_tables)
-        attn = paged_attention(q, ctx_k, ctx_v, positions, kv_lens)
+        if attn_impl in ("pallas", "pallas_interpret"):
+            from dynamo_tpu.ops.paged_attention import paged_attention_kernel
+
+            attn = paged_attention_kernel(
+                q, ck, cv, block_tables, q_start, kv_lens,
+                interpret=(attn_impl == "pallas_interpret"),
+            )
+        else:
+            ctx_k = _gather_kv(ck, block_tables)
+            ctx_v = _gather_kv(cv, block_tables)
+            attn = paged_attention(q, ctx_k, ctx_v, positions, kv_lens)
         attn = attn.reshape(b, t, cfg.q_size) @ lp["wo"]
         hid = hid + attn
         x = rms_norm(hid, lp["mlp_norm"], cfg.rms_norm_eps)

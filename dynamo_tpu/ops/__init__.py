@@ -1,0 +1,13 @@
+"""TPU hot-op kernels (Pallas) with portable fallbacks.
+
+The reference keeps its one hand-written kernel in CUDA
+(lib/llm/src/kernels/block_copy.cu); here the hot ops are Pallas TPU
+kernels with numerically-equivalent XLA fallbacks for CPU tests:
+
+- paged_attention: flash-style attention over a block-table-paged KV cache.
+- ring_attention: blockwise attention sharded over the "seq" mesh axis.
+"""
+
+from dynamo_tpu.ops.paged_attention import paged_attention_kernel, select_attn_impl
+
+__all__ = ["paged_attention_kernel", "select_attn_impl"]

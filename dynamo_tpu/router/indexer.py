@@ -90,12 +90,11 @@ class RadixIndexer:
             if node is None or not node.workers:
                 break
             holders = node.workers if active is None else (active & node.workers)
-            # workers that dropped out keep their previous depth
-            active = holders if holders else set()
+            if not holders:
+                break  # workers that dropped out keep their previous depth
+            active = holders
             for w in holders:
                 out.scores[w] = depth
-            if not holders:
-                break
         return out
 
     # ------------------------------------------------------------------

@@ -138,10 +138,13 @@ class KvPushRouter:
                 log.exception("bad metrics payload")
 
     async def _instance_gc_loop(self) -> None:
-        """Purge router state for workers whose instances vanished."""
+        """Purge router state for workers whose instances vanished from
+        discovery. Uses known_instance_ids (NOT the quarantine-filtered
+        list): a transient dial failure must not erase a live worker's
+        radix index — only lease expiry removes an instance."""
         while True:
             await asyncio.sleep(0.5)
-            live = set(self.client.instance_ids())
+            live = set(self.client.known_instance_ids())
             for wid in self._known_workers - live:
                 log.info("purging dead worker %x from router state", wid)
                 self.router.remove_worker(wid)
@@ -154,13 +157,24 @@ class KvPushRouter:
         wid, overlap = self.router.find_best_match(req.request_id, req.token_ids, worker_ids)
         req.estimated_prefix_hit_blocks = overlap
         first = True
+        # Track real KV block growth during decode so the load predictor sees
+        # long generations (reference: sequence.rs decode-block accounting).
+        bs = self.router.config.block_size
+        prompt_len = len(req.token_ids)
+        gen_tokens = 0
+        seen_blocks = -(-prompt_len // bs)
         try:
             async for item in self.client.generate_direct(req.to_dict(), wid, req.request_id):
                 if first:
                     self.router.active.mark_prefill_complete(req.request_id)
                     first = False
-                else:
-                    self.router.active.note_decode_progress(req.request_id, 0)
+                if isinstance(item, dict):
+                    gen_tokens += len(item.get("token_ids") or [])
+                total_blocks_now = -(-(prompt_len + gen_tokens) // bs)
+                if total_blocks_now > seen_blocks:
+                    self.router.active.note_decode_progress(
+                        req.request_id, total_blocks_now - seen_blocks)
+                    seen_blocks = total_blocks_now
                 yield item
         finally:
             self.router.complete(req.request_id)

@@ -69,6 +69,12 @@ class KvEventPublisher:
         payload = msgpack.packb([e.to_dict() for e in batch], use_bin_type=True)
         try:
             await self.client.publish(self.subject, payload)
+        except asyncio.CancelledError:
+            # Re-queue the detached batch so stop()'s final flush sends it —
+            # cancellation mid-publish must not lose BlockStored/Removed
+            # events (routers would keep stale index entries).
+            self._buffer = batch + self._buffer
+            raise
         except Exception:
             log.exception("kv event publish failed (%d events dropped)", len(batch))
 
@@ -76,6 +82,10 @@ class KvEventPublisher:
         self._stopped = True
         if self._task:
             self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
         await self.flush()
 
 

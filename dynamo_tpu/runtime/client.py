@@ -150,6 +150,12 @@ class EndpointClient:
         now = asyncio.get_event_loop().time()
         return sorted(i for i in self.instances if self._quarantine.get(i, 0.0) <= now)
 
+    def known_instance_ids(self) -> list[int]:
+        """All registered instances, including quarantined ones. Use for
+        liveness decisions (a quarantined instance is still discovered —
+        only a lease expiry actually removes it)."""
+        return sorted(self.instances)
+
     # ------------------------------------------------------------------
     async def _connect(self, inst: Instance) -> _WorkerConnection:
         wc = self._conns.get(inst.address)

@@ -141,11 +141,24 @@ class _Session:
     conn: MsgpackConnection
     watches: dict[int, str] = field(default_factory=dict)      # watch_id -> prefix
     subscriptions: dict[int, str] = field(default_factory=dict)  # sub_id -> subject pattern
+    # Server→client pushes go through this queue, drained by a per-session
+    # sender task, so a stalled client can never block a broadcast for the
+    # whole cluster (its queue fills and it gets dropped instead).
+    outbox: "asyncio.Queue[dict]" = field(default_factory=lambda: asyncio.Queue(maxsize=8192))
+    sender: asyncio.Task | None = None
     _next_id: int = 0
 
     def next_id(self) -> int:
         self._next_id += 1
         return self._next_id
+
+    def enqueue(self, msg: dict) -> bool:
+        """Non-blocking push send; False when the client is stalled (full)."""
+        try:
+            self.outbox.put_nowait(msg)
+            return True
+        except asyncio.QueueFull:
+            return False
 
 
 class CoordinatorServer:
@@ -189,34 +202,54 @@ class CoordinatorServer:
             if events:
                 await self._broadcast_kv_events(events)
 
+    def _drop_session(self, session: _Session, reason: str) -> None:
+        log.warning("dropping coordinator session %s: %s", session.conn.peer, reason)
+        self._sessions.discard(session)
+        if session.sender is not None:
+            session.sender.cancel()
+        session.conn.close()
+
+    async def _sender_loop(self, session: _Session) -> None:
+        """Drain one session's outbox onto its socket."""
+        try:
+            while True:
+                msg = await session.outbox.get()
+                await session.conn.send(msg)
+        except (asyncio.CancelledError, Exception):
+            self._sessions.discard(session)
+            session.conn.close()
+
     async def _broadcast_kv_events(self, events: list[dict]) -> None:
+        # Enqueues only (no awaited sends) under the lock: per-session order
+        # vs watch replay is preserved via the shared outbox, and a wedged
+        # client fills its own queue instead of blocking the cluster.
         async with self._watch_lock:
             for session in list(self._sessions):
                 for wid, prefix in list(session.watches.items()):
-                    hits = [e for e in events if e["key"].startswith(prefix)]
-                    for e in hits:
-                        try:
-                            await session.conn.send({"t": Frame.WATCH_EVENT, "watch_id": wid, **e})
-                        except Exception:
-                            self._sessions.discard(session)
+                    for e in events:
+                        if not e["key"].startswith(prefix):
+                            continue
+                        if not session.enqueue({"t": Frame.WATCH_EVENT, "watch_id": wid, **e}):
+                            self._drop_session(session, "watch outbox full")
+                            break
 
     async def _publish(self, subject: str, payload: bytes) -> int:
         n = 0
         for session in list(self._sessions):
             for sid, pattern in list(session.subscriptions.items()):
                 if fnmatch.fnmatchcase(subject, pattern):
-                    try:
-                        await session.conn.send(
-                            {"t": Frame.PUBSUB_MSG, "sub_id": sid, "subject": subject,
-                             "payload": payload})
+                    if session.enqueue({"t": Frame.PUBSUB_MSG, "sub_id": sid,
+                                        "subject": subject, "payload": payload}):
                         n += 1
-                    except Exception:
-                        self._sessions.discard(session)
+                    else:
+                        self._drop_session(session, "pubsub outbox full")
+                        break
         return n
 
     # ------------------------------------------------------------------
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         session = _Session(conn=MsgpackConnection(reader, writer))
+        session.sender = asyncio.create_task(self._sender_loop(session))
         self._sessions.add(session)
         try:
             while True:
@@ -231,6 +264,8 @@ class CoordinatorServer:
                 task.add_done_callback(self._handler_tasks.discard)
         finally:
             self._sessions.discard(session)
+            if session.sender is not None:
+                session.sender.cancel()
             session.conn.close()
 
     async def _handle(self, session: _Session, msg: dict) -> None:
@@ -267,12 +302,9 @@ class CoordinatorServer:
             wid = msg.get("watch_id") or session.next_id()
             async with self._watch_lock:  # atomic register+replay vs broadcasts
                 session.watches[wid] = msg["prefix"]
-                initial = [
-                    {"op": "put", "key": k, "value": v, "initial": True}
-                    for k, v in st.get_prefix(msg["prefix"]).items()
-                ]
-                for e in initial:
-                    await session.conn.send({"t": Frame.WATCH_EVENT, "watch_id": wid, **e})
+                for k, v in st.get_prefix(msg["prefix"]).items():
+                    session.enqueue({"t": Frame.WATCH_EVENT, "watch_id": wid,
+                                     "op": "put", "key": k, "value": v, "initial": True})
             return {"watch_id": wid}
         if op == "unwatch":
             session.watches.pop(msg.get("watch_id"), None)

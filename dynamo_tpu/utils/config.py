@@ -98,6 +98,9 @@ class EngineConfig:
     enable_prefix_caching: bool = True
     kv_event_publishing: bool = True
     seed: int = 0
+    # Attention implementation: "auto" (pallas on TPU, dense elsewhere),
+    # "dense", "pallas", or "pallas_interpret" (CPU-testable kernel path).
+    attn_impl: str = "auto"
 
     def mesh_shape(self) -> dict[str, int]:
         return {"data": self.dp, "model": self.tp, "expert": self.ep, "seq": self.sp}

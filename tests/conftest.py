@@ -22,11 +22,17 @@ os.environ.setdefault("DYN_LOG", "warning")
 # sitecustomize) before this conftest runs; drop it so jax never initializes
 # that backend during tests.
 try:  # pragma: no cover - environment-specific
+    import jax
     from jax._src import xla_bridge as _xb
 
+    # Keep "tpu" registered (pallas lowering registration requires the
+    # platform to be *known*); jax_platforms=cpu stops it initializing.
     for _name in list(getattr(_xb, "_backend_factories", {})):
-        if _name != "cpu":
+        if _name not in ("cpu", "tpu"):
             _xb._backend_factories.pop(_name, None)
+    # The plugin may have set jax_platforms programmatically before this
+    # conftest ran; the env var alone does not override that.
+    jax.config.update("jax_platforms", "cpu")
 except Exception:
     pass
 
