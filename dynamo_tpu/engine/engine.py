@@ -145,11 +145,12 @@ class ModelRunner:
         trash_row = self.engine_cfg.max_batch_size
 
         attn_impl = self.attn_impl
+        moe_impl = "ep" if self.engine_cfg.ep > 1 else "dense"
 
         def step(params, ck, cv, counts, keys, tokens, q_start, q_len, bt, slots,
                  temp, top_k, top_p, fp, pp, rp, do_sample):
             hidden, ck, cv = llama.forward(params, cfg, tokens, q_start, q_len, bt, ck, cv,
-                                           attn_impl=attn_impl)
+                                           attn_impl=attn_impl, moe_impl=moe_impl)
             logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
             st = SamplingState(
                 temperature=temp, top_k=top_k, top_p=top_p,

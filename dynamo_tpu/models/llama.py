@@ -245,6 +245,7 @@ def forward(
     cache_k: jax.Array,      # [L, NB, BS, KH, D]
     cache_v: jax.Array,
     attn_impl: str = "dense",
+    moe_impl: str = "dense",
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One engine step. Returns (last_hidden [B,H], cache_k, cache_v).
 
@@ -293,7 +294,12 @@ def forward(
         hid = hid + attn
         x = rms_norm(hid, lp["mlp_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:
-            mlp_out = moe_mlp(x, lp, cfg)
+            if moe_impl == "ep":
+                from dynamo_tpu.models.moe import moe_mlp_ep
+
+                mlp_out = moe_mlp_ep(x, lp, cfg)
+            else:
+                mlp_out = moe_mlp(x, lp, cfg)
         else:
             mlp_out = swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
         hid = hid + mlp_out

@@ -1,0 +1,88 @@
+"""Expert-parallel MoE dispatch (GSPMD capacity-based all-to-all).
+
+The reference only passes wide-EP flags through to SGLang/vLLM
+(SURVEY.md §2.7: TEP16/DEP16 recipes, e.g. recipes/deepseek-r1/sglang-wideep);
+the expert math itself is ours. This is the TPU-idiomatic formulation:
+tokens are dispatched to experts through a capacity-bounded one-hot dispatch
+tensor, and the three einsums below — dispatch, expert FFN, combine — are
+written so that with ``w_gate/w_up/w_down`` sharded on the "expert" mesh
+axis, GSPMD inserts the token all-to-alls automatically (the scaling-book
+recipe: annotate shardings, let XLA place collectives on ICI).
+
+Equivalence: with enough capacity (no dropped tokens) the result equals the
+dense-dispatch ``models.llama.moe_mlp``; under pressure, choices over
+capacity are dropped (standard Switch/GShard behavior — their router weight
+simply doesn't contribute, no renormalization).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dynamo_tpu.models.config import ModelConfig
+
+Params = dict
+
+
+def expert_capacity(num_tokens: int, num_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Per-expert token slots, padded to a lane-friendly multiple of 8."""
+    cap = int(num_tokens * top_k / num_experts * capacity_factor) + 1
+    return max(-(-cap // 8) * 8, 8)
+
+
+def moe_mlp_ep(x: jax.Array, lp: Params, cfg: ModelConfig,
+               capacity_factor: float = 2.0) -> jax.Array:
+    """Capacity-based EP MoE FFN. x: [B, T, H] → [B, T, H].
+
+    The dispatch/combine tensors route each token's top-k expert choices to
+    per-expert buffers of C slots; choice order is priority order (a token's
+    1st choice wins slots over another token's 2nd choice at equal index by
+    flattened position).
+    """
+    b, t, h = x.shape
+    n = b * t
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    xt = x.reshape(n, h)
+    logits = xt.astype(jnp.float32) @ lp["router"].astype(jnp.float32)   # [N, E]
+    topv, topi = lax.top_k(logits, k)                                    # [N, k]
+    weights = jax.nn.softmax(topv, axis=-1)                              # [N, k]
+
+    cap = expert_capacity(n, e, k, capacity_factor)
+    # Position of each (choice, token) within its expert's buffer. Flatten
+    # choice-major so every token's 1st choice outranks all 2nd choices.
+    oh = jax.nn.one_hot(topi.T.reshape(k * n), e, dtype=jnp.int32)       # [kN, E]
+    pos = jnp.cumsum(oh, axis=0) * oh - 1                                # [kN, E]
+    pos_in_e = jnp.max(pos, axis=1)                                      # [kN]
+    keep = (pos_in_e >= 0) & (pos_in_e < cap)
+    pos_in_e = jnp.where(keep, pos_in_e, 0)
+
+    # Back to [N, k] layout.
+    keep = keep.reshape(k, n).T
+    pos_nk = pos_in_e.reshape(k, n).T                                    # [N, k]
+
+    # dispatch[n, e, c] = 1 where token n's choice lands in slot c of expert e
+    slot_oh = jax.nn.one_hot(pos_nk, cap, dtype=jnp.float32)             # [N, k, C]
+    exp_oh = jax.nn.one_hot(topi, e, dtype=jnp.float32)                  # [N, k, E]
+    keep_f = keep.astype(jnp.float32)[..., None]
+    dispatch = jnp.einsum("nke,nkc->nec", exp_oh, slot_oh * keep_f)      # [N, E, C]
+    combine = jnp.einsum("nke,nkc->nec", exp_oh * (weights * keep)[..., None],
+                         slot_oh)                                        # [N, E, C]
+
+    # Expert buffers [E, C, H]: sharded on "expert" with the weights; GSPMD
+    # turns the N↔(E,C) einsums into token all-to-alls over ICI.
+    expert_in = jnp.einsum("nec,nh->ech", dispatch, xt.astype(jnp.float32))
+    expert_in = expert_in.astype(x.dtype)
+    gate = jnp.einsum("ech,ehm->ecm", expert_in, lp["w_gate"])
+    up = jnp.einsum("ech,ehm->ecm", expert_in, lp["w_up"])
+    act = jax.nn.silu(gate) * up
+    out_e = jnp.einsum("ecm,emh->ech", act, lp["w_down"])                # [E, C, H]
+    y = jnp.einsum("nec,ech->nh", combine, out_e.astype(jnp.float32)).astype(x.dtype)
+
+    if cfg.num_shared_experts:
+        from dynamo_tpu.models.llama import swiglu
+
+        y = y + swiglu(xt, lp["shared_gate"], lp["shared_up"], lp["shared_down"])
+    return y.reshape(b, t, h)

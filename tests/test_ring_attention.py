@@ -1,0 +1,81 @@
+"""Ring attention vs dense causal attention on an 8-device virtual CPU mesh.
+
+Sequence parallelism is greenfield in this framework (SURVEY.md §2.7: the
+reference has none) — correctness is defined by equivalence with dense
+global causal attention.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.ops.ring_attention import ring_attention_sharded
+
+
+def _dense_causal(q, k, v, kv_len=None):
+    b, t, h, d = q.shape
+    kh = k.shape[2]
+    rep = h // kh
+    qf = (q.astype(jnp.float32) * d**-0.5).reshape(b, t, kh, rep, d)
+    scores = jnp.einsum("btkrd,bskd->btkrs", qf, k.astype(jnp.float32))
+    pos = jnp.arange(t)
+    visible = pos[None, :, None] >= pos[None, None, :]
+    if kv_len is not None:
+        visible = visible & (pos[None, None, :] < kv_len[:, None, None])
+    scores = jnp.where(visible[:, :, None, None, :], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("btkrs,bskd->btkrd", p, v.astype(jnp.float32))
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    devs = np.asarray(jax.devices()[:8]).reshape(8)
+    return Mesh(devs, ("seq",))
+
+
+@pytest.mark.parametrize("h,kh", [(4, 4), (8, 2)])
+def test_ring_attention_matches_dense(seq_mesh, h, kh):
+    rng = np.random.default_rng(0)
+    b, t, d = 2, 64, 32  # t split 8 ways -> 8 per device
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, kh, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, kh, d)), jnp.float32)
+    fn = ring_attention_sharded(seq_mesh)
+    out = fn(q, k, v)
+    ref = _dense_causal(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_ragged_kv_len(seq_mesh):
+    rng = np.random.default_rng(1)
+    b, t, h, kh, d = 2, 64, 4, 4, 32
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, kh, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, kh, d)), jnp.float32)
+    kv_len = jnp.asarray([40, 64], jnp.int32)
+    fn = ring_attention_sharded(seq_mesh)
+    out = np.asarray(fn(q, k, v, kv_len))
+    ref = np.asarray(_dense_causal(q, k, v, kv_len))
+    # Only rows within kv_len are meaningful for row 0.
+    np.testing.assert_allclose(out[0, :40], ref[0, :40], atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(out[1], ref[1], atol=2e-5, rtol=2e-5)
+    assert np.isfinite(out).all()
+
+
+def test_ring_attention_sharded_inputs_stay_sharded(seq_mesh):
+    """Inputs placed with a seq sharding run without resharding errors and
+    produce a seq-sharded output."""
+    rng = np.random.default_rng(2)
+    b, t, h, d = 1, 32, 4, 32
+    sharding = NamedSharding(seq_mesh, P(None, "seq", None, None))
+    q = jax.device_put(jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32), sharding)
+    k = jax.device_put(jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32), sharding)
+    v = jax.device_put(jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32), sharding)
+    fn = ring_attention_sharded(seq_mesh)
+    out = fn(q, k, v)
+    assert out.sharding.spec == P(None, "seq", None, None)
+    ref = _dense_causal(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
