@@ -40,6 +40,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--speedup-ratio", type=float, default=10.0, help="mocker only")
     p.add_argument("--no-kv-events", action="store_true")
+    p.add_argument("--host-kv-blocks", type=int, default=0, help="G2 host KV tier capacity")
+    p.add_argument("--disk-kv-path", default=None, help="G3 disk KV tier directory")
     return p.parse_args(argv)
 
 
@@ -91,6 +93,8 @@ async def amain(ns: argparse.Namespace) -> None:
             max_batch_size=ns.max_batch_size,
             max_model_len=ns.max_model_len,
             tp=ns.tp,
+            host_kv_blocks=ns.host_kv_blocks,
+            disk_kv_path=ns.disk_kv_path,
         ), event_sink=sink))
         stats_fn = engine.stats
 

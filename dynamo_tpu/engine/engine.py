@@ -21,7 +21,10 @@ import threading
 import time
 from dataclasses import dataclass, field
 from functools import partial
-from typing import AsyncIterator, Callable
+from typing import TYPE_CHECKING, AsyncIterator, Callable
+
+if TYPE_CHECKING:
+    from dynamo_tpu.kvbm.offload import OffloadManager
 
 import jax
 import jax.numpy as jnp
@@ -266,6 +269,22 @@ class EngineCore:
         self.metrics = EngineMetrics()
         self._seqs: dict[str, Seq] = {}
         self.default_eos: list[int] = []
+        self.kvbm: "OffloadManager | None" = None
+        if engine_cfg.host_kv_blocks > 0 or engine_cfg.disk_kv_path:
+            from dynamo_tpu.kvbm.offload import OffloadManager
+            from dynamo_tpu.kvbm.pools import DiskBlockPool, HostBlockPool
+
+            disk = (DiskBlockPool(self.runner.spec, engine_cfg.disk_kv_path,
+                                  engine_cfg.disk_kv_bytes,
+                                  fingerprint=engine_cfg.model)
+                    if engine_cfg.disk_kv_path else None)
+            tiers: list = []
+            if engine_cfg.host_kv_blocks > 0:
+                tiers.append(HostBlockPool(self.runner.spec, engine_cfg.host_kv_blocks,
+                                           overflow=disk))
+            if disk is not None:
+                tiers.append(disk)
+            self.kvbm = OffloadManager(self.runner, self.pool, tiers)
 
     # ------------------------------------------------------------------
     def add_request(self, req: PreprocessedRequest) -> LLMEngineOutput | None:
@@ -276,13 +295,24 @@ class EngineCore:
             )
         seq = Seq(req=req, block_size=self.engine_cfg.block_size)
         self.sched.add(seq)
-        if seq.phase is Phase.FINISHED:  # rejected (too long)
+        if seq.phase is Phase.FINISHED:  # rejected (too long for model or pool)
             return LLMEngineOutput(
                 finish_reason=FinishReason.ERROR,
-                error=f"prompt of {seq.prompt_len} tokens exceeds max_model_len="
-                      f"{self.engine_cfg.max_model_len}",
+                error=f"prompt of {seq.prompt_len} tokens exceeds capacity "
+                      f"(max_model_len={self.engine_cfg.max_model_len}, "
+                      f"usable_kv_blocks={self.pool.num_blocks - 1})",
             )
         self._seqs[req.request_id] = seq
+        if self.kvbm is not None:
+            # Same matchable cap as the scheduler: leave ≥1 prompt token to
+            # compute so decode has last-position state. Onboarding is an
+            # optimization — a corrupt tier entry must not take down the
+            # engine-core thread (add_request runs outside step()'s guard).
+            cap = (seq.prefill_target() - 1) // seq.block_size
+            try:
+                self.kvbm.onboard(seq.block_seq.sequence_hashes()[:cap])
+            except Exception:
+                log.exception("kvbm onboard failed; continuing without reuse")
         self.metrics.prefix_lookup_blocks += max(len(seq.tokens) // seq.block_size, 1)
         return None
 
@@ -464,7 +494,10 @@ class AsyncJaxEngine:
                 self._wake.set()
 
     def stats(self) -> dict:
-        return self.core.metrics.snapshot(self.core.sched, self.core.pool)
+        out = self.core.metrics.snapshot(self.core.sched, self.core.pool)
+        if self.core.kvbm is not None:
+            out["kvbm"] = self.core.kvbm.snapshot()
+        return out
 
 
 def build_engine(engine_cfg: EngineConfig, mesh=None, params=None,

@@ -33,6 +33,10 @@ class PrefixPool:
         self.block_size = block_size
         self.enable_prefix_caching = enable_prefix_caching
         self._event_sink = event_sink
+        # Called as evict_hook(block_id, seq_hash) *before* an evicted
+        # committed block's id is reused — the KVBM offload manager's
+        # write-back point (dynamo_tpu.kvbm.offload).
+        self.evict_hook: Callable[[int, int], None] | None = None
         # block 0 reserved (trash)
         self._free: list[int] = list(range(num_blocks - 1, 0, -1))
         self._refcount: dict[int, int] = {}
@@ -51,6 +55,17 @@ class PrefixPool:
 
     def cached_block_count(self) -> int:
         return len(self._by_hash)
+
+    def has_hash(self, seq_hash: int) -> bool:
+        return seq_hash in self._by_hash
+
+    def touch(self, seq_hash: int) -> None:
+        """Refresh an inactive cached block to MRU so an imminent allocation
+        burst doesn't evict it (used by KVBM onboarding to protect the
+        on-device part of a chain it is about to extend)."""
+        bid = self._by_hash.get(seq_hash)
+        if bid is not None and bid in self._inactive:
+            self._inactive.move_to_end(bid)
 
     # -- events --------------------------------------------------------------
     def _emit(self, ev: KvCacheEvent) -> None:
@@ -77,6 +92,8 @@ class PrefixPool:
         bid, _ = self._inactive.popitem(last=False)  # oldest
         h = self._hash_of.pop(bid, None)
         if h is not None:
+            if self.evict_hook is not None:
+                self.evict_hook(bid, h)
             del self._by_hash[h]
             self._emit(BlockRemoved(block_hashes=(h,)))
         return bid
@@ -134,6 +151,11 @@ class PrefixPool:
 
     def clear(self) -> None:
         """Drop all cached (inactive) blocks — admin /clear_kv_blocks
-        (reference: http/service/clear_kv_blocks.rs)."""
-        while self._inactive:
-            self._free.append(self._evict_one())
+        (reference: http/service/clear_kv_blocks.rs). A deliberate clear
+        drops content outright (no write-back offload)."""
+        hook, self.evict_hook = self.evict_hook, None
+        try:
+            while self._inactive:
+                self._free.append(self._evict_one())
+        finally:
+            self.evict_hook = hook

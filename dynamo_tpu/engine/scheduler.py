@@ -119,6 +119,12 @@ class Scheduler:
             seq.phase = Phase.FINISHED
             seq.finish_reason = FinishReason.ERROR
             return
+        # A prompt that can't fit even into an *empty* pool would wait
+        # forever — reject it up front (+1: decode needs room to grow).
+        if seq.blocks_needed(seq.prompt_len + 1) > self.pool.num_blocks - 1:
+            seq.phase = Phase.FINISHED
+            seq.finish_reason = FinishReason.ERROR
+            return
         self.waiting.append(seq)
 
     def has_work(self) -> bool:

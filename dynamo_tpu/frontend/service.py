@@ -71,6 +71,7 @@ class HttpService:
         self.app.router.add_get("/live", self.live)
         self.app.router.add_get("/metrics", self.metrics_handler)
         self.app.router.add_post("/clear_kv_blocks", self.clear_kv_blocks)
+        self.app.router.add_get("/engine_stats", self.engine_stats)
         self._runner: web.AppRunner | None = None
         self.port: int = 0
 
@@ -97,6 +98,17 @@ class HttpService:
 
     async def metrics_handler(self, request: web.Request) -> web.Response:
         return web.Response(text=self.metrics.expose(), content_type="text/plain")
+
+    async def engine_stats(self, request: web.Request) -> web.Response:
+        """Per-model engine stats (scheduler depth, KV usage, KVBM tiers) —
+        the role of the reference's system status server
+        (reference: lib/runtime/src/system_status_server.rs)."""
+        out = {}
+        for name in self.models.names():
+            entry = self.models.get(name)
+            if entry and entry.stats:
+                out[name] = entry.stats()
+        return web.json_response(out)
 
     async def list_models(self, request: web.Request) -> web.Response:
         data = ModelList(data=[ModelInfo(id=n) for n in self.models.names()])

@@ -1,0 +1,27 @@
+"""KVBM — tiered KV block manager.
+
+Fills the role of the reference's KV Block Manager
+(reference: lib/llm/src/block_manager.rs:63-103, CacheLevel G1-G4):
+
+- **G1 (device)** lives in the engine: the paged ``jax.Array`` cache plus
+  the refcounted :class:`~dynamo_tpu.engine.prefix_pool.PrefixPool`.
+- **G2 (host)** — :class:`HostBlockPool`: a preallocated pinned-host numpy
+  arena keyed by sequence hash with LRU eviction.
+- **G3 (disk)** — :class:`DiskBlockPool`: file-per-block local-disk tier
+  with a byte budget; persists across engine restarts (the reference's
+  "KV survives restart only at G3/G4", SURVEY.md §5).
+- **Offload manager** — :class:`OffloadManager`: write-back offload when the
+  device pool evicts a committed block, and onboarding of host/disk-cached
+  prefixes back onto the device at request admission
+  (reference: lib/llm/src/block_manager/offload.rs).
+
+On TPU the device↔host copies ride XLA gather/scatter + DMA
+(``jax.device_get``/``device_put``) instead of the reference's CUDA
+``block_copy.cu`` kernel — see :mod:`dynamo_tpu.kvbm.transfer`.
+"""
+
+from dynamo_tpu.kvbm.offload import OffloadManager
+from dynamo_tpu.kvbm.pools import DiskBlockPool, HostBlockPool
+from dynamo_tpu.kvbm.transfer import BlockTransferEngine
+
+__all__ = ["BlockTransferEngine", "DiskBlockPool", "HostBlockPool", "OffloadManager"]

@@ -1,0 +1,117 @@
+"""Offload manager: G1↔G2↔G3 block movement policy.
+
+Reference: lib/llm/src/block_manager/offload.rs (offload manager with
+priority queues + transfer managers). Two flows:
+
+- **Offload (write-back)**: when the device PrefixPool evicts a committed
+  block under allocation pressure, its contents are pulled off the device
+  *before the slot is reused* and stored in the first tier; tiers cascade
+  their own LRU victims downward (host→disk).
+- **Onboard**: at request admission the engine asks for the prompt's block
+  hashes; hashes missing from the device pool but present in a tier are
+  batch-injected into freshly allocated device blocks and committed as
+  matchable (inactive) cache entries, so the scheduler's normal prefix
+  match then reuses them — TTFT win without touching scheduler logic
+  (reference: connector/scheduler.rs onboarding decisions).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from dynamo_tpu.engine.errors import NoFreeBlocks
+from dynamo_tpu.engine.prefix_pool import PrefixPool
+from dynamo_tpu.kvbm.transfer import BlockTransferEngine
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("kvbm")
+
+
+@dataclass
+class OffloadStats:
+    offloaded_blocks: int = 0
+    onboarded_blocks: int = 0
+
+    def to_dict(self) -> dict:
+        return {"offloaded_blocks": self.offloaded_blocks,
+                "onboarded_blocks": self.onboarded_blocks}
+
+
+class OffloadManager:
+    """Ties the engine's device cache + PrefixPool to host/disk tiers.
+
+    ``runner`` is duck-typed: needs mutable ``cache_k``/``cache_v`` jax
+    arrays (this manager replaces them on inject — the inject program
+    donates its inputs, mirroring the engine step functions).
+    """
+
+    def __init__(self, runner, pool: PrefixPool, tiers: list):
+        assert tiers, "OffloadManager needs at least one tier"
+        self.runner = runner
+        self.pool = pool
+        self.tiers = tiers
+        self.transfer = BlockTransferEngine()
+        self.stats = OffloadStats()
+        pool.evict_hook = self._on_evict
+
+    # -- offload -----------------------------------------------------------
+    def _on_evict(self, block_id: int, seq_hash: int) -> None:
+        top = self.tiers[0]
+        if seq_hash in top:
+            return
+        [block] = self.transfer.extract(self.runner.cache_k, self.runner.cache_v, [block_id])
+        top.put(seq_hash, block)
+        self.stats.offloaded_blocks += 1
+
+    # -- onboard -----------------------------------------------------------
+    def _lookup(self, seq_hash: int) -> np.ndarray | None:
+        for tier in self.tiers:
+            block = tier.get(seq_hash)
+            if block is not None:
+                return block
+        return None
+
+    def onboard(self, seq_hashes: list[int]) -> int:
+        """Bring the longest tier-cached prefix of ``seq_hashes`` onto the
+        device. Returns the number of blocks injected."""
+        plan: list[tuple[int, int | None, np.ndarray]] = []  # (hash, parent, data)
+        parent: int | None = None
+        for h in seq_hashes:
+            if self.pool.has_hash(h):
+                # Already on device: refresh to MRU so the allocation below
+                # doesn't evict the head of the very chain we're extending
+                # (which would make the injected tail unmatchable).
+                self.pool.touch(h)
+                parent = h
+                continue
+            block = self._lookup(h)
+            if block is None:
+                break
+            plan.append((h, parent, block))
+            parent = h
+        if not plan:
+            return 0
+        try:
+            # May evict inactive device blocks → reentrant _on_evict (safe:
+            # the evicted blocks are disjoint from the ones being loaded,
+            # and tier.get returned copies).
+            block_ids = self.pool.allocate(len(plan))
+        except NoFreeBlocks:
+            return 0
+        self.runner.cache_k, self.runner.cache_v = self.transfer.inject(
+            self.runner.cache_k, self.runner.cache_v,
+            block_ids, [data for _, _, data in plan],
+        )
+        for bid, (h, par, _) in zip(block_ids, plan):
+            self.pool.commit(bid, h, par)
+        self.pool.release(block_ids)  # park as matchable inactive blocks
+        self.stats.onboarded_blocks += len(plan)
+        return len(plan)
+
+    def snapshot(self) -> dict:
+        out = self.stats.to_dict()
+        for tier in self.tiers:
+            out[tier.name] = {"blocks": len(tier), **tier.stats.to_dict()}
+        return out

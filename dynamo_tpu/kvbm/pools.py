@@ -1,0 +1,187 @@
+"""Host (G2) and disk (G3) KV block tiers.
+
+Reference: lib/llm/src/block_manager/ — pinned-host and local-disk pools
+with layouts + a sequence-hash registry (block/registry.rs:478) and
+inactive-pool LRU eviction (pool/managed.rs). Here each tier is a plain
+hash→block store:
+
+- key: the block's *sequence hash* (chained prefix identity from
+  dynamo_tpu.tokens) — the same global identity the KV router uses, so a
+  block cached anywhere is addressable from everywhere.
+- value: one host block ``[2, layers, block_size, kv_heads, head_dim]``
+  (see dynamo_tpu.kvbm.transfer).
+
+Tiers chain: the host pool spills its LRU victim to an optional overflow
+tier (disk) instead of dropping it — the reference's offload cascade
+G1→G2→G3 (block_manager/offload.rs priority queues).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.cache import KVCacheSpec
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("kvbm")
+
+
+@dataclass
+class TierStats:
+    lookups: int = 0
+    hits: int = 0
+    stores: int = 0
+    evictions: int = 0
+
+    def to_dict(self) -> dict:
+        return {"lookups": self.lookups, "hits": self.hits,
+                "stores": self.stores, "evictions": self.evictions}
+
+
+def block_shape(spec: KVCacheSpec) -> tuple[int, int, int, int, int]:
+    return (2, spec.num_layers, spec.block_size, spec.num_kv_heads, spec.head_dim)
+
+
+class HostBlockPool:
+    """Preallocated host-memory arena of KV blocks with LRU eviction.
+
+    One contiguous numpy allocation (the pinned-host analog of the
+    reference's G2 pool) — blocks are slots in the arena, never
+    realloc'd, so offload traffic causes no host allocator churn.
+    """
+
+    name = "host"
+
+    def __init__(
+        self,
+        spec: KVCacheSpec,
+        capacity_blocks: int,
+        overflow: "DiskBlockPool | None" = None,
+    ):
+        self.spec = spec
+        self.capacity = capacity_blocks
+        self.overflow = overflow
+        self._arena = np.zeros((capacity_blocks, *block_shape(spec)), jnp.dtype(spec.dtype))
+        self._free: list[int] = list(range(capacity_blocks - 1, -1, -1))
+        self._lru: OrderedDict[int, int] = OrderedDict()  # seq_hash -> slot, LRU order
+        self.stats = TierStats()
+
+    def __contains__(self, seq_hash: int) -> bool:
+        return seq_hash in self._lru
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def put(self, seq_hash: int, block: np.ndarray) -> None:
+        if seq_hash in self._lru:
+            self._lru.move_to_end(seq_hash)
+            return
+        if not self._free:
+            victim_hash, victim_slot = self._lru.popitem(last=False)
+            self.stats.evictions += 1
+            if self.overflow is not None:
+                self.overflow.put(victim_hash, self._arena[victim_slot])
+            self._free.append(victim_slot)
+        slot = self._free.pop()
+        self._arena[slot] = block
+        self._lru[seq_hash] = slot
+        self.stats.stores += 1
+
+    def get(self, seq_hash: int) -> np.ndarray | None:
+        """Return a *copy* of the block (the arena slot may be recycled by a
+        later put while the caller still holds the data — e.g. onboarding
+        triggers device evictions that write back into this pool)."""
+        self.stats.lookups += 1
+        slot = self._lru.get(seq_hash)
+        if slot is None:
+            return None
+        self._lru.move_to_end(seq_hash)
+        self.stats.hits += 1
+        return self._arena[slot].copy()
+
+
+class DiskBlockPool:
+    """Local-disk KV block tier (G3): one file per block, byte-budgeted LRU.
+
+    Files are named ``<seq_hash:016x>.kvb`` and contain the raw block bytes;
+    the index is rebuilt from the directory on startup so cached KV survives
+    engine restarts (reference: SURVEY.md §5 checkpoint/resume — "KV cache
+    survives engine restart only at G3/G4").
+    """
+
+    name = "disk"
+
+    def __init__(
+        self,
+        spec: KVCacheSpec,
+        path: str | Path,
+        capacity_bytes: int = 1 << 30,
+        fingerprint: str = "",
+    ):
+        self.spec = spec
+        self.path = Path(path)
+        self.path.mkdir(parents=True, exist_ok=True)
+        self.capacity_bytes = capacity_bytes
+        self._block_bytes = int(np.prod(block_shape(spec))) * jnp.dtype(spec.dtype).itemsize
+        self._lru: OrderedDict[int, None] = OrderedDict()
+        self.stats = TierStats()
+        # Sequence hashes cover token content only — a directory written by a
+        # different model (even one with identical KV geometry) must not be
+        # served. The MANIFEST records model identity + layout; any mismatch
+        # purges the tier.
+        manifest = self.path / "MANIFEST"
+        want = f"{fingerprint}|{block_shape(spec)}|{spec.dtype}"
+        have = manifest.read_text() if manifest.exists() else None
+        if have != want:
+            if have is not None:
+                log.warning("disk KV tier %s manifest mismatch; purging", self.path)
+            for f in self.path.glob("*.kvb"):
+                f.unlink(missing_ok=True)
+            manifest.write_text(want)
+        for f in sorted(self.path.glob("*.kvb"), key=lambda p: p.stat().st_mtime):
+            if f.stat().st_size == self._block_bytes:
+                self._lru[int(f.stem, 16)] = None
+            else:  # truncated write from a crashed process
+                f.unlink(missing_ok=True)
+
+    def __contains__(self, seq_hash: int) -> bool:
+        return seq_hash in self._lru
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def _file(self, seq_hash: int) -> Path:
+        return self.path / f"{seq_hash:016x}.kvb"
+
+    def put(self, seq_hash: int, block: np.ndarray) -> None:
+        if seq_hash in self._lru:
+            self._lru.move_to_end(seq_hash)
+            return
+        while (len(self._lru) + 1) * self._block_bytes > self.capacity_bytes and self._lru:
+            victim, _ = self._lru.popitem(last=False)
+            self._file(victim).unlink(missing_ok=True)
+            self.stats.evictions += 1
+        np.ascontiguousarray(block).view(np.uint8).tofile(self._file(seq_hash))
+        self._lru[seq_hash] = None
+        self.stats.stores += 1
+
+    def get(self, seq_hash: int) -> np.ndarray | None:
+        self.stats.lookups += 1
+        if seq_hash not in self._lru:
+            return None
+        try:
+            raw = np.fromfile(self._file(seq_hash), dtype=np.uint8)
+            if raw.size != self._block_bytes:  # truncated/concurrent write
+                raise OSError(f"short read: {raw.size} != {self._block_bytes}")
+        except OSError:
+            self._lru.pop(seq_hash, None)
+            self._file(seq_hash).unlink(missing_ok=True)
+            return None
+        self._lru.move_to_end(seq_hash)
+        self.stats.hits += 1
+        return raw.view(jnp.dtype(self.spec.dtype)).reshape(block_shape(self.spec))

@@ -1,0 +1,76 @@
+"""Device↔host KV block movement.
+
+The TPU replacement for the reference's CUDA block-copy kernel
+(reference: lib/llm/src/kernels/block_copy.cu) and its transfer managers
+(reference: lib/llm/src/block_manager/offload.rs): block gather/scatter is
+expressed as XLA ops under ``jit`` (fused, MXU-free, HBM-bandwidth bound)
+and the host hop is the runtime's DMA via ``device_get``/``device_put``.
+
+Block ids are padded up to power-of-two buckets so the number of distinct
+compiled programs stays bounded (same static-shape discipline as the engine
+step functions).
+
+Host-side block format: one ``np.ndarray`` of shape
+``[2, layers, block_size, kv_heads, head_dim]`` (index 0 = K, 1 = V) —
+the unit stored by the host/disk tiers and shipped across DCN for
+disaggregated prefill→decode handoff (dynamo_tpu.disagg).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pad_pow2(ids: list[int], cap: int = 256) -> list[int]:
+    n = max(len(ids), 1)
+    b = 1
+    while b < n and b < cap:
+        b *= 2
+    # Duplicate writes/reads of the last id are harmless (same content).
+    return ids + [ids[-1]] * (b - len(ids))
+
+
+def _extract(ck, cv, ids):
+    return ck[:, ids], cv[:, ids]
+
+
+def _inject(ck, cv, ids, dk, dv):
+    return ck.at[:, ids].set(dk), cv.at[:, ids].set(dv)
+
+
+class BlockTransferEngine:
+    """Bucketed, jit-compiled block gather (extract) / scatter (inject)."""
+
+    def __init__(self) -> None:
+        self._extract = jax.jit(_extract)
+        self._inject = jax.jit(_inject, donate_argnums=(0, 1))
+
+    def extract(self, cache_k: jax.Array, cache_v: jax.Array, ids: list[int]) -> list[np.ndarray]:
+        """Gather blocks off the device; returns one host block per id."""
+        n = len(ids)
+        padded = jnp.asarray(_pad_pow2(list(ids)), jnp.int32)
+        k, v = self._extract(cache_k, cache_v, padded)
+        kv = np.stack([np.asarray(k), np.asarray(v)])  # [2, layers, n_pad, bs, kvh, hd]
+        per_block = np.moveaxis(kv, 2, 0)              # [n_pad, 2, layers, bs, kvh, hd]
+        return [np.ascontiguousarray(per_block[i]) for i in range(n)]
+
+    def inject(
+        self,
+        cache_k: jax.Array,
+        cache_v: jax.Array,
+        ids: list[int],
+        blocks: list[np.ndarray],
+    ) -> tuple[jax.Array, jax.Array]:
+        """Scatter host blocks into the device cache (cache args are donated —
+        callers must replace their references with the returned arrays)."""
+        assert len(ids) == len(blocks) and ids
+        padded = _pad_pow2(list(ids))
+        data = np.stack(blocks + [blocks[-1]] * (len(padded) - len(blocks)))
+        dk = np.moveaxis(data[:, 0], 0, 1)  # [layers, n_pad, bs, kvh, hd]
+        dv = np.moveaxis(data[:, 1], 0, 1)
+        return self._inject(
+            cache_k, cache_v, jnp.asarray(padded, jnp.int32),
+            jnp.asarray(dk, cache_k.dtype), jnp.asarray(dv, cache_v.dtype),
+        )

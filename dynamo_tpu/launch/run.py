@@ -54,6 +54,8 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--max-tokens", type=int, default=256, help="default max output tokens")
     p.add_argument("--input-jsonl", default=None)
+    p.add_argument("--host-kv-blocks", type=int, default=0, help="G2 host KV tier capacity")
+    p.add_argument("--disk-kv-path", default=None, help="G3 disk KV tier directory")
     ns = p.parse_args(rest)
     ns.in_mode, ns.out_mode = in_mode, out_mode
     return ns
@@ -67,6 +69,8 @@ def build_local_engine(ns: argparse.Namespace) -> tuple[AsyncJaxEngine, EngineCo
         block_size=ns.block_size,
         num_blocks=ns.num_blocks,
         tp=ns.tp,
+        host_kv_blocks=ns.host_kv_blocks,
+        disk_kv_path=ns.disk_kv_path,
     )
     from dynamo_tpu.engine.engine import build_engine
 

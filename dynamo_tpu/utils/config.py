@@ -97,6 +97,13 @@ class EngineConfig:
     sp: int = 1
     enable_prefix_caching: bool = True
     kv_event_publishing: bool = True
+    # KVBM tiers (reference: lib/llm/src/block_manager.rs CacheLevel):
+    # G2 host arena capacity in blocks (0 = disabled) and optional G3 disk
+    # tier (path + byte budget). Device-evicted committed blocks write back
+    # to host, host spills to disk, prompts onboard from either.
+    host_kv_blocks: int = 0
+    disk_kv_path: str | None = None
+    disk_kv_bytes: int = 1 << 30
     seed: int = 0
     # Attention implementation: "auto" (pallas on TPU, dense elsewhere),
     # "dense", "pallas", or "pallas_interpret" (CPU-testable kernel path).
