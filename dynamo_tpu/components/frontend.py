@@ -111,10 +111,21 @@ class ModelWatcher:
             async for item in migration.generate(req):
                 yield LLMEngineOutput.from_dict(item)
 
+        def stats_fn(client=client, router=router) -> dict:
+            # Worker-published engine stats (incl. KVBM tiers) relayed over
+            # the load_metrics subject — the distributed view behind
+            # /engine_stats (reference: ForwardPassMetrics over NATS).
+            out: dict = {"instances": [f"{i:x}" for i in client.known_instance_ids()]}
+            if isinstance(router, KvPushRouter):
+                out["workers"] = {f"{wid:x}": m
+                                  for wid, m in router.router.worker_metrics.items()}
+            return out
+
         tokenizer = load_tokenizer(card.get("tokenizer"))
         self.models.register(
             name, tokenizer, generate,
             defaults=ModelDefaults(max_model_len=card.get("max_model_len", 8192)),
+            stats=stats_fn,
         )
         self._pipelines[name] = (client, router)
         log.info("model added: %s via %s (router=%s)", name, endpoint, mode)

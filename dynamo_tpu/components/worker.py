@@ -42,6 +42,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--no-kv-events", action="store_true")
     p.add_argument("--host-kv-blocks", type=int, default=0, help="G2 host KV tier capacity")
     p.add_argument("--disk-kv-path", default=None, help="G3 disk KV tier directory")
+    # Disaggregated serving (reference: vllm decode-first pattern).
+    p.add_argument("--disagg", choices=["none", "prefill", "decode"], default="none")
+    p.add_argument("--prefill-endpoint", default="dyn://dynamo.prefill.generate",
+                   help="decode mode: where the prefill pool lives")
+    p.add_argument("--min-prefill-blocks", type=int, default=2,
+                   help="decode mode: prompt blocks below which prefill stays local")
     return p.parse_args(argv)
 
 
@@ -98,12 +104,48 @@ async def amain(ns: argparse.Namespace) -> None:
         ), event_sink=sink))
         stats_fn = engine.stats
 
-    async def handler(payload: dict, ctx: RequestContext):
-        req = PreprocessedRequest.from_dict(payload)
-        async for out in engine.generate(req):
-            if ctx.is_cancelled():
-                return
-            yield out.to_dict()
+    if ns.disagg != "none" and ns.engine != "jax":
+        raise SystemExit("--disagg requires --engine jax (KV handoff needs a real cache)")
+
+    kv_source = None
+    if ns.disagg == "prefill":
+        from dynamo_tpu.disagg.handlers import PrefillHandler
+        from dynamo_tpu.disagg.source import KV_PULL_ENDPOINT, KvTransferSource
+
+        kv_source = KvTransferSource(engine)
+        kv_source.start()
+        pull_ep = rt.namespace(ns.namespace).component(ns.component).endpoint(KV_PULL_ENDPOINT)
+        await pull_ep.serve(kv_source.kv_pull_handler)
+        prefill = PrefillHandler(
+            engine, kv_source,
+            advertise_addr=rt.advertise_address,
+            endpoint_path=f"{ns.namespace}.{ns.component}.{KV_PULL_ENDPOINT}",
+            block_size=ns.block_size)
+        handler = prefill.generate
+    elif ns.disagg == "decode":
+        from dynamo_tpu.disagg.handlers import DisaggDecodeHandler
+        from dynamo_tpu.runtime.client import EndpointClient, PushRouter
+        from dynamo_tpu.runtime.protocols import EndpointId
+
+        prefill_client = await EndpointClient.create(
+            rt, EndpointId.parse(ns.prefill_endpoint))
+        prefill_router = PushRouter(prefill_client)
+
+        async def prefill_call(payload, request_id):
+            async for item in prefill_router.generate(payload, request_id):
+                yield item
+
+        decode = DisaggDecodeHandler(
+            engine, prefill_call, block_size=ns.block_size,
+            min_prefill_blocks=ns.min_prefill_blocks)
+        handler = decode.generate
+    else:
+        async def handler(payload: dict, ctx: RequestContext):
+            req = PreprocessedRequest.from_dict(payload)
+            async for out in engine.generate(req):
+                if ctx.is_cancelled():
+                    return
+                yield out.to_dict()
 
     ep = rt.namespace(ns.namespace).component(ns.component).endpoint(ns.endpoint)
     await ep.serve(handler)
@@ -113,11 +155,15 @@ async def amain(ns: argparse.Namespace) -> None:
     metrics_pub.start()
 
     name = ns.served_model_name or ns.model
-    await rt.client.put(
-        f"{MODEL_PREFIX}/{name}/{rt.instance_id:016x}",
-        json.dumps(model_card(ns, name)).encode(),
-        lease_id=rt.primary_lease.id)
-    log.info("worker ready: engine=%s model=%s instance=%x", ns.engine, name, rt.instance_id)
+    if ns.disagg != "prefill":
+        # Prefill workers are internal capacity — only decode/agg workers
+        # publish a model card for the frontend to discover.
+        await rt.client.put(
+            f"{MODEL_PREFIX}/{name}/{rt.instance_id:016x}",
+            json.dumps(model_card(ns, name)).encode(),
+            lease_id=rt.primary_lease.id)
+    log.info("worker ready: engine=%s model=%s disagg=%s instance=%x",
+             ns.engine, name, ns.disagg, rt.instance_id)
     print(f"WORKER_READY instance={rt.instance_id:016x}", flush=True)
 
     stop = asyncio.Event()
@@ -127,6 +173,8 @@ async def amain(ns: argparse.Namespace) -> None:
     await stop.wait()
     log.info("worker draining")
     await metrics_pub.stop()
+    if kv_source is not None:
+        await kv_source.stop()
     if publisher:
         await publisher.stop()
     await rt.shutdown()

@@ -1,0 +1,25 @@
+"""Disaggregated prefill/decode serving.
+
+Fills the role of the reference's disaggregation stack — separate prefill
+and decode workers with a KV handoff (reference: disagg flow
+components/src/dynamo/vllm/handlers.py:188-247 decode-first pattern;
+NIXL transfer docs/architecture/disagg_serving.md) — redesigned for TPU:
+
+- The prefill worker computes the prompt's KV, **pins** the blocks, and
+  returns ``kv_transfer_params`` (its data-plane address + the block hash
+  chain + a transfer id) instead of NIXL RDMA metadata.
+- The decode worker dials that address directly over the runtime's framed
+  TCP data plane (DCN path; intra-slice transfers ride ICI inside the
+  engine's own sharding), pulls the raw block bytes, and injects them as
+  matchable prefix-cache entries — its scheduler then admits the request
+  with the whole prompt (minus the tail) already resident.
+- Decode-first and conditional: short prompts skip the remote hop, and any
+  prefill failure falls back to local prefill (availability over latency,
+  same stance as the reference's conditional disaggregation).
+"""
+
+from dynamo_tpu.disagg.handlers import DisaggDecodeHandler
+from dynamo_tpu.disagg.receiver import pull_and_import
+from dynamo_tpu.disagg.source import KvTransferSource
+
+__all__ = ["DisaggDecodeHandler", "KvTransferSource", "pull_and_import"]

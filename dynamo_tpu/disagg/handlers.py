@@ -1,0 +1,117 @@
+"""Disaggregated request handlers: decode-first flow + prefill wrapper.
+
+Reference: components/src/dynamo/vllm/handlers.py — the decode worker
+decides per request whether remote prefill is worthwhile (``can_prefill``),
+calls the prefill pool, then generates locally with the handed-off KV; the
+prefill worker generates exactly one token and returns transfer metadata.
+The TRT-LLM PREFILL_FIRST strategy routes through prefill first — here we
+implement the decode-first (vLLM) pattern.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, AsyncIterator, Callable
+
+from dynamo_tpu.disagg.receiver import pull_and_import
+from dynamo_tpu.disagg.source import KV_PULL_ENDPOINT, KvTransferSource
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.tokens import compute_block_hashes_for_tokens
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("disagg")
+
+
+class PrefillHandler:
+    """Wraps an engine as a prefill-only worker: compute prompt KV, discard
+    the sampled token, pin + advertise the blocks for pulling."""
+
+    def __init__(self, engine: AsyncJaxEngine, source: KvTransferSource,
+                 advertise_addr: str, endpoint_path: str, block_size: int):
+        self.engine = engine
+        self.source = source
+        self.advertise_addr = advertise_addr   # "host:port" of our data plane
+        self.endpoint_path = endpoint_path     # "ns.comp.kv_pull"
+        self.block_size = block_size
+
+    async def generate(self, payload: dict, ctx) -> AsyncIterator[dict]:
+        req = PreprocessedRequest.from_dict(payload)
+        # Prefill-only: one step past the prompt, sampling result discarded
+        # (the decode side samples its own first token from the handed-off KV).
+        req.stop_conditions = StopConditions(max_tokens=1, ignore_eos=True)
+        async for out in self.engine.generate(req):
+            if ctx.is_cancelled():
+                return
+            if out.finish_reason is not None and out.error:
+                yield out.to_dict()
+                return
+        # The decode scheduler can match at most (prompt_len-1)//block_size
+        # blocks (it must recompute ≥1 token for last-position state), so a
+        # final exactly-full block would be transferred but never matched —
+        # don't ship it.
+        cap = (len(req.token_ids) - 1) // self.block_size
+        hashes = compute_block_hashes_for_tokens(req.token_ids, self.block_size)[:cap]
+        params = await self.source.register(hashes)
+        result: dict[str, Any] = {"token_ids": [], "finish_reason": "stop"}
+        if params is not None:
+            result["kv_transfer_params"] = {
+                "addr": self.advertise_addr,
+                "endpoint": self.endpoint_path,
+                **params,
+            }
+        yield result
+
+
+class DisaggDecodeHandler:
+    """Decode worker handler with conditional remote prefill.
+
+    ``prefill_call(payload, request_id)`` is any async-iterator factory that
+    reaches the prefill pool (a PushRouter/KvPushRouter generate) — injected
+    so the handler is transport-agnostic and unit-testable.
+    """
+
+    def __init__(
+        self,
+        engine: AsyncJaxEngine,
+        prefill_call: Callable[[dict, str], AsyncIterator[dict]],
+        block_size: int,
+        min_prefill_blocks: int = 2,
+    ):
+        self.engine = engine
+        self.prefill_call = prefill_call
+        self.block_size = block_size
+        self.min_prefill_blocks = min_prefill_blocks
+        self.remote_prefills = 0
+        self.local_fallbacks = 0
+
+    def _can_disagg(self, req: PreprocessedRequest) -> bool:
+        return len(req.token_ids) // self.block_size >= self.min_prefill_blocks
+
+    async def _remote_prefill(self, req: PreprocessedRequest) -> None:
+        pre = copy.deepcopy(req)
+        pre.request_id = f"{req.request_id}-prefill"
+        pre.annotations["disagg"] = "prefill"
+        params = None
+        async for out in self.prefill_call(pre.to_dict(), pre.request_id):
+            if isinstance(out, dict) and out.get("kv_transfer_params"):
+                params = out["kv_transfer_params"]
+        if params is None:
+            raise RuntimeError("prefill worker returned no kv_transfer_params")
+        await pull_and_import(self.engine, params)
+
+    async def generate(self, payload: dict, ctx) -> AsyncIterator[dict]:
+        req = PreprocessedRequest.from_dict(payload)
+        if self._can_disagg(req):
+            try:
+                await self._remote_prefill(req)
+                self.remote_prefills += 1
+            except Exception as exc:
+                # Conditional disagg: fall back to local prefill rather than
+                # failing the request (reference: can_prefill gating).
+                self.local_fallbacks += 1
+                log.warning("remote prefill failed (%s); prefilling locally", exc)
+        async for out in self.engine.generate(req):
+            if ctx.is_cancelled():
+                return
+            yield out.to_dict()

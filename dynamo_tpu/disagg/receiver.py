@@ -1,0 +1,64 @@
+"""Decode-side KV transfer receiver: dial, pull, inject.
+
+Reference: the decode worker passing ``kv_transfer_params`` into its local
+engine so vLLM pulls blocks via NIXL (components/src/dynamo/vllm/
+handlers.py:236-241). Here the pull is explicit: a direct framed-TCP call
+to the prefill instance's data plane (the caller address came inside the
+params — data never transits the broker/coordinator, same stance as the
+reference's direct TCP response plane).
+"""
+
+from __future__ import annotations
+
+import uuid
+
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.kvbm.pools import block_shape
+from dynamo_tpu.transports.wire import Frame, MsgpackConnection
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("disagg")
+
+
+async def pull_and_import(engine: AsyncJaxEngine, params: dict) -> int:
+    """Pull the blocks described by ``params`` from the prefill worker and
+    inject them into ``engine``'s prefix cache. Returns blocks injected.
+
+    params: {"addr": "host:port", "endpoint": "ns.comp.kv_pull",
+             "xfer_id": ..., "block_hashes": [...]}
+    """
+    spec = engine.core.runner.spec
+    shape = block_shape(spec)
+    dtype = jnp.dtype(spec.dtype)
+    host, _, port = params["addr"].rpartition(":")
+    conn = await MsgpackConnection.connect(host, int(port))
+    plan: list[tuple[int, int | None, np.ndarray]] = []
+    try:
+        await conn.send({
+            "t": Frame.CALL, "stream_id": 1, "endpoint": params["endpoint"],
+            "request_id": uuid.uuid4().hex,
+            "payload": {"xfer_id": params["xfer_id"],
+                        "hashes": params["block_hashes"], "release": True},
+        })
+        while True:
+            msg = await conn.recv()
+            if msg is None or msg.get("t") == Frame.END:
+                break
+            if msg.get("t") == Frame.ERR:
+                raise RuntimeError(f"kv pull failed: {msg.get('error')}")
+            if msg.get("t") != Frame.DATA:
+                continue
+            item = msg["payload"]
+            data = np.frombuffer(item["d"], dtype=dtype).reshape(shape)
+            plan.append((item["h"], item.get("p"), data))
+    finally:
+        conn.close()
+    if not plan:
+        return 0
+    n = await engine.run_in_core(lambda core: core.import_blocks(plan))
+    log.info("pulled %d KV blocks from %s (injected %d)",
+             len(plan), params["addr"], n)
+    return n

@@ -1,0 +1,102 @@
+"""Prefill-side KV transfer source: pin, serve, expire.
+
+Reference: the KVBM-distributed leader/worker + NIXL metadata handshake
+(lib/llm/src/block_manager/distributed/leader.rs, storage/nixl.rs).
+Here the "RDMA registration" becomes: pin the blocks in the prefill
+engine's pool (incref — survives scheduler churn), hand out a transfer id,
+and stream the raw block bytes over the runtime data plane when the decode
+side calls the ``kv_pull`` endpoint. Unpulled transfers expire after a TTL
+so an aborted decode can't leak device blocks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from dataclasses import dataclass
+
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("disagg")
+
+KV_PULL_ENDPOINT = "kv_pull"
+
+
+@dataclass
+class _Transfer:
+    block_ids: list[int]      # pinned device blocks (refcounted)
+    seq_hashes: list[int]     # chain covered by the pin, same length
+    deadline: float
+
+
+class KvTransferSource:
+    def __init__(self, engine: AsyncJaxEngine, ttl_s: float = 60.0):
+        self.engine = engine
+        self.ttl_s = ttl_s
+        self._transfers: dict[str, _Transfer] = {}
+        self._gc_task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        if self._gc_task is None:
+            self._gc_task = asyncio.create_task(self._gc_loop())
+
+    async def stop(self) -> None:
+        if self._gc_task is not None:
+            self._gc_task.cancel()
+            self._gc_task = None
+        for xid in list(self._transfers):
+            await self._release(xid)
+
+    # ------------------------------------------------------------------
+    async def register(self, seq_hashes: list[int]) -> dict | None:
+        """Pin the device-resident prefix of ``seq_hashes``; returns the
+        kv_transfer_params fragment (id + covered hashes) or None if nothing
+        is resident (e.g. prompt shorter than one block)."""
+        if not seq_hashes:
+            return None
+        block_ids = await self.engine.run_in_core(
+            lambda core: core.pin_blocks(seq_hashes))
+        if not block_ids:
+            return None
+        xid = uuid.uuid4().hex
+        covered = seq_hashes[: len(block_ids)]
+        self._transfers[xid] = _Transfer(
+            block_ids=block_ids, seq_hashes=covered,
+            deadline=time.monotonic() + self.ttl_s)
+        return {"xfer_id": xid, "block_hashes": covered}
+
+    async def _release(self, xid: str) -> None:
+        xfer = self._transfers.pop(xid, None)
+        if xfer is not None:
+            await self.engine.run_in_core(
+                lambda core: core.unpin_blocks(xfer.block_ids))
+
+    async def _gc_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.ttl_s / 4)
+            now = time.monotonic()
+            for xid, xfer in list(self._transfers.items()):
+                if xfer.deadline <= now:
+                    log.warning("kv transfer %s expired unpulled; releasing", xid)
+                    await self._release(xid)
+
+    # ------------------------------------------------------------------
+    async def kv_pull_handler(self, payload: dict, ctx):
+        """Data-plane handler: stream the pinned blocks' raw bytes.
+
+        One DATA frame per block keeps frames small and lets the decode
+        side overlap receive with inject."""
+        xid = payload.get("xfer_id", "")
+        xfer = self._transfers.get(xid)
+        if xfer is None:
+            raise KeyError(f"unknown or expired kv transfer {xid!r}")
+        plan = await self.engine.run_in_core(
+            lambda core: core.export_blocks(xfer.seq_hashes))
+        try:
+            for h, parent, data in plan:
+                yield {"h": h, "p": parent, "d": data.tobytes()}
+        finally:
+            if payload.get("release", True):
+                await self._release(xid)

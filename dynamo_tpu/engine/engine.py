@@ -21,7 +21,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 from functools import partial
-from typing import TYPE_CHECKING, AsyncIterator, Callable
+from typing import TYPE_CHECKING, Any, AsyncIterator, Callable
 
 if TYPE_CHECKING:
     from dynamo_tpu.kvbm.offload import OffloadManager
@@ -393,6 +393,53 @@ class EngineCore:
             outputs[seq.request_id] = out
         return outputs
 
+    # -- disagg / KV-transfer primitives (engine-core thread only) ---------
+    @property
+    def transfer(self):
+        if self.kvbm is not None:  # share jit caches with the offload path
+            return self.kvbm.transfer
+        if getattr(self, "_transfer", None) is None:
+            from dynamo_tpu.kvbm.transfer import BlockTransferEngine
+
+            self._transfer = BlockTransferEngine()
+        return self._transfer
+
+    def export_blocks(self, seq_hashes: list[int]) -> list[tuple[int, int | None, np.ndarray]]:
+        """Gather the device-resident prefix of a hash chain off the device.
+        The prefill side of disaggregated serving (reference: the NIXL
+        kv_transfer_params handoff, components/src/dynamo/vllm/handlers.py)."""
+        ids, kept = [], []
+        parent: int | None = None
+        for h in seq_hashes:
+            bid = self.pool.block_for_hash(h)
+            if bid is None:
+                break
+            ids.append(bid)
+            kept.append((h, parent))
+            parent = h
+        if not ids:
+            return []
+        blocks = self.transfer.extract(self.runner.cache_k, self.runner.cache_v, ids)
+        return [(h, par, data) for (h, par), data in zip(kept, blocks)]
+
+    def import_blocks(self, plan: list[tuple[int, int | None, np.ndarray]]) -> int:
+        """Inject externally-received blocks as matchable cache entries —
+        the decode side of disaggregated serving. Hashes already on device
+        are skipped (and MRU-protected)."""
+        from dynamo_tpu.kvbm.offload import inject_and_commit, plan_onboard
+
+        by_hash = {h: data for h, _, data in plan}
+        filtered = plan_onboard(self.pool, [h for h, _, _ in plan], by_hash.get)
+        return inject_and_commit(self.runner, self.pool, self.transfer, filtered)
+
+    def pin_blocks(self, seq_hashes: list[int]) -> list[int]:
+        """Incref the device-resident prefix of a chain so it survives until
+        a pending transfer pulls it; pair with unpin_blocks."""
+        return self.pool.match_prefix(seq_hashes)
+
+    def unpin_blocks(self, block_ids: list[int]) -> None:
+        self.pool.release(block_ids)
+
     def fail_all(self, error: str) -> list[str]:
         """Abort every in-flight request (engine-fatal path). Returns the
         request ids that were failed so callers can notify their streams."""
@@ -449,6 +496,17 @@ class AsyncJaxEngine:
                 elif kind == "abort":
                     self.core.abort(payload)
                     self._post(payload, LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
+                elif kind == "exec":
+                    # Arbitrary core access (KV export/import/pin for disagg)
+                    # marshaled onto this thread — the only thread allowed to
+                    # touch device state.
+                    fn, fut = payload
+                    try:
+                        result = fn(self.core)
+                    except Exception as exc:
+                        self._loop.call_soon_threadsafe(self._resolve, fut, None, exc)
+                    else:
+                        self._loop.call_soon_threadsafe(self._resolve, fut, result, None)
             if not self.core.has_work():
                 if not moved:
                     self._wake.wait(timeout=0.05)
@@ -466,6 +524,23 @@ class AsyncJaxEngine:
                 continue
             for rid, out in outputs.items():
                 self._post(rid, out)
+
+    @staticmethod
+    def _resolve(fut: asyncio.Future, result, exc: Exception | None) -> None:
+        if fut.cancelled():
+            return
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+
+    async def run_in_core(self, fn: Callable[[EngineCore], Any]) -> Any:
+        """Run ``fn(core)`` on the engine-core thread and await its result."""
+        self.start()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._inbox.put(("exec", (fn, fut)))
+        self._wake.set()
+        return await fut
 
     def _post(self, rid: str, out: LLMEngineOutput) -> None:
         loop, q = self._loop, self._streams.get(rid)

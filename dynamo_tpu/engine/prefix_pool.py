@@ -59,6 +59,9 @@ class PrefixPool:
     def has_hash(self, seq_hash: int) -> bool:
         return seq_hash in self._by_hash
 
+    def block_for_hash(self, seq_hash: int) -> int | None:
+        return self._by_hash.get(seq_hash)
+
     def touch(self, seq_hash: int) -> None:
         """Refresh an inactive cached block to MRU so an imminent allocation
         burst doesn't evict it (used by KVBM onboarding to protect the

@@ -18,6 +18,7 @@ priority queues + transfer managers). Two flows:
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Callable
 
 import numpy as np
 
@@ -27,6 +28,54 @@ from dynamo_tpu.kvbm.transfer import BlockTransferEngine
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("kvbm")
+
+# One onboarding unit: (seq_hash, parent_seq_hash | None, block data).
+OnboardPlan = list[tuple[int, "int | None", np.ndarray]]
+
+
+def plan_onboard(
+    pool: PrefixPool,
+    seq_hashes: list[int],
+    lookup: Callable[[int], "np.ndarray | None"],
+) -> OnboardPlan:
+    """Walk a hash chain: device-resident blocks are touched (MRU-refreshed
+    so the upcoming allocation can't evict the chain head), missing blocks
+    are resolved through ``lookup``; the walk stops at the first hash found
+    nowhere (a later block without its prefix is unmatchable)."""
+    plan: OnboardPlan = []
+    parent: int | None = None
+    for h in seq_hashes:
+        if pool.has_hash(h):
+            pool.touch(h)
+            parent = h
+            continue
+        block = lookup(h)
+        if block is None:
+            break
+        plan.append((h, parent, block))
+        parent = h
+    return plan
+
+
+def inject_and_commit(runner, pool: PrefixPool, transfer: BlockTransferEngine,
+                      plan: OnboardPlan) -> int:
+    """Allocate device blocks, scatter the plan's data in, and commit them as
+    matchable inactive cache entries. Returns blocks injected (0 if the pool
+    can't make room). ``runner`` is duck-typed: mutable cache_k/cache_v."""
+    if not plan:
+        return 0
+    try:
+        block_ids = pool.allocate(len(plan))
+    except NoFreeBlocks:
+        return 0
+    runner.cache_k, runner.cache_v = transfer.inject(
+        runner.cache_k, runner.cache_v, block_ids,
+        [data for _, _, data in plan],
+    )
+    for bid, (h, par, _) in zip(block_ids, plan):
+        pool.commit(bid, h, par)
+    pool.release(block_ids)  # park as matchable inactive blocks
+    return len(plan)
 
 
 @dataclass
@@ -75,40 +124,15 @@ class OffloadManager:
 
     def onboard(self, seq_hashes: list[int]) -> int:
         """Bring the longest tier-cached prefix of ``seq_hashes`` onto the
-        device. Returns the number of blocks injected."""
-        plan: list[tuple[int, int | None, np.ndarray]] = []  # (hash, parent, data)
-        parent: int | None = None
-        for h in seq_hashes:
-            if self.pool.has_hash(h):
-                # Already on device: refresh to MRU so the allocation below
-                # doesn't evict the head of the very chain we're extending
-                # (which would make the injected tail unmatchable).
-                self.pool.touch(h)
-                parent = h
-                continue
-            block = self._lookup(h)
-            if block is None:
-                break
-            plan.append((h, parent, block))
-            parent = h
-        if not plan:
-            return 0
-        try:
-            # May evict inactive device blocks → reentrant _on_evict (safe:
-            # the evicted blocks are disjoint from the ones being loaded,
-            # and tier.get returned copies).
-            block_ids = self.pool.allocate(len(plan))
-        except NoFreeBlocks:
-            return 0
-        self.runner.cache_k, self.runner.cache_v = self.transfer.inject(
-            self.runner.cache_k, self.runner.cache_v,
-            block_ids, [data for _, _, data in plan],
-        )
-        for bid, (h, par, _) in zip(block_ids, plan):
-            self.pool.commit(bid, h, par)
-        self.pool.release(block_ids)  # park as matchable inactive blocks
-        self.stats.onboarded_blocks += len(plan)
-        return len(plan)
+        device. Returns the number of blocks injected.
+
+        The allocation inside may evict inactive device blocks → reentrant
+        ``_on_evict`` (safe: the evicted blocks are disjoint from the ones
+        being loaded, and tier ``get`` returned copies)."""
+        plan = plan_onboard(self.pool, seq_hashes, self._lookup)
+        n = inject_and_commit(self.runner, self.pool, self.transfer, plan)
+        self.stats.onboarded_blocks += n
+        return n
 
     def snapshot(self) -> dict:
         out = self.stats.to_dict()

@@ -25,9 +25,12 @@ import numpy as np
 
 def _pad_pow2(ids: list[int], cap: int = 256) -> list[int]:
     n = max(len(ids), 1)
-    b = 1
-    while b < n and b < cap:
-        b *= 2
+    if n > cap:  # above the pow2 range, round up to a multiple of cap
+        b = -(-n // cap) * cap
+    else:
+        b = 1
+        while b < n:
+            b *= 2
     # Duplicate writes/reads of the last id are harmless (same content).
     return ids + [ids[-1]] * (b - len(ids))
 

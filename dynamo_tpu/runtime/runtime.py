@@ -84,7 +84,7 @@ class DistributedRuntime:
     async def create(cls, config: RuntimeConfig | None = None) -> "DistributedRuntime":
         rt = cls(config)
         rt.client = await CoordinatorClient.connect(rt.config.coordinator_url)
-        rt.primary_lease = await rt.client.lease_grant(ttl=6.0)
+        rt.primary_lease = await rt.client.lease_grant(ttl=rt.config.lease_ttl_s)
         # Coordinator lease ids are server-unique — mixing one in makes
         # instance ids collision-free even for runtimes created in the same
         # millisecond in the same process.
@@ -112,6 +112,12 @@ class DistributedRuntime:
             self._server.close()
         if self.client:
             await self.client.close()
+
+    @property
+    def advertise_address(self) -> str:
+        """The 'host:port' other processes dial to reach this node's data
+        plane (what Instance.address is built from)."""
+        return f"{self._advertise_host}:{self.data_port}"
 
     def namespace(self, name: str) -> "Namespace":
         return Namespace(self, name)

@@ -46,6 +46,9 @@ class RuntimeConfig:
     log_jsonl: bool = False
     # Request plane.
     request_timeout_s: float = 600.0
+    # Primary lease TTL (liveness). Generous enough that a long GIL-holding
+    # XLA trace/compile can't starve the keep-alive loop into lease expiry.
+    lease_ttl_s: float = 20.0
     # Graceful shutdown drain deadline.
     drain_timeout_s: float = 30.0
 

@@ -55,6 +55,7 @@ def pytest_pyfunc_call(pyfuncitem):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: async test (handled by conftest)")
+    config.addinivalue_line("markers", "slow: multi-process e2e tests")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,229 @@
+"""Disaggregated prefill/decode tests.
+
+Reference test model: the disagg flow is validated by serve e2e + mocker
+tests in the reference (SURVEY.md §4); here the CPU-testable JAX engine
+lets us assert KV-handoff *correctness* (bit-identical generation), which
+the reference can't do without GPUs.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.disagg.handlers import DisaggDecodeHandler, PrefillHandler
+from dynamo_tpu.disagg.source import KvTransferSource
+from dynamo_tpu.engine.engine import AsyncJaxEngine, EngineCore
+from dynamo_tpu.tokens import compute_block_hashes_for_tokens
+
+from tests.test_engine import make_req, run_to_completion, tiny_config
+
+
+PROMPT = list(range(60, 84))  # 24 tokens = 6 full blocks of 4
+
+
+def baseline_tokens(prompt, max_tokens=6):
+    core = EngineCore(tiny_config())
+    out, _ = run_to_completion(core, [make_req(prompt=prompt, max_tokens=max_tokens, rid="b")])
+    return out["b"]
+
+
+class _Ctx:
+    def is_cancelled(self):
+        return False
+
+
+async def drain(agen):
+    out = []
+    async for item in agen:
+        out.append(item)
+    return out
+
+
+# -- core primitives ---------------------------------------------------------
+
+def test_export_import_roundtrip_matches_baseline():
+    """KV computed on engine P, exported, imported into engine D → D's
+    continuation is bit-identical to a single-engine run."""
+    expected = baseline_tokens(PROMPT)
+
+    p_core = EngineCore(tiny_config())
+    run_to_completion(p_core, [make_req(prompt=PROMPT, max_tokens=1, rid="p")])
+    hashes = compute_block_hashes_for_tokens(PROMPT, 4)
+    plan = p_core.export_blocks(hashes)
+    assert len(plan) == 6  # all full prompt blocks resident + committed
+
+    d_core = EngineCore(tiny_config())
+    injected = d_core.import_blocks(plan)
+    assert injected == 6
+    out, _ = run_to_completion(d_core, [make_req(prompt=PROMPT, max_tokens=6, rid="d")])
+    assert out["d"] == expected
+    # scheduler matched the injected prefix (minus the last-token cap)
+    stats = d_core.metrics.snapshot(d_core.sched, d_core.pool)
+    assert stats["prefix_hit_rate"] > 0
+
+
+def test_pin_survives_churn_and_unpin_releases():
+    core = EngineCore(tiny_config(num_blocks=17))  # 16 usable
+    run_to_completion(core, [make_req(prompt=PROMPT, max_tokens=1, rid="p")])
+    hashes = compute_block_hashes_for_tokens(PROMPT, 4)
+    pinned = core.pin_blocks(hashes)
+    assert len(pinned) == 6
+    # churn: disjoint prompts that would evict unpinned inactive blocks
+    run_to_completion(core, [make_req(prompt=[300 + i] * 20, max_tokens=2, rid=f"c{i}")
+                             for i in range(3)])
+    assert core.export_blocks(hashes), "pinned blocks must survive churn"
+    core.unpin_blocks(pinned)
+
+
+# -- async handler flow (in-process, no network) -----------------------------
+
+async def test_decode_first_flow_in_process():
+    expected = baseline_tokens(PROMPT)
+
+    p_engine = AsyncJaxEngine(EngineCore(tiny_config()))
+    d_engine = AsyncJaxEngine(EngineCore(tiny_config()))
+    source = KvTransferSource(p_engine)
+
+    # The in-process "network": prefill_call drives PrefillHandler directly,
+    # and the pull hop is replaced by export→import through the source's
+    # registry (the TCP path is covered by the e2e test below).
+    from dynamo_tpu.disagg import handlers as h
+
+    async def fake_pull(engine, params):
+        xfer = source._transfers[params["xfer_id"]]
+        plan = await p_engine.run_in_core(lambda c: c.export_blocks(xfer.seq_hashes))
+        await source._release(params["xfer_id"])
+        return await engine.run_in_core(lambda c: c.import_blocks(plan))
+
+    prefill = PrefillHandler(p_engine, source, "127.0.0.1:0", "ns.prefill.kv_pull", 4)
+
+    async def prefill_call(payload, request_id):
+        async for item in prefill.generate(payload, _Ctx()):
+            yield item
+
+    decode = DisaggDecodeHandler(d_engine, prefill_call, block_size=4)
+    orig = h.pull_and_import
+    h.pull_and_import = fake_pull
+    try:
+        outs = await drain(decode.generate(make_req(prompt=PROMPT, max_tokens=6).to_dict(), _Ctx()))
+    finally:
+        h.pull_and_import = orig
+    tokens = [t for o in outs for t in o.get("token_ids", [])]
+    assert tokens == expected
+    assert decode.remote_prefills == 1 and decode.local_fallbacks == 0
+    assert not source._transfers  # transfer released after pull
+    await p_engine.shutdown()
+    await d_engine.shutdown()
+
+
+async def test_decode_falls_back_on_prefill_failure():
+    d_engine = AsyncJaxEngine(EngineCore(tiny_config()))
+
+    async def broken_prefill(payload, request_id):
+        raise RuntimeError("prefill pool down")
+        yield  # pragma: no cover
+
+    decode = DisaggDecodeHandler(d_engine, broken_prefill, block_size=4)
+    outs = await drain(decode.generate(make_req(prompt=PROMPT, max_tokens=4).to_dict(), _Ctx()))
+    tokens = [t for o in outs for t in o.get("token_ids", [])]
+    assert tokens == baseline_tokens(PROMPT, max_tokens=4)
+    assert decode.local_fallbacks == 1
+
+
+async def test_short_prompt_skips_remote_prefill():
+    d_engine = AsyncJaxEngine(EngineCore(tiny_config()))
+    calls = []
+
+    async def spy_prefill(payload, request_id):
+        calls.append(request_id)
+        yield {}
+
+    decode = DisaggDecodeHandler(d_engine, spy_prefill, block_size=4, min_prefill_blocks=2)
+    await drain(decode.generate(make_req(prompt=[1, 2, 3, 4, 5], max_tokens=2).to_dict(), _Ctx()))
+    assert calls == []  # 1 full block < min_prefill_blocks
+
+
+# -- full network e2e: coordinator + prefill + decode processes --------------
+
+@pytest.mark.slow
+def test_disagg_e2e_over_network():
+    """Two real worker processes with the KV pull riding the framed-TCP data
+    plane; the decode worker's output must match a local aggregated run."""
+    import socket
+    import time
+
+    from tests.utils_process import ManagedProcess
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    prompt_text = "measure twice cut once " * 2   # 46 bytes → 11 blocks of 4
+    expected = baseline_tokens(list(prompt_text.encode()), max_tokens=8)
+
+    coord_port = free_port()
+    url = f"tcp://127.0.0.1:{coord_port}"
+    coordinator = ManagedProcess(
+        ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
+         "--port", str(coord_port)], name="coordinator").start()
+    time.sleep(1.0)
+    common = ["--coordinator", url, "--engine", "jax", "--model", "tiny-llama",
+              "--block-size", "4", "--num-blocks", "64", "--max-model-len", "256",
+              "--max-batch-size", "8"]
+    prefill_w = ManagedProcess(
+        ["-m", "dynamo_tpu.components.worker", *common,
+         "--component", "prefill", "--disagg", "prefill"], name="prefill").start()
+    decode_w = ManagedProcess(
+        ["-m", "dynamo_tpu.components.worker", *common,
+         "--disagg", "decode",
+         "--prefill-endpoint", "dyn://dynamo.prefill.generate"], name="decode").start()
+    try:
+        prefill_w.wait_for_line("WORKER_READY", 90)
+        decode_w.wait_for_line("WORKER_READY", 90)
+
+        async def drive():
+            from dynamo_tpu.runtime.client import EndpointClient, PushRouter
+            from dynamo_tpu.runtime.protocols import EndpointId
+            from dynamo_tpu.runtime.runtime import DistributedRuntime
+            from dynamo_tpu.utils.config import RuntimeConfig
+
+            rt = await DistributedRuntime.create(RuntimeConfig(coordinator_url=url))
+            client = await EndpointClient.create(
+                rt, EndpointId.parse("dyn://dynamo.backend.generate"))
+            await client.wait_for_instances(30)
+            router = PushRouter(client)
+            req = make_req(prompt=list(prompt_text.encode()), max_tokens=8)
+            tokens = []
+            async for out in router.generate(req.to_dict(), req.request_id):
+                tokens.extend(out.get("token_ids", []))
+            await client.close()
+            await rt.shutdown()
+            return tokens
+
+        tokens = asyncio.run(drive())
+        assert tokens == expected, f"disagg output diverged: {tokens} != {expected}"
+        assert "pulled" in decode_w.logs()  # KV actually moved over TCP
+    finally:
+        decode_w.stop()
+        prefill_w.stop()
+        coordinator.stop()
+
+
+async def test_transfer_ttl_expiry_unpins():
+    engine = AsyncJaxEngine(EngineCore(tiny_config()))
+    core = engine.core
+    src = KvTransferSource(engine, ttl_s=0.2)
+
+    async def setup():
+        run_to_completion(core, [make_req(prompt=PROMPT, max_tokens=1, rid="p")])
+        hashes = compute_block_hashes_for_tokens(PROMPT, 4)
+        params = await src.register(hashes)
+        assert params is not None
+        src.start()
+        await asyncio.sleep(0.6)
+        assert not src._transfers  # expired + unpinned
+        await src.stop()
+
+    await setup()
+    await engine.shutdown()
