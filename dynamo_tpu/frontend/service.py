@@ -63,6 +63,9 @@ class HttpService:
         self._itl = m.histogram("frontend_inter_token_latency_seconds", "ITL")
         self._req_dur = m.histogram("frontend_request_duration_seconds", "request duration")
         self._output_tokens = m.counter("frontend_output_tokens_total", "output tokens")
+        self._input_tokens = m.counter("frontend_input_tokens_total", "prompt tokens")
+        self._model_requests = m.counter("frontend_model_requests_total",
+                                         "completed requests per model")
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self.chat_completions)
         self.app.router.add_post("/v1/completions", self.completions)
@@ -160,6 +163,8 @@ class HttpService:
             return _error(400, f"preprocessing failed: {exc}")
 
         self._inflight.inc(model=req.model)
+        self._input_tokens.inc(len(pre.token_ids), model=req.model)
+        self._model_requests.inc(model=req.model)
         t_start = time.monotonic()
         try:
             if req.stream:

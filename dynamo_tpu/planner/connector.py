@@ -1,0 +1,111 @@
+"""Planner connectors: apply replica decisions to the world.
+
+Reference: components/src/dynamo/planner/ — KubernetesConnector patches
+DynamoGraphDeployment replicas; VirtualConnector writes decisions to etcd
+for an external orchestrator (virtual_connector.py). Here:
+
+- :class:`VirtualConnector` writes the decision JSON to the coordinator KV
+  (``planner/decisions/{namespace}``) with a monotonically increasing
+  revision; any orchestrator can watch that prefix.
+- :class:`ProcessConnector` applies decisions directly by spawning/stopping
+  local worker processes — the no-K8s path used by tests and single-host
+  deployments (each "replica" is one ``python -m
+  dynamo_tpu.components.worker`` process).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import signal
+import subprocess
+import sys
+import time
+
+from dynamo_tpu.transports.client import CoordinatorClient
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("planner")
+
+DECISIONS_PREFIX = "planner/decisions"
+
+
+class VirtualConnector:
+    def __init__(self, client: CoordinatorClient, namespace: str = "dynamo"):
+        self.client = client
+        self.namespace = namespace
+        self.revision = 0
+
+    @property
+    def key(self) -> str:
+        return f"{DECISIONS_PREFIX}/{self.namespace}"
+
+    async def apply(self, prefill_replicas: int, decode_replicas: int,
+                    reason: str = "") -> None:
+        self.revision += 1
+        await self.client.put(self.key, json.dumps({
+            "revision": self.revision,
+            "prefill_replicas": prefill_replicas,
+            "decode_replicas": decode_replicas,
+            "reason": reason,
+            "ts": time.time(),
+        }).encode())
+
+    async def read(self) -> dict | None:
+        value = await self.client.get(self.key)
+        return json.loads(value) if value else None
+
+
+class ProcessConnector:
+    """Scale worker fleets by (de)spawning local processes.
+
+    ``prefill_args``/``decode_args`` are full argv tails for
+    ``python -m dynamo_tpu.components.worker``; scale-down stops the
+    most-recently started replica (SIGTERM → graceful drain)."""
+
+    def __init__(self, prefill_args: list[str] | None, decode_args: list[str]):
+        self.prefill_args = prefill_args
+        self.decode_args = decode_args
+        self.prefill_procs: list[subprocess.Popen] = []
+        self.decode_procs: list[subprocess.Popen] = []
+
+    def _spawn(self, args: list[str]) -> subprocess.Popen:
+        cmd = [sys.executable, "-u", "-m", "dynamo_tpu.components.worker", *args]
+        log.info("spawning worker: %s", " ".join(args))
+        return subprocess.Popen(cmd)
+
+    @staticmethod
+    def _stop(proc: subprocess.Popen, grace: float = 15.0) -> None:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(grace)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def _reap(self, procs: list[subprocess.Popen]) -> None:
+        procs[:] = [p for p in procs if p.poll() is None]
+
+    async def apply(self, prefill_replicas: int, decode_replicas: int,
+                    reason: str = "") -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self._apply_sync,
+                                   prefill_replicas, decode_replicas)
+
+    def _apply_sync(self, prefill_replicas: int, decode_replicas: int) -> None:
+        for procs, args, target in (
+            (self.prefill_procs, self.prefill_args, prefill_replicas),
+            (self.decode_procs, self.decode_args, decode_replicas),
+        ):
+            if args is None:
+                continue
+            self._reap(procs)
+            while len(procs) < target:
+                procs.append(self._spawn(args))
+            while len(procs) > target:
+                self._stop(procs.pop())
+
+    def shutdown(self) -> None:
+        for procs in (self.prefill_procs, self.decode_procs):
+            while procs:
+                self._stop(procs.pop())

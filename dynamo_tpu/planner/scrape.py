@@ -1,0 +1,89 @@
+"""Prometheus scraping for the planner.
+
+Reference: components/src/dynamo/planner/utils/prometheus.py — the planner
+observes the frontend's Prometheus metrics. Here we scrape the frontend's
+``/metrics`` endpoint directly (no Prometheus server in the loop) and diff
+counters across intervals to recover per-interval rates.
+"""
+
+from __future__ import annotations
+
+import aiohttp
+
+from dynamo_tpu.planner.planner_core import Metrics
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("planner")
+
+Sample = dict[tuple[str, frozenset], float]
+
+
+def parse_prometheus(text: str) -> Sample:
+    """Minimal Prometheus text parser: name{labels} value."""
+    out: Sample = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        head, _, value = line.rpartition(" ")
+        name, labels = head, {}
+        if "{" in head:
+            name, _, rest = head.partition("{")
+            for pair in rest.rstrip("}").split(","):
+                if "=" in pair:
+                    k, _, v = pair.partition("=")
+                    labels[k.strip()] = v.strip().strip('"')
+        try:
+            out[(name, frozenset(labels.items()))] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def _sum_for(sample: Sample, name: str, model: str | None = None) -> float:
+    total = 0.0
+    for (n, labels), v in sample.items():
+        if n != name:
+            continue
+        if model is not None and ("model", model) not in labels:
+            continue
+        total += v
+    return total
+
+
+class FrontendScraper:
+    """Diffs the frontend's counters into per-interval Metrics."""
+
+    def __init__(self, metrics_url: str, model: str | None = None):
+        self.url = metrics_url
+        self.model = model
+        self._prev: Sample | None = None
+
+    async def fetch(self) -> Sample:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(self.url, timeout=aiohttp.ClientTimeout(total=10)) as resp:
+                resp.raise_for_status()
+                return parse_prometheus(await resp.text())
+
+    def _delta(self, cur: Sample, name: str) -> float:
+        now = _sum_for(cur, name, self.model)
+        before = _sum_for(self._prev, name, self.model) if self._prev else 0.0
+        return max(now - before, 0.0)  # counter reset → treat as fresh
+
+    async def observe_interval(self) -> Metrics:
+        cur = await self.fetch()
+        n_req = self._delta(cur, "dynamo_frontend_model_requests_total")
+        in_tok = self._delta(cur, "dynamo_frontend_input_tokens_total")
+        out_tok = self._delta(cur, "dynamo_frontend_output_tokens_total")
+        ttft_sum = self._delta(cur, "dynamo_frontend_time_to_first_token_seconds_sum")
+        ttft_cnt = self._delta(cur, "dynamo_frontend_time_to_first_token_seconds_count")
+        itl_sum = self._delta(cur, "dynamo_frontend_inter_token_latency_seconds_sum")
+        itl_cnt = self._delta(cur, "dynamo_frontend_inter_token_latency_seconds_count")
+        self._prev = cur
+        return Metrics(
+            num_req=n_req,
+            isl=in_tok / n_req if n_req else 0.0,
+            osl=out_tok / n_req if n_req else 0.0,
+            ttft_s=ttft_sum / ttft_cnt if ttft_cnt else None,
+            itl_s=itl_sum / itl_cnt if itl_cnt else None,
+        )

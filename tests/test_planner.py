@@ -1,0 +1,201 @@
+"""Planner tests: predictors, interpolators, replica calculation, connector.
+
+Reference test model: tests/planner/test_replica_calculation.py — replica
+math validated against profiling data; here against the synthetic analytic
+profile (real sweeps slot into the same arrays).
+"""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.planner.interpolator import (
+    DecodeInterpolator, PrefillInterpolator, synthetic_profile)
+from dynamo_tpu.planner.load_predictor import make_predictor
+from dynamo_tpu.planner.planner_core import Metrics, Planner, PlannerConfig
+from dynamo_tpu.planner.scrape import parse_prometheus
+
+
+# -- predictors --------------------------------------------------------------
+
+def test_constant_predictor():
+    p = make_predictor("constant")
+    for v in (1.0, 5.0, 3.0):
+        p.add_data_point(v)
+    assert p.predict_next() == 3.0
+
+
+def test_moving_average_predictor():
+    p = make_predictor("moving_average", window_size=4)
+    for v in (2.0, 4.0, 6.0, 8.0):
+        p.add_data_point(v)
+    assert p.predict_next() == 5.0
+    p.add_data_point(10.0)  # rolls 2.0 out
+    assert p.predict_next() == 7.0
+
+
+def test_linear_trend_predictor_tracks_ramp():
+    p = make_predictor("linear", window_size=10)
+    for i in range(10):
+        p.add_data_point(10.0 + 2.0 * i)   # 10, 12, ... 28
+    assert p.predict_next() == pytest.approx(30.0, abs=1e-6)
+
+
+def test_linear_trend_clamps_at_zero():
+    p = make_predictor("linear")
+    for v in (30.0, 20.0, 10.0, 0.0):
+        p.add_data_point(v)
+    assert p.predict_next() == 0.0
+
+
+def test_unknown_predictor_rejected():
+    with pytest.raises(ValueError):
+        make_predictor("prophet")
+
+
+def test_predictor_ignores_nan():
+    p = make_predictor("constant")
+    p.add_data_point(4.0)
+    p.add_data_point(float("nan"))
+    assert p.predict_next() == 4.0
+
+
+# -- interpolators -----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def profile():
+    return synthetic_profile(base_ttft_s=0.1, prefill_rate_tokps=8000.0,
+                             base_itl_s=0.01)
+
+
+def test_prefill_interpolation_matches_analytic(profile):
+    pi = PrefillInterpolator.from_data(profile)
+    # On a sample point, exact; between points, linear.
+    assert pi.interpolate_ttft(512) == pytest.approx(0.1 + 512 / 8000.0)
+    mid = pi.interpolate_ttft((512 + 2048) / 2)
+    assert pi.interpolate_ttft(512) < mid < pi.interpolate_ttft(2048)
+    assert pi.interpolate_thpt_per_chip(1000) == pytest.approx(8000.0)
+
+
+def test_decode_interpolation_monotone(profile):
+    di = DecodeInterpolator.from_data(profile)
+    # ITL grows with concurrency and context.
+    assert di.interpolate_itl(64, 1024) > di.interpolate_itl(1, 1024)
+    assert di.interpolate_itl(16, 16384) > di.interpolate_itl(16, 256)
+
+
+def test_find_best_throughput_respects_sla(profile):
+    di = DecodeInterpolator.from_data(profile)
+    tight = di.find_best_throughput_per_chip(0.0101, 256)
+    loose = di.find_best_throughput_per_chip(1.0, 256)
+    assert loose[0] > tight[0]           # looser SLA → higher throughput point
+    assert loose[1] == 64                # max concurrency admissible
+    # Impossible SLA falls back to the lowest-latency point, not a crash.
+    t, conc = di.find_best_throughput_per_chip(1e-6, 256)
+    assert conc == 1
+
+
+# -- replica calculation -----------------------------------------------------
+
+def make_planner(**cfg_kw) -> Planner:
+    data = synthetic_profile()
+    kw = {"adjustment_interval_s": 10.0, "max_replicas": 64, **cfg_kw}
+    return Planner(PlannerConfig(**kw), PrefillInterpolator.from_data(data),
+                   DecodeInterpolator.from_data(data))
+
+
+def test_replicas_scale_with_load():
+    planner = make_planner()
+    low = planner.compute_replicas(num_req=5, isl=512, osl=128)
+    high = planner.compute_replicas(num_req=500, isl=512, osl=128)
+    assert high.prefill_replicas > low.prefill_replicas
+    assert high.decode_replicas > low.decode_replicas
+
+
+def test_replicas_exact_prefill_math():
+    planner = make_planner()
+    # 100 req × 512 isl / 10s = 5120 tok/s; capacity 8000 tok/s/replica → 1
+    d = planner.compute_replicas(num_req=100, isl=512, osl=128)
+    assert d.prefill_replicas == 1
+    # 10× the load → ceil(51200/8000) = 7
+    d = planner.compute_replicas(num_req=1000, isl=512, osl=128)
+    assert d.prefill_replicas == 7
+
+
+def test_no_load_gives_min_replicas():
+    planner = make_planner(min_replicas=2)
+    d = planner.compute_replicas(0, 0, 0)
+    assert (d.prefill_replicas, d.decode_replicas) == (2, 2)
+
+
+def test_max_replicas_bound():
+    planner = make_planner(max_replicas=3)
+    d = planner.compute_replicas(num_req=10000, isl=8192, osl=1024)
+    assert d.prefill_replicas == 3 and d.decode_replicas == 3
+
+
+def test_chip_budget_trims_prefill_first():
+    planner = make_planner(chip_budget=4)
+    d = planner.compute_replicas(num_req=10000, isl=8192, osl=1024)
+    assert d.prefill_replicas + d.decode_replicas <= 4
+    assert d.decode_replicas >= d.prefill_replicas
+
+
+def test_ttft_correction_scales_prefill_up():
+    planner = make_planner()
+    base = planner.compute_replicas(num_req=1000, isl=512, osl=128)
+    # Observed TTFT 3× the interpolated value → queueing → more prefill.
+    planner.observe(Metrics(num_req=1000, isl=512, osl=128,
+                            ttft_s=3 * (0.1 + 512 / 8000.0), itl_s=None))
+    assert planner.p_correction == pytest.approx(3.0)
+    corrected = planner.compute_replicas(num_req=1000, isl=512, osl=128)
+    assert corrected.prefill_replicas > base.prefill_replicas
+
+
+def test_observe_predict_plan_cycle():
+    planner = make_planner(load_predictor="moving_average")
+    for _ in range(5):
+        planner.observe(Metrics(num_req=200, isl=1024, osl=256))
+    num_req, isl, osl = planner.predict_load()
+    assert (num_req, isl, osl) == (200, 1024, 256)
+    d = planner.plan()
+    assert d.prefill_replicas >= 1 and d.decode_replicas >= 1
+
+
+# -- prometheus parsing ------------------------------------------------------
+
+def test_parse_prometheus_text():
+    text = """
+# HELP dynamo_frontend_model_requests_total completed requests per model
+# TYPE dynamo_frontend_model_requests_total counter
+dynamo_frontend_model_requests_total{model="tiny-llama"} 42.0
+dynamo_frontend_input_tokens_total{model="tiny-llama"} 8400
+dynamo_frontend_time_to_first_token_seconds_sum{model="tiny-llama"} 2.5
+dynamo_frontend_time_to_first_token_seconds_count{model="tiny-llama"} 42
+"""
+    s = parse_prometheus(text)
+    key = ("dynamo_frontend_model_requests_total", frozenset({("model", "tiny-llama")}))
+    assert s[key] == 42.0
+
+
+# -- virtual connector (live coordinator) ------------------------------------
+
+async def test_virtual_connector_roundtrip():
+    from dynamo_tpu.transports.client import CoordinatorClient
+    from dynamo_tpu.transports.coordinator import CoordinatorServer
+    from dynamo_tpu.planner.connector import VirtualConnector
+
+    server = CoordinatorServer()
+    port = await server.start()
+    try:
+        client = await CoordinatorClient.connect(f"tcp://127.0.0.1:{port}")
+        vc = VirtualConnector(client, "testns")
+        await vc.apply(2, 3, "scale up")
+        decision = await vc.read()
+        assert decision["prefill_replicas"] == 2
+        assert decision["decode_replicas"] == 3
+        assert decision["revision"] == 1
+        await vc.apply(1, 1)
+        assert (await vc.read())["revision"] == 2
+        await client.close()
+    finally:
+        await server.stop()
