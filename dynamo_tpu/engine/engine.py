@@ -118,11 +118,13 @@ class ModelRunner:
         from dynamo_tpu.ops.paged_attention import select_attn_impl
 
         self.attn_impl = select_attn_impl(engine_cfg.attn_impl)
-        if self.attn_impl == "pallas" and mesh is not None and mesh.shape.get("model", 1) > 1:
-            # The kernel is not yet shard_map-wrapped; TP meshes use the
-            # dense path (XLA partitions the gather+matmul over "model").
-            log.info("pallas attention disabled under TP mesh; using dense path")
-            self.attn_impl = "dense"
+        if (self.attn_impl in ("pallas", "pallas_interpret") and mesh is not None
+                and mesh.shape.get("model", 1) > 1
+                and cfg.num_kv_heads % mesh.shape["model"] != 0):
+            log.warning(
+                "num_kv_heads=%d does not divide tp=%d: pallas attention will "
+                "fall back to the dense gather path", cfg.num_kv_heads,
+                mesh.shape["model"])
 
     def _auto_num_blocks(self) -> int:
         """Size the device KV pool from free memory (TPU) or a small default."""
@@ -149,11 +151,13 @@ class ModelRunner:
 
         attn_impl = self.attn_impl
         moe_impl = "ep" if self.engine_cfg.ep > 1 else "dense"
+        mesh = self.mesh
 
         def step(params, ck, cv, counts, keys, tokens, q_start, q_len, bt, slots,
                  temp, top_k, top_p, fp, pp, rp, do_sample):
             hidden, ck, cv = llama.forward(params, cfg, tokens, q_start, q_len, bt, ck, cv,
-                                           attn_impl=attn_impl, moe_impl=moe_impl)
+                                           attn_impl=attn_impl, moe_impl=moe_impl,
+                                           mesh=mesh)
             logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
             st = SamplingState(
                 temperature=temp, top_k=top_k, top_p=top_p,

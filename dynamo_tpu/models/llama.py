@@ -246,6 +246,7 @@ def forward(
     cache_v: jax.Array,
     attn_impl: str = "dense",
     moe_impl: str = "dense",
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One engine step. Returns (last_hidden [B,H], cache_k, cache_v).
 
@@ -256,6 +257,15 @@ def forward(
     """
     b, t = token_ids.shape
     bs = cache_k.shape[2]
+    tp = mesh.shape.get("model", 1) if mesh is not None else 1
+    dp = mesh.shape.get("data", 1) if mesh is not None else 1
+    if attn_impl in ("pallas", "pallas_interpret") and tp > 1 and (
+        cfg.num_kv_heads % tp != 0 or b % dp != 0
+    ):
+        # Heads/batch don't divide the mesh: fall back to the dense gather
+        # path, partitioned by GSPMD (trace-time decision; logged once at
+        # engine init where the head/mesh mismatch is known statically).
+        attn_impl = "dense"
     positions = q_start[:, None] + jnp.arange(t)[None, :]          # [B, T]
     valid = jnp.arange(t)[None, :] < q_len[:, None]                # [B, T]
     kv_lens = q_start + q_len                                      # [B]
@@ -280,12 +290,23 @@ def forward(
         ck = _scatter_kv(ck, k, slot)
         cv = _scatter_kv(cv, v, slot)
         if attn_impl in ("pallas", "pallas_interpret"):
-            from dynamo_tpu.ops.paged_attention import paged_attention_kernel
-
-            attn = paged_attention_kernel(
-                q, ck, cv, block_tables, q_start, kv_lens,
-                interpret=(attn_impl == "pallas_interpret"),
+            from dynamo_tpu.ops.paged_attention import (
+                paged_attention_kernel,
+                paged_attention_sharded,
             )
+
+            interp = attn_impl == "pallas_interpret"
+            if tp > 1:
+                # TP: shard_map the kernel over the head axis; GSPMD's psum
+                # in the wo projection completes the TP contraction.
+                attn = paged_attention_sharded(
+                    mesh, q, ck, cv, block_tables, q_start, kv_lens,
+                    interpret=interp,
+                )
+            else:
+                attn = paged_attention_kernel(
+                    q, ck, cv, block_tables, q_start, kv_lens, interpret=interp,
+                )
         else:
             ctx_k = _gather_kv(ck, block_tables)
             ctx_v = _gather_kv(cv, block_tables)

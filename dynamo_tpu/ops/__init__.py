@@ -8,6 +8,10 @@ kernels with numerically-equivalent XLA fallbacks for CPU tests:
 - ring_attention: blockwise attention sharded over the "seq" mesh axis.
 """
 
-from dynamo_tpu.ops.paged_attention import paged_attention_kernel, select_attn_impl
+from dynamo_tpu.ops.paged_attention import (
+    paged_attention_kernel,
+    paged_attention_sharded,
+    select_attn_impl,
+)
 
-__all__ = ["paged_attention_kernel", "select_attn_impl"]
+__all__ = ["paged_attention_kernel", "paged_attention_sharded", "select_attn_impl"]

@@ -3,26 +3,33 @@
 The portable path in models/llama.py gathers the whole paged context into a
 dense ``[B, S, KH, D]`` tensor in HBM before attending — correct, but it
 materializes S=NBLK*BS rows per sequence and streams them twice. This kernel
-instead walks the block table directly: for each (sequence, kv-head, context
-block) grid step, Pallas DMAs exactly one KV block ``[BS, D]`` from HBM into
+instead walks the block table directly: for each (sequence, context block)
+grid step, Pallas DMAs exactly one KV block ``[BS, KH, D]`` from HBM into
 VMEM (double-buffered across grid steps via the index map) and folds it into
 a running online softmax. No gathered context tensor ever exists.
 
 Works for both prefill chunks (T>1 query tokens) and decode (T=1) with the
 same causal position masking as the dense path. Numerical equivalence is
-tested in tests/test_ops.py (interpret mode on CPU).
+tested in tests/test_ops.py; TPU lowering is proven by bench.py on hardware.
 
 Design notes (reference has no TPU analog; its one kernel is a CUDA block
 copy, lib/llm/src/kernels/block_copy.cu — paged attention itself lives
 inside vLLM/TRT-LLM, which we replace):
-- grid = (B, KH, NBLK): batch and kv-head are parallel; the context-block
-  axis is sequential ("arbitrary") carrying the softmax state in VMEM
-  scratch (acc, row-max m, row-sum l).
+- grid = (B, NBLK): batch is parallel; the context-block axis is sequential
+  ("arbitrary") carrying the softmax state in VMEM scratch (acc, row-max m,
+  row-sum l), one slab per kv head.
 - block tables + positions are scalar-prefetched (PrefetchScalarGridSpec)
   so the K/V BlockSpec index maps can address HBM blocks by table lookup —
   the DMA pipeline chases the page table, the kernel body never sees HBM.
-- q rows are laid out [T*rep, D] (rep = query heads per kv head) so one
-  MXU matmul covers all query heads of the kv head.
+- K/V blocks load ALL kv heads at once — block shape ``(1, BS, KH, D)``
+  equals the array's trailing dims, which always satisfies Mosaic's tiling
+  constraint (the round-1 kernel's per-head block ``(1, BS, 1, D)`` had a
+  second-to-minor dim of 1 against KH=8 and failed to lower). The kv-head
+  loop is a static Python loop inside the kernel: KH small 2D matmuls on
+  the MXU per block.
+- q rows are pre-laid-out ``[B, KH, T*REP, D]`` (rep = query heads per kv
+  head) outside the kernel so each head's queries are one contiguous 2D
+  slab — one MXU matmul covers all query heads of the kv head.
 - blocks past a sequence's kv_len skip compute via pl.when (their DMA still
   runs; the trash-block index 0 keeps it in-bounds).
 """
@@ -36,13 +43,16 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30
+_SCRATCH_CAP_BYTES = 4 * 2**20  # online-softmax VMEM scratch budget
 
 
 def _kernel(bt_ref, qs_ref, kl_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-            bs: int, rep: int):
+            bs: int, kh: int, rep: int):
     b = pl.program_id(0)
+    qi = pl.program_id(1)
     j = pl.program_id(2)
     nblk = pl.num_programs(2)
 
@@ -56,42 +66,44 @@ def _kernel(bt_ref, qs_ref, kl_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, 
 
     @pl.when(j * bs < kv_len)
     def _compute():
-        t = q_ref.shape[1]
-        q = q_ref[0, :, 0].astype(jnp.float32).reshape(t * rep, -1)   # [R, D]
-        k = k_ref[0, :, 0].astype(jnp.float32)                        # [BS, D]
-        v = v_ref[0, :, 0].astype(jnp.float32)                        # [BS, D]
-        scores = lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )                                                             # [R, BS]
-        r = t * rep
-        row_t = lax.broadcasted_iota(jnp.int32, (r, bs), 0) // rep    # query token idx
+        r = q_ref.shape[2]  # rows in this q chunk (row = token*rep + q-head)
+        # Causal/visibility mask is head-independent: [R, BS].
+        row = lax.broadcasted_iota(jnp.int32, (r, bs), 0) + qi * r
+        row_t = row // rep                                            # query token idx
         ctx = lax.broadcasted_iota(jnp.int32, (r, bs), 1) + j * bs    # context position
         q_pos = qs_ref[b] + row_t
         visible = (ctx <= q_pos) & (ctx < kv_len)
-        scores = jnp.where(visible, scores, NEG_INF)
 
-        m_prev = m_ref[:, :1]                                         # [R, 1]
-        l_prev = l_ref[:, :1]
-        m_curr = jnp.max(scores, axis=1, keepdims=True)               # [R, 1]
-        m_new = jnp.maximum(m_prev, m_curr)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new)                                   # [R, BS]
-        p = jnp.where(visible, p, 0.0)
-        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        pv = lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )                                                             # [R, D]
-        acc_ref[:] = acc_ref[:] * alpha + pv
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        for ki in range(kh):
+            q = q_ref[0, ki].astype(jnp.float32)                      # [R, D]
+            k = k_ref[0, :, ki].astype(jnp.float32)                   # [BS, D]
+            v = v_ref[0, :, ki].astype(jnp.float32)                   # [BS, D]
+            scores = lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )                                                         # [R, BS]
+            scores = jnp.where(visible, scores, NEG_INF)
+
+            m_prev = m_ref[ki, :, :1]                                 # [R, 1]
+            l_prev = l_ref[ki, :, :1]
+            m_curr = jnp.max(scores, axis=1, keepdims=True)           # [R, 1]
+            m_new = jnp.maximum(m_prev, m_curr)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(scores - m_new)                               # [R, BS]
+            p = jnp.where(visible, p, 0.0)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            pv = lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )                                                         # [R, D]
+            acc_ref[ki] = acc_ref[ki] * alpha + pv
+            m_ref[ki] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+            l_ref[ki] = jnp.broadcast_to(l_new, l_ref.shape[1:])
 
     @pl.when(j == nblk - 1)
     def _finish():
-        t = o_ref.shape[1]
-        l = l_ref[:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)                               # all-masked rows → 0
-        out = (acc_ref[:] / l).reshape(t, rep, -1)
-        o_ref[0, :, 0] = out.astype(o_ref.dtype)
+        for ki in range(kh):
+            l = l_ref[ki, :, :1]
+            l = jnp.where(l == 0.0, 1.0, l)                           # all-masked rows → 0
+            o_ref[0, ki] = (acc_ref[ki] / l).astype(o_ref.dtype)
 
 
 def paged_attention_kernel(
@@ -109,42 +121,91 @@ def paged_attention_kernel(
     nb, bs, kh, _ = k_cache.shape
     nblk = block_tables.shape[1]
     rep = h // kh
+    # [B, T, KH, REP, D] → [B, KH, T*REP, D]: one contiguous query slab per
+    # kv head (row r ↔ query token r // rep, query head r % rep).
     qs = (q * (d ** -0.5)).reshape(b, t, kh, rep, d)
+    qs = qs.transpose(0, 2, 1, 3, 4).reshape(b, kh, t * rep, d)
+
+    # Chunk the query rows (flash tiling) so the all-head softmax scratch
+    # stays within a few MB of VMEM for long prefill chunks: scratch bytes =
+    # KH * rchunk * (D + 256) * 4. Decode (T=1) always fits in one chunk, so
+    # each KV block is still DMA'd exactly once per step on the hot path.
+    r = t * rep
+    rchunk = r
+    while kh * rchunk * (d + 256) * 4 > _SCRATCH_CAP_BYTES and rchunk % 2 == 0 and rchunk > rep:
+        rchunk //= 2
+    nq = r // rchunk
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # block_tables, q_start, kv_lens
-        grid=(b, kh, nblk),
+        grid=(b, nq, nblk),
         in_specs=[
-            pl.BlockSpec((1, t, 1, rep, d), lambda bi, ki, j, bt, qp, kl: (bi, 0, ki, 0, 0)),
-            pl.BlockSpec((1, bs, 1, d), lambda bi, ki, j, bt, qp, kl: (bt[bi, j], 0, ki, 0)),
-            pl.BlockSpec((1, bs, 1, d), lambda bi, ki, j, bt, qp, kl: (bt[bi, j], 0, ki, 0)),
+            pl.BlockSpec((1, kh, rchunk, d), lambda bi, qi, j, bt, qp, kl: (bi, 0, qi, 0)),
+            pl.BlockSpec((1, bs, kh, d), lambda bi, qi, j, bt, qp, kl: (bt[bi, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, kh, d), lambda bi, qi, j, bt, qp, kl: (bt[bi, j], 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, t, 1, rep, d), lambda bi, ki, j, bt, qp, kl: (bi, 0, ki, 0, 0)),
+        out_specs=pl.BlockSpec((1, kh, rchunk, d), lambda bi, qi, j, bt, qp, kl: (bi, 0, qi, 0)),
         scratch_shapes=[
-            pltpu.VMEM((t * rep, d), jnp.float32),
-            pltpu.VMEM((t * rep, 128), jnp.float32),
-            pltpu.VMEM((t * rep, 128), jnp.float32),
+            pltpu.VMEM((kh, rchunk, d), jnp.float32),
+            pltpu.VMEM((kh, rchunk, 128), jnp.float32),
+            pltpu.VMEM((kh, rchunk, 128), jnp.float32),
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, bs=bs, rep=rep),
+        functools.partial(_kernel, bs=bs, kh=kh, rep=rep),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, t, kh, rep, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, kh, t * rep, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), q_start.astype(jnp.int32), kv_lens.astype(jnp.int32),
       qs, k_cache, v_cache)
-    return out.reshape(b, t, h, d)
+    # [B, KH, T*REP, D] → [B, T, H, D]
+    return out.reshape(b, kh, t, rep, d).transpose(0, 2, 1, 3, 4).reshape(b, t, h, d)
+
+
+def paged_attention_sharded(
+    mesh,
+    q: jax.Array,             # [B, T, H, D] — H sharded on "model"
+    k_cache: jax.Array,       # [NB, BS, KH, D] — KH sharded on "model"
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, NBLK]
+    q_start: jax.Array,       # [B]
+    kv_lens: jax.Array,       # [B]
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """TP-sharded paged attention: shard_map the kernel over the "model"
+    (head) axis so each device runs the kernel on its local heads. Heads are
+    fully parallel in attention, so no collective is needed — the psum for
+    TP happens in the subsequent wo projection, inserted by GSPMD.
+
+    Batch rides the "data" axis (size-1 no-op on pure-TP meshes).
+    """
+    fn = jax.shard_map(
+        functools.partial(paged_attention_kernel, interpret=interpret),
+        mesh=mesh,
+        in_specs=(
+            P("data", None, "model", None),
+            P(None, None, "model", None),
+            P(None, None, "model", None),
+            P("data", None),
+            P("data"),
+            P("data"),
+        ),
+        out_specs=P("data", None, "model", None),
+        check_vma=False,
+    )
+    return fn(q, k_cache, v_cache, block_tables.astype(jnp.int32),
+              q_start.astype(jnp.int32), kv_lens.astype(jnp.int32))
 
 
 def select_attn_impl(requested: str = "auto") -> str:
     """Resolve the attention implementation name.
 
-    "auto" → "pallas" on TPU, "dense" elsewhere. TP-sharded meshes currently
-    use the dense path (the kernel is not yet wrapped in shard_map); the
-    engine handles that guard.
+    "auto" → "pallas" on TPU, "dense" elsewhere. TP-sharded meshes use the
+    shard_map-wrapped kernel (paged_attention_sharded).
     """
     if requested != "auto":
         return requested
