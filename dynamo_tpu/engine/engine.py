@@ -347,6 +347,11 @@ class EngineCore:
     def step(self) -> dict[str, LLMEngineOutput]:
         """Run one engine step; returns per-request output deltas."""
         plan = self.sched.plan()
+        if self.kvbm is not None:
+            # Write back blocks evicted during planning before their slots
+            # are rewritten by this step's KV scatter (batched: one bucketed
+            # transfer instead of per-eviction round-trips).
+            self.kvbm.flush_pending()
         self.metrics.num_preemptions = self.sched.preemption_count
         if plan.empty:
             return {}
@@ -434,7 +439,9 @@ class EngineCore:
 
         by_hash = {h: data for h, _, data in plan}
         filtered = plan_onboard(self.pool, [h for h, _, _ in plan], by_hash.get)
-        return inject_and_commit(self.runner, self.pool, self.transfer, filtered)
+        flush = self.kvbm.flush_pending if self.kvbm is not None else None
+        return inject_and_commit(self.runner, self.pool, self.transfer, filtered,
+                                 flush=flush)
 
     def pin_blocks(self, seq_hashes: list[int]) -> list[int]:
         """Incref the device-resident prefix of a chain so it survives until
@@ -503,14 +510,21 @@ class AsyncJaxEngine:
                 elif kind == "exec":
                     # Arbitrary core access (KV export/import/pin for disagg)
                     # marshaled onto this thread — the only thread allowed to
-                    # touch device state.
-                    fn, fut = payload
+                    # touch device state. The future resolves on the loop it
+                    # was created on (the caller's), which may differ from
+                    # self._loop — cross-loop set_result is not thread-safe.
+                    fn, fut, fut_loop = payload
                     try:
-                        result = fn(self.core)
-                    except Exception as exc:
-                        self._loop.call_soon_threadsafe(self._resolve, fut, None, exc)
-                    else:
-                        self._loop.call_soon_threadsafe(self._resolve, fut, result, None)
+                        result, exc = fn(self.core), None
+                    except Exception as e:
+                        result, exc = None, e
+                    try:
+                        fut_loop.call_soon_threadsafe(self._resolve, fut, result, exc)
+                    except RuntimeError:
+                        # Caller's loop closed before we resolved (e.g. a
+                        # cancelled asyncio.run): the future's owner is gone;
+                        # dropping the result must not kill this thread.
+                        log.warning("exec result dropped: caller loop closed")
             if not self.core.has_work():
                 if not moved:
                     self._wake.wait(timeout=0.05)
@@ -541,8 +555,9 @@ class AsyncJaxEngine:
     async def run_in_core(self, fn: Callable[[EngineCore], Any]) -> Any:
         """Run ``fn(core)`` on the engine-core thread and await its result."""
         self.start()
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._inbox.put(("exec", (fn, fut)))
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._inbox.put(("exec", (fn, fut, loop)))
         self._wake.set()
         return await fut
 

@@ -58,16 +58,23 @@ def plan_onboard(
 
 
 def inject_and_commit(runner, pool: PrefixPool, transfer: BlockTransferEngine,
-                      plan: OnboardPlan) -> int:
+                      plan: OnboardPlan, flush: Callable[[], int] | None = None) -> int:
     """Allocate device blocks, scatter the plan's data in, and commit them as
     matchable inactive cache entries. Returns blocks injected (0 if the pool
-    can't make room). ``runner`` is duck-typed: mutable cache_k/cache_v."""
+    can't make room). ``runner`` is duck-typed: mutable cache_k/cache_v.
+
+    ``flush`` (the offload manager's write-back flush) runs between the
+    allocation and the inject: the allocate may queue evictions of the very
+    blocks being recycled, and their contents must be extracted before the
+    inject overwrites them."""
     if not plan:
         return 0
     try:
         block_ids = pool.allocate(len(plan))
     except NoFreeBlocks:
         return 0
+    if flush is not None:
+        flush()
     runner.cache_k, runner.cache_v = transfer.inject(
         runner.cache_k, runner.cache_v, block_ids,
         [data for _, _, data in plan],
@@ -103,16 +110,35 @@ class OffloadManager:
         self.tiers = tiers
         self.transfer = BlockTransferEngine()
         self.stats = OffloadStats()
+        self._pending: list[tuple[int, int]] = []  # (block_id, seq_hash)
         pool.evict_hook = self._on_evict
 
     # -- offload -----------------------------------------------------------
     def _on_evict(self, block_id: int, seq_hash: int) -> None:
+        """Queue the eviction; the device copy happens in one bucketed
+        transfer at flush_pending() (an eviction-per-gather here would
+        serialize step() with many tiny device round-trips)."""
         top = self.tiers[0]
         if seq_hash in top:
             return
-        [block] = self.transfer.extract(self.runner.cache_k, self.runner.cache_v, [block_id])
-        top.put(seq_hash, block)
-        self.stats.offloaded_blocks += 1
+        self._pending.append((block_id, seq_hash))
+
+    def flush_pending(self) -> int:
+        """Extract all queued evictions in one bucketed transfer and store
+        them in the top tier. Must run before the evicted slots are rewritten
+        (engine step / onboard inject); callers: EngineCore.step,
+        inject_and_commit."""
+        if not self._pending:
+            return 0
+        pending, self._pending = self._pending, []
+        blocks = self.transfer.extract(
+            self.runner.cache_k, self.runner.cache_v, [b for b, _ in pending]
+        )
+        top = self.tiers[0]
+        for (_, seq_hash), block in zip(pending, blocks):
+            top.put(seq_hash, block)
+        self.stats.offloaded_blocks += len(pending)
+        return len(pending)
 
     # -- onboard -----------------------------------------------------------
     def _lookup(self, seq_hash: int) -> np.ndarray | None:
@@ -130,7 +156,8 @@ class OffloadManager:
         ``_on_evict`` (safe: the evicted blocks are disjoint from the ones
         being loaded, and tier ``get`` returned copies)."""
         plan = plan_onboard(self.pool, seq_hashes, self._lookup)
-        n = inject_and_commit(self.runner, self.pool, self.transfer, plan)
+        n = inject_and_commit(self.runner, self.pool, self.transfer, plan,
+                              flush=self.flush_pending)
         self.stats.onboarded_blocks += n
         return n
 

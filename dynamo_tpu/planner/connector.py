@@ -35,13 +35,25 @@ class VirtualConnector:
         self.client = client
         self.namespace = namespace
         self.revision = 0
+        self._seeded = False
 
     @property
     def key(self) -> str:
         return f"{DECISIONS_PREFIX}/{self.namespace}"
 
+    async def _seed_revision(self) -> None:
+        """Resume the revision counter from the stored decision so a planner
+        restart never regresses it (an orchestrator deduplicating by revision
+        would ignore fresh decisions otherwise)."""
+        existing = await self.read()
+        if existing and isinstance(existing.get("revision"), int):
+            self.revision = max(self.revision, existing["revision"])
+        self._seeded = True
+
     async def apply(self, prefill_replicas: int, decode_replicas: int,
                     reason: str = "") -> None:
+        if not self._seeded:
+            await self._seed_revision()
         self.revision += 1
         await self.client.put(self.key, json.dumps({
             "revision": self.revision,

@@ -72,6 +72,13 @@ class FrontendScraper:
 
     async def observe_interval(self) -> Metrics:
         cur = await self.fetch()
+        if self._prev is None:
+            # First scrape: only establish the baseline. Diffing against zero
+            # would report all-time cumulative totals as one interval's load
+            # (an attach to a long-running frontend could spuriously scale to
+            # max_replicas and pollute the predictor window).
+            self._prev = cur
+            return Metrics()  # all-default: num_req=0 → planner skips it
         n_req = self._delta(cur, "dynamo_frontend_model_requests_total")
         in_tok = self._delta(cur, "dynamo_frontend_input_tokens_total")
         out_tok = self._delta(cur, "dynamo_frontend_output_tokens_total")
