@@ -102,7 +102,25 @@ class ModelRunner:
         self.engine_cfg = engine_cfg
         self.mesh = mesh
         key = jax.random.key(rng_seed)
-        self.params = params if params is not None else llama.init_params(cfg, key)
+        if params is not None:
+            self.params = params
+        else:
+            from dynamo_tpu.models.loader import has_weights, load_params
+
+            if has_weights(engine_cfg.model):
+                self.params = load_params(cfg, engine_cfg.model, mesh=mesh)
+            else:
+                import os
+
+                if os.path.isdir(engine_cfg.model):
+                    # A real model dir without safetensors (e.g. .bin-only
+                    # snapshot): serving random weights here would look like
+                    # a working server producing garbage.
+                    log.warning(
+                        "%s has no *.safetensors weights: engine will serve "
+                        "RANDOM weights (convert the checkpoint to "
+                        "safetensors to load it)", engine_cfg.model)
+                self.params = llama.init_params(cfg, key)
         num_blocks = engine_cfg.num_blocks or self._auto_num_blocks()
         self.spec = KVCacheSpec.for_model(cfg, num_blocks, engine_cfg.block_size)
         self.cache_k, self.cache_v = allocate_cache(self.spec, mesh)

@@ -54,7 +54,17 @@ class ModelConfig:
         """Read a local HF config.json (llama-family keys)."""
         cfg = json.loads((Path(path) / "config.json").read_text())
         n_heads = cfg["num_attention_heads"]
+        # MoE keys across HF families: mixtral (num_local_experts),
+        # deepseek/qwen-moe (n_routed_experts, num_experts).
+        n_experts = (cfg.get("num_local_experts") or cfg.get("n_routed_experts")
+                     or cfg.get("num_experts") or 0)
         return cls(
+            num_experts=n_experts,
+            num_experts_per_tok=cfg.get("num_experts_per_tok", 2 if n_experts else 0),
+            moe_intermediate_size=cfg.get(
+                "moe_intermediate_size",
+                cfg["intermediate_size"] if n_experts else 0),
+            num_shared_experts=cfg.get("n_shared_experts", 0) or 0,
             name=cfg.get("_name_or_path", Path(path).name),
             vocab_size=cfg["vocab_size"],
             hidden_size=cfg["hidden_size"],

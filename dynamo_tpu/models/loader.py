@@ -1,0 +1,321 @@
+"""Checkpoint loading: HF safetensors → the engine's params pytree.
+
+Fills the reference's LocalModel/hub role (reference:
+lib/llm/src/local_model.rs:45 LocalModelBuilder, lib/llm/src/hub.rs HF
+resolution, lib/llm/src/gguf.rs single-file weights) — but TPU-first:
+
+- The safetensors container is parsed directly (8-byte header length +
+  JSON header + raw little-endian data) over ``mmap``, so tensor reads are
+  zero-copy views; no safetensors/torch dependency.
+- HF llama-family tensor names map onto the stacked-layer pytree that
+  ``models/llama.forward`` scans over: per-layer weights are gathered into
+  one ``[L, ...]`` array per parameter (filled layer-by-layer from the
+  mapped files to bound peak host memory), projections are transposed from
+  HF's ``[out, in]`` to the engine's row-major ``x @ W`` layout.
+- When a mesh is given, each finished parameter is placed with its
+  logical-axis sharding (parallel/mesh.py rules) as it is built — the full
+  replicated pytree never materializes on one device.
+
+RoPE note: our ``rope`` uses the half-rotate convention, matching HF
+transformers' llama checkpoints — weights need no permutation (the
+interleaved→half-rotate permutation is only needed for Meta's original
+distribution format, which HF checkpoints already incorporate).
+
+MoE checkpoints (mixtral-style ``block_sparse_moe`` names) map onto the
+stacked expert arrays; shared-expert variants use the dense-MLP names.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+from pathlib import Path
+from typing import Any, Iterator
+
+import numpy as np
+
+try:  # jax ships ml_dtypes; bf16 numpy arrays view through it
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("loader")
+
+_ST_DTYPES: dict[str, np.dtype] = {
+    "F64": np.dtype(np.float64),
+    "F32": np.dtype(np.float32),
+    "F16": np.dtype(np.float16),
+    "I64": np.dtype(np.int64),
+    "I32": np.dtype(np.int32),
+    "I16": np.dtype(np.int16),
+    "I8": np.dtype(np.int8),
+    "U8": np.dtype(np.uint8),
+    "BOOL": np.dtype(np.bool_),
+}
+if _BF16 is not None:
+    _ST_DTYPES["BF16"] = _BF16
+
+
+class SafetensorsFile:
+    """Zero-copy reader for one .safetensors file (mmap-backed)."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        with open(self.path, "rb") as f:
+            self._mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        header_len = int.from_bytes(self._mm[:8], "little")
+        self.header: dict[str, Any] = json.loads(self._mm[8 : 8 + header_len])
+        self.header.pop("__metadata__", None)
+        self._base = 8 + header_len
+
+    def names(self) -> list[str]:
+        return list(self.header)
+
+    def tensor(self, name: str) -> np.ndarray:
+        meta = self.header[name]
+        dtype = _ST_DTYPES[meta["dtype"]]
+        shape = meta["shape"]
+        start, _end = meta["data_offsets"]
+        count = int(np.prod(shape)) if shape else 1
+        arr = np.frombuffer(self._mm, dtype=dtype, count=count,
+                            offset=self._base + start)
+        return arr.reshape(shape)
+
+
+def save_safetensors(path: str | Path, tensors: dict[str, np.ndarray]) -> None:
+    """Write a .safetensors file (tests + checkpoint tooling)."""
+    codes = {v: k for k, v in _ST_DTYPES.items()}
+    header: dict[str, Any] = {}
+    offset = 0
+    blobs: list[bytes] = []
+    for name, a in tensors.items():
+        a = np.ascontiguousarray(a)
+        code = codes[np.dtype(a.dtype)]
+        header[name] = {
+            "dtype": code,
+            "shape": list(a.shape),
+            "data_offsets": [offset, offset + a.nbytes],
+        }
+        blobs.append(a.tobytes())
+        offset += a.nbytes
+    hj = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(len(hj).to_bytes(8, "little"))
+        f.write(hj)
+        for b in blobs:
+            f.write(b)
+
+
+class CheckpointReader:
+    """Name→tensor access across a sharded checkpoint directory.
+
+    Resolves ``model.safetensors.index.json`` (weight_map) when present,
+    else unions all ``*.safetensors`` files in the directory."""
+
+    def __init__(self, model_dir: str | Path):
+        self.dir = Path(model_dir)
+        self._files: dict[str, SafetensorsFile] = {}
+        self._where: dict[str, str] = {}
+        index = self.dir / "model.safetensors.index.json"
+        if index.exists():
+            weight_map = json.loads(index.read_text())["weight_map"]
+            for name, fname in weight_map.items():
+                self._where[name] = fname
+        else:
+            for p in sorted(self.dir.glob("*.safetensors")):
+                for name in self._file(p.name).names():
+                    self._where[name] = p.name
+        if not self._where:
+            raise FileNotFoundError(f"no safetensors weights under {self.dir}")
+
+    def _file(self, fname: str) -> SafetensorsFile:
+        if fname not in self._files:
+            self._files[fname] = SafetensorsFile(self.dir / fname)
+        return self._files[fname]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._where
+
+    def names(self) -> list[str]:
+        return list(self._where)
+
+    def get(self, name: str) -> np.ndarray:
+        return self._file(self._where[name]).tensor(name)
+
+
+def has_weights(model_dir: str | Path) -> bool:
+    p = Path(model_dir)
+    return p.is_dir() and (
+        (p / "model.safetensors.index.json").exists()
+        or any(p.glob("*.safetensors"))
+    )
+
+
+# ---------------------------------------------------------------------------
+# HF llama-family name mapping
+# ---------------------------------------------------------------------------
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        if _BF16 is None:  # pragma: no cover
+            raise RuntimeError("bfloat16 load requires ml_dtypes")
+        return _BF16
+    return np.dtype(name)
+
+
+def _layer_specs(cfg: ModelConfig, family: str) -> dict[str, tuple[str, bool]]:
+    """Our layer param name → (HF suffix under model.layers.{i}., transpose).
+
+    Transpose=True: HF stores linear weights as [out_features, in_features];
+    the engine computes ``x @ W`` with W as [in, out].
+    """
+    specs = {
+        "wq": ("self_attn.q_proj.weight", True),
+        "wk": ("self_attn.k_proj.weight", True),
+        "wv": ("self_attn.v_proj.weight", True),
+        "wo": ("self_attn.o_proj.weight", True),
+        "attn_norm": ("input_layernorm.weight", False),
+        "mlp_norm": ("post_attention_layernorm.weight", False),
+    }
+    if cfg.is_moe:
+        router = ("block_sparse_moe.gate.weight" if family == "mixtral"
+                  else "mlp.gate.weight")
+        specs["router"] = (router, True)
+        if cfg.num_shared_experts:
+            specs.update(
+                shared_gate=("mlp.shared_experts.gate_proj.weight", True),
+                shared_up=("mlp.shared_experts.up_proj.weight", True),
+                shared_down=("mlp.shared_experts.down_proj.weight", True),
+            )
+    else:
+        specs.update(
+            w_gate=("mlp.gate_proj.weight", True),
+            w_up=("mlp.up_proj.weight", True),
+            w_down=("mlp.down_proj.weight", True),
+        )
+    return specs
+
+
+def _moe_family(reader: "CheckpointReader", cfg: ModelConfig) -> str:
+    """Detect the MoE naming family from the checkpoint's tensor names:
+    mixtral (block_sparse_moe.experts.N.w1/w2/w3) vs deepseek/qwen-moe
+    (mlp.experts.N.gate_proj/up_proj/down_proj + optional shared_experts)."""
+    if "model.layers.0.block_sparse_moe.gate.weight" in reader:
+        if cfg.num_shared_experts:
+            raise ValueError(
+                "config declares shared experts but checkpoint uses "
+                "mixtral-style names, which have none")
+        return "mixtral"
+    if "model.layers.0.mlp.gate.weight" in reader:
+        return "deepseek"
+    raise ValueError(
+        "MoE config but no recognized MoE router tensor in checkpoint "
+        "(looked for block_sparse_moe.gate / mlp.gate)")
+
+
+def _expert_specs(family: str) -> dict[str, str]:
+    """Routed-expert weights: our name → HF suffix pattern.
+
+    Mixtral convention: w1=gate, w3=up, w2=down."""
+    if family == "mixtral":
+        return {
+            "w_gate": "block_sparse_moe.experts.{e}.w1.weight",
+            "w_up": "block_sparse_moe.experts.{e}.w3.weight",
+            "w_down": "block_sparse_moe.experts.{e}.w2.weight",
+        }
+    return {
+        "w_gate": "mlp.experts.{e}.gate_proj.weight",
+        "w_up": "mlp.experts.{e}.up_proj.weight",
+        "w_down": "mlp.experts.{e}.down_proj.weight",
+    }
+
+
+def iter_param_leaves(
+    cfg: ModelConfig, reader: CheckpointReader, dtype: np.dtype
+) -> Iterator[tuple[tuple[str, ...], np.ndarray]]:
+    """Yield ((pytree path), stacked ndarray) for every model parameter.
+
+    Layer params are stacked into [L, ...] host arrays filled one layer at a
+    time from the mmap'd files, so peak host memory is one full parameter,
+    not one full checkpoint.
+    """
+    L = cfg.num_layers
+    family = _moe_family(reader, cfg) if cfg.is_moe else "llama"
+
+    def grab(name: str, transpose: bool) -> np.ndarray:
+        if name not in reader:
+            raise KeyError(
+                f"checkpoint is missing tensor {name!r} (family={family}); "
+                f"config/checkpoint mismatch?")
+        t = reader.get(name)
+        if transpose:
+            t = t.T
+        return np.ascontiguousarray(t, dtype=dtype)
+
+    yield ("embed",), grab("model.embed_tokens.weight", False)
+    yield ("final_norm",), grab("model.norm.weight", False)
+    if not cfg.tie_word_embeddings:
+        yield ("lm_head",), grab("lm_head.weight", True)
+
+    for our, (suffix, transpose) in _layer_specs(cfg, family).items():
+        first = grab(f"model.layers.0.{suffix}", transpose)
+        out = np.empty((L, *first.shape), dtype=dtype)
+        out[0] = first
+        for i in range(1, L):
+            out[i] = grab(f"model.layers.{i}.{suffix}", transpose)
+        yield ("layers", our), out
+
+    if cfg.is_moe:
+        E = cfg.num_experts
+        for our, pattern in _expert_specs(family).items():
+            first = grab(f"model.layers.0.{pattern.format(e=0)}", True)
+            out = np.empty((L, E, *first.shape), dtype=dtype)
+            for i in range(L):
+                for e in range(E):
+                    out[i, e] = grab(
+                        f"model.layers.{i}.{pattern.format(e=e)}", True
+                    )
+            yield ("layers", our), out
+
+
+def load_params(
+    cfg: ModelConfig, model_dir: str | Path, mesh=None
+) -> dict[str, Any]:
+    """Load an HF llama-family checkpoint into the engine's params pytree.
+
+    With a mesh, each parameter is placed with its logical-axis sharding as
+    soon as it is assembled (parallel/mesh.py rules); without one, params
+    land on the default device.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import param_logical_axes
+    from dynamo_tpu.parallel.mesh import param_sharding_rules
+
+    reader = CheckpointReader(model_dir)
+    axes = param_logical_axes(cfg)
+    dtype = _np_dtype(cfg.dtype)
+    params: dict[str, Any] = {}
+    n_bytes = 0
+    for path, arr in iter_param_leaves(cfg, reader, dtype):
+        leaf_axes = axes
+        node = params
+        for key in path[:-1]:
+            leaf_axes = leaf_axes[key]
+            node = node.setdefault(key, {})
+        leaf_axes = leaf_axes[path[-1]]
+        if mesh is not None:
+            placed = jax.device_put(arr, param_sharding_rules(mesh, leaf_axes))
+        else:
+            placed = jnp.asarray(arr)
+        node[path[-1]] = placed
+        n_bytes += arr.nbytes
+    log.info("loaded %s: %.2f GiB of weights from %s",
+             cfg.name, n_bytes / 2**30, model_dir)
+    return params

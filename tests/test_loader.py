@@ -1,0 +1,246 @@
+"""Checkpoint loader tests: safetensors round-trip, HF name mapping, and an
+end-to-end serve of a real (tiny, generated) HF-layout checkpoint.
+
+Mirrors the reference's local_model/hub test strategy (its LocalModelBuilder
+is tested against toy checkpoints) with a generated llama-layout checkpoint.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.models.config import ModelConfig, resolve_model_config
+from dynamo_tpu.models.loader import (
+    CheckpointReader,
+    SafetensorsFile,
+    has_weights,
+    load_params,
+    save_safetensors,
+)
+
+
+def _tiny_cfg():
+    return ModelConfig(
+        name="ckpt-llama", vocab_size=96, hidden_size=32, intermediate_size=48,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=8,
+        tie_word_embeddings=False, dtype="float32",
+    )
+
+
+def _write_checkpoint(tmp_path, cfg, rng, split=False):
+    """Generate an HF-llama-layout checkpoint; returns the tensor dict."""
+    h, q, kv, i = (cfg.hidden_size, cfg.q_size, cfg.kv_size,
+                   cfg.intermediate_size)
+    tensors = {
+        "model.embed_tokens.weight": rng.standard_normal((cfg.vocab_size, h)),
+        "model.norm.weight": rng.standard_normal((h,)),
+        "lm_head.weight": rng.standard_normal((cfg.vocab_size, h)),
+    }
+    for l in range(cfg.num_layers):
+        p = f"model.layers.{l}."
+        tensors[p + "self_attn.q_proj.weight"] = rng.standard_normal((q, h))
+        tensors[p + "self_attn.k_proj.weight"] = rng.standard_normal((kv, h))
+        tensors[p + "self_attn.v_proj.weight"] = rng.standard_normal((kv, h))
+        tensors[p + "self_attn.o_proj.weight"] = rng.standard_normal((h, q))
+        tensors[p + "input_layernorm.weight"] = rng.standard_normal((h,))
+        tensors[p + "post_attention_layernorm.weight"] = rng.standard_normal((h,))
+        tensors[p + "mlp.gate_proj.weight"] = rng.standard_normal((i, h))
+        tensors[p + "mlp.up_proj.weight"] = rng.standard_normal((i, h))
+        tensors[p + "mlp.down_proj.weight"] = rng.standard_normal((h, i))
+    tensors = {k: v.astype(np.float32) for k, v in tensors.items()}
+
+    if split:  # sharded layout + index, as large HF checkpoints ship
+        names = sorted(tensors)
+        half = len(names) // 2
+        shards = {"model-00001.safetensors": names[:half],
+                  "model-00002.safetensors": names[half:]}
+        weight_map = {}
+        for fname, ns in shards.items():
+            save_safetensors(tmp_path / fname, {n: tensors[n] for n in ns})
+            weight_map.update({n: fname for n in ns})
+        (tmp_path / "model.safetensors.index.json").write_text(
+            json.dumps({"weight_map": weight_map}))
+    else:
+        save_safetensors(tmp_path / "model.safetensors", tensors)
+
+    (tmp_path / "config.json").write_text(json.dumps({
+        "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.head_dim, "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "tie_word_embeddings": cfg.tie_word_embeddings,
+        "torch_dtype": "float32",
+    }))
+    return tensors
+
+
+def test_safetensors_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    tensors = {
+        "a": rng.standard_normal((3, 5)).astype(np.float32),
+        "b": (rng.standard_normal((4,)) * 100).astype(np.float16),
+        "c": np.arange(6, dtype=np.int32).reshape(2, 3),
+    }
+    save_safetensors(tmp_path / "t.safetensors", tensors)
+    f = SafetensorsFile(tmp_path / "t.safetensors")
+    assert sorted(f.names()) == ["a", "b", "c"]
+    for name, ref in tensors.items():
+        np.testing.assert_array_equal(f.tensor(name), ref)
+
+
+def test_safetensors_bf16_roundtrip(tmp_path):
+    import ml_dtypes
+
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((8, 16)).astype(ml_dtypes.bfloat16)
+    save_safetensors(tmp_path / "t.safetensors", {"a": a})
+    out = SafetensorsFile(tmp_path / "t.safetensors").tensor("a")
+    assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(out, a)
+
+
+@pytest.mark.parametrize("split", [False, True])
+def test_load_params_maps_hf_names(tmp_path, split):
+    cfg = _tiny_cfg()
+    rng = np.random.default_rng(2)
+    tensors = _write_checkpoint(tmp_path, cfg, rng, split=split)
+    assert has_weights(tmp_path)
+    params = load_params(cfg, tmp_path)
+
+    np.testing.assert_allclose(
+        np.asarray(params["embed"]), tensors["model.embed_tokens.weight"])
+    np.testing.assert_allclose(
+        np.asarray(params["lm_head"]), tensors["lm_head.weight"].T)
+    # projections transposed, layers stacked
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["wq"][1]),
+        tensors["model.layers.1.self_attn.q_proj.weight"].T)
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["w_down"][0]),
+        tensors["model.layers.0.mlp.down_proj.weight"].T)
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["attn_norm"][1]),
+        tensors["model.layers.1.input_layernorm.weight"])
+
+
+def test_load_params_sharded_mesh(tmp_path):
+    from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = _tiny_cfg()
+    rng = np.random.default_rng(3)
+    _write_checkpoint(tmp_path, cfg, rng)
+    mesh = make_mesh(MeshConfig(tp=2))
+    params = load_params(cfg, tmp_path, mesh=mesh)
+    wq = params["layers"]["wq"]
+    # heads axis (last) sharded over "model"
+    assert wq.sharding.spec[-1] == "model"
+    assert not wq.sharding.is_fully_replicated
+
+
+def test_load_params_moe_deepseek_family(tmp_path):
+    """Deepseek/qwen-moe naming (mlp.gate router, mlp.experts.N.*_proj,
+    shared_experts) maps onto the stacked expert pytree."""
+    cfg = ModelConfig(
+        name="ckpt-moe", vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_layers=2, num_heads=2, num_kv_heads=2, head_dim=8,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=24,
+        num_shared_experts=1, tie_word_embeddings=True, dtype="float32",
+    )
+    rng = np.random.default_rng(5)
+    h, m, sm = cfg.hidden_size, cfg.moe_intermediate_size, cfg.moe_intermediate_size
+    tensors = {
+        "model.embed_tokens.weight": rng.standard_normal((cfg.vocab_size, h)),
+        "model.norm.weight": rng.standard_normal((h,)),
+    }
+    for l in range(cfg.num_layers):
+        p = f"model.layers.{l}."
+        for n, shape in (("self_attn.q_proj.weight", (cfg.q_size, h)),
+                         ("self_attn.k_proj.weight", (cfg.kv_size, h)),
+                         ("self_attn.v_proj.weight", (cfg.kv_size, h)),
+                         ("self_attn.o_proj.weight", (h, cfg.q_size)),
+                         ("input_layernorm.weight", (h,)),
+                         ("post_attention_layernorm.weight", (h,)),
+                         ("mlp.gate.weight", (cfg.num_experts, h)),
+                         ("mlp.shared_experts.gate_proj.weight", (sm, h)),
+                         ("mlp.shared_experts.up_proj.weight", (sm, h)),
+                         ("mlp.shared_experts.down_proj.weight", (h, sm))):
+            tensors[p + n] = rng.standard_normal(shape)
+        for e in range(cfg.num_experts):
+            q = f"{p}mlp.experts.{e}."
+            tensors[q + "gate_proj.weight"] = rng.standard_normal((m, h))
+            tensors[q + "up_proj.weight"] = rng.standard_normal((m, h))
+            tensors[q + "down_proj.weight"] = rng.standard_normal((h, m))
+    tensors = {k: v.astype(np.float32) for k, v in tensors.items()}
+    save_safetensors(tmp_path / "model.safetensors", tensors)
+
+    params = load_params(cfg, tmp_path)
+    assert params["layers"]["w_gate"].shape == (2, 4, h, m)
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["w_up"][1, 3]),
+        tensors["model.layers.1.mlp.experts.3.up_proj.weight"].T)
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["router"][0]),
+        tensors["model.layers.0.mlp.gate.weight"].T)
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["shared_down"][1]),
+        tensors["model.layers.1.mlp.shared_experts.down_proj.weight"].T)
+    assert "lm_head" not in params  # tied embeddings
+
+
+def test_from_hf_config_moe_keys(tmp_path):
+    """config.json MoE keys (num_local_experts / n_routed_experts) resolve
+    to an MoE ModelConfig instead of silently going dense."""
+    (tmp_path / "config.json").write_text(json.dumps({
+        "vocab_size": 64, "hidden_size": 16, "intermediate_size": 32,
+        "num_hidden_layers": 2, "num_attention_heads": 2,
+        "num_key_value_heads": 2, "num_local_experts": 8,
+        "num_experts_per_tok": 2,
+    }))
+    cfg = resolve_model_config(str(tmp_path))
+    assert cfg.is_moe and cfg.num_experts == 8
+    assert cfg.num_experts_per_tok == 2
+    assert cfg.moe_intermediate_size == 32
+
+
+def test_engine_serves_checkpoint_deterministically(tmp_path):
+    """EngineCore picks up weights from a model path; two engines built from
+    the same checkpoint generate identical greedy tokens, and differ from
+    random init (i.e. the weights really loaded)."""
+    from dynamo_tpu.engine.engine import EngineCore
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.utils.config import EngineConfig
+
+    cfg = _tiny_cfg()
+    rng = np.random.default_rng(4)
+    _write_checkpoint(tmp_path, cfg, rng)
+    resolved = resolve_model_config(str(tmp_path))
+    assert resolved.hidden_size == cfg.hidden_size
+
+    def run(model):
+        core = EngineCore(EngineConfig(
+            model=model, max_batch_size=2, max_model_len=128, num_blocks=32,
+            dtype="float32",
+        ))
+        core.add_request(PreprocessedRequest(
+            request_id="r", token_ids=list(range(1, 17)),
+            sampling_options=SamplingOptions(temperature=0.0),
+            stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+        ))
+        toks = []
+        while core.has_work():
+            for out in core.step().values():
+                toks.extend(out.token_ids)
+        return toks
+
+    a = run(str(tmp_path))
+    b = run(str(tmp_path))
+    assert a == b and len(a) == 8
+    assert a != run("tiny-llama")  # random-init engine differs
