@@ -381,6 +381,14 @@ class EngineCore:
                 self.runner.reset_slot(seq.slot, seq.req.sampling_options.seed)
                 seq.slot_initialized = True
 
+        # Decode and prefill run as two bucketed programs in the same step
+        # (decode first — see scheduler module docstring for why they are
+        # not one padded batch).
+        batches: list[tuple[list, list[bool]]] = []
+        if plan.decode:
+            rows = [(s, s.num_computed, 1) for s in plan.decode]
+            batches.append((rows, [True] * len(rows)))
+            self.metrics.num_decode_tokens += len(rows)
         if plan.prefill:
             rows = [(w.seq, w.start, w.length) for w in plan.prefill]
             # Sample only on the chunk completing a *fresh* prompt; a
@@ -391,33 +399,30 @@ class EngineCore:
                 and len(w.seq.tokens) == w.seq.prompt_len
                 for w in plan.prefill
             ]
+            batches.append((rows, sample_rows))
             self.metrics.num_prefill_tokens += sum(w.length for w in plan.prefill)
-        else:
-            rows = [(s, s.num_computed, 1) for s in plan.decode]
-            sample_rows = [True] * len(rows)
-            self.metrics.num_decode_tokens += len(rows)
 
-        toks, lps = self.runner.run(rows, sample_rows)
-
-        for i, (seq, start, length) in enumerate(rows):
-            seq.num_computed = start + length
-            self.sched.commit_computed_blocks(seq)
-            if not sample_rows[i]:
-                continue  # intermediate prefill chunk: no token emitted
-            token = int(toks[i])
-            seq.tokens.append(token)
-            seq.block_seq.append(token)
-            if seq.prefix_hit_blocks:
-                self.metrics.prefix_hit_blocks += seq.prefix_hit_blocks
-                seq.prefix_hit_blocks = 0
-            reason = self._check_stop(seq, token)
-            out = LLMEngineOutput(token_ids=[token], cum_log_probs=float(lps[i]))
-            if reason is not None:
-                out.finish_reason = reason
-                self.sched.finish(seq, reason)
-                self.metrics.num_requests_finished += 1
-                del self._seqs[seq.request_id]
-            outputs[seq.request_id] = out
+        for rows, sample_rows in batches:
+            toks, lps = self.runner.run(rows, sample_rows)
+            for i, (seq, start, length) in enumerate(rows):
+                seq.num_computed = start + length
+                self.sched.commit_computed_blocks(seq)
+                if not sample_rows[i]:
+                    continue  # intermediate prefill chunk: no token emitted
+                token = int(toks[i])
+                seq.tokens.append(token)
+                seq.block_seq.append(token)
+                if seq.prefix_hit_blocks:
+                    self.metrics.prefix_hit_blocks += seq.prefix_hit_blocks
+                    seq.prefix_hit_blocks = 0
+                reason = self._check_stop(seq, token)
+                out = LLMEngineOutput(token_ids=[token], cum_log_probs=float(lps[i]))
+                if reason is not None:
+                    out.finish_reason = reason
+                    self.sched.finish(seq, reason)
+                    self.metrics.num_requests_finished += 1
+                    del self._seqs[seq.request_id]
+                outputs[seq.request_id] = out
         return outputs
 
     # -- disagg / KV-transfer primitives (engine-core thread only) ---------

@@ -7,8 +7,14 @@ prefill) → RUNNING (decode) → FINISHED, with block allocation against the
 PrefixPool, recompute-style preemption under block pressure, and prefix-cache
 reuse feeding back into TTFT.
 
-One step = either one prefill chunk batch or one decode batch (prefill
-prioritized). Static-shape buckets keep XLA compile counts bounded.
+One step = one decode batch AND at most one prefill-chunk batch (decode
+first): decode streams advance every step, so a long prompt's prefill can
+stall ITL by at most one chunk's compute, not the whole prompt (the
+reference's engines mix within token-budgeted steps the same way,
+lib/llm/src/mocker/scheduler.rs:117-178). The two batches stay separate
+XLA programs because their shapes differ radically — padding decode rows
+to the prefill chunk T would multiply their FLOPs by T. Static-shape
+buckets keep XLA compile counts bounded.
 """
 
 from __future__ import annotations
@@ -149,6 +155,15 @@ class Scheduler:
         matchable = (seq.prefill_target() - 1) // seq.block_size
         matched = self.pool.match_prefix(seq.block_seq.sequence_hashes()[:matchable])
         need = seq.blocks_needed(len(seq.tokens)) - len(matched)
+        # Watermark: keep one free/evictable block per running seq so the
+        # decode-growth loop doesn't immediately hit pressure and preempt the
+        # seq we just admitted (admit→evict→re-admit thrash under mixed
+        # prefill+decode stepping). The preempted-resume path (front of the
+        # waiting deque with committed prefix) still re-admits once decoders
+        # drain.
+        if need + len(self.running) > self.pool.num_free:
+            self.pool.release(matched)
+            return False
         try:
             fresh = self.pool.allocate(need)
         except NoFreeBlocks:
@@ -211,24 +226,14 @@ class Scheduler:
                 break
             self.waiting.popleft()
 
-        # Prefill-priority: any running seq short of its prefill target gets chunks.
-        budget = self.max_tokens_per_step
-        for seq in self.running:
-            target = seq.prefill_target()
-            if seq.num_computed < target and budget > 0:
-                chunk = min(target - seq.num_computed, self.prefill_chunk, budget)
-                plan.prefill.append(PrefillWork(seq=seq, start=seq.num_computed, length=chunk))
-                budget -= chunk
-        if plan.prefill:
-            return plan
-
-        # Decode batch; grow blocks, preempting from the back on pressure.
+        # Decode batch first (every decodable stream advances every step);
+        # grow blocks, preempting from the back on pressure.
         decodable: list[Seq] = []
         for seq in list(self.running):
             if not seq.in_decode:
                 continue
             while not self._grow_for_decode(seq):
-                # preempt the most recently admitted other decodable seq
+                # preempt the most recently admitted other seq
                 victims = [s for s in reversed(self.running) if s is not seq]
                 if not victims:
                     break
@@ -242,6 +247,16 @@ class Scheduler:
             # could not grow even after preemption: preempt seq itself
             self.preempt(seq)
         plan.decode = decodable[: self.max_batch_size]
+
+        # Prefill chunks for seqs short of their target, within what's left
+        # of the step token budget after the decode rows.
+        budget = self.max_tokens_per_step - len(plan.decode)
+        for seq in self.running:
+            target = seq.prefill_target()
+            if seq.num_computed < target and budget > 0:
+                chunk = min(target - seq.num_computed, self.prefill_chunk, budget)
+                plan.prefill.append(PrefillWork(seq=seq, start=seq.num_computed, length=chunk))
+                budget -= chunk
         return plan
 
     # ------------------------------------------------------------------

@@ -169,3 +169,64 @@ def test_seeded_sampling_reproducible():
     out2, _ = run_to_completion(core, [b])
     # NOTE: seeds are applied per-slot at admission; same slot+seed → same stream
     assert len(out["sa"]) == len(out2["sb"]) == 8
+
+
+def test_decode_not_stalled_by_prefill():
+    """Mixed steps: while a long prompt prefills over several chunks, an
+    already-decoding stream emits a token every step (VERDICT weak #5)."""
+    core = EngineCore(tiny_config(prefill_chunk=16, num_blocks=128))
+    core.add_request(make_req(rid="short", max_tokens=64))
+    # Let the short request finish prefill and emit a couple of tokens.
+    for _ in range(3):
+        core.step()
+    # A long prompt that needs 4 chunks of prefill.
+    core.add_request(make_req(prompt=list(range(1, 65)), rid="long", max_tokens=4))
+    stalls = 0
+    prefill_steps = 0
+    while core._seqs.get("long") is not None and core._seqs["long"].num_computed < 64:
+        outs = core.step()
+        prefill_steps += 1
+        if "short" not in outs or not outs["short"].token_ids:
+            stalls += 1
+        if prefill_steps > 50:
+            break
+    assert prefill_steps >= 3, "expected multi-chunk prefill"
+    assert stalls == 0, f"decode stalled {stalls}/{prefill_steps} steps during prefill"
+
+
+def test_mixed_step_outputs_match_sequential():
+    """Greedy outputs are identical whether requests arrive together or the
+    second arrives mid-decode of the first (mixed prefill+decode steps must
+    not change numerics)."""
+    together, _ = run_to_completion(
+        EngineCore(tiny_config()),
+        [make_req(rid="a", max_tokens=12), make_req(prompt=[3, 4, 5, 6], rid="b", max_tokens=12)],
+    )
+    core = EngineCore(tiny_config())
+    core.add_request(make_req(rid="a", max_tokens=12))
+    collected = {"a": [], "b": []}
+    for _ in range(4):
+        for rid, out in core.step().items():
+            collected[rid].extend(out.token_ids)
+    core.add_request(make_req(prompt=[3, 4, 5, 6], rid="b", max_tokens=12))
+    for _ in range(200):
+        if not core.has_work():
+            break
+        for rid, out in core.step().items():
+            collected[rid].extend(out.token_ids)
+    assert collected["a"] == together["a"]
+    assert collected["b"] == together["b"]
+
+
+def test_no_admit_evict_thrash_under_pressure():
+    """Tight pool + active decoders + a long prompt: the admission watermark
+    keeps the long prompt queued (not admit→evict→re-admit thrashing), and
+    everything still completes."""
+    core = EngineCore(tiny_config(num_blocks=24, prefill_chunk=16, max_batch_size=4))
+    reqs = [make_req(rid=f"d{i}", max_tokens=24) for i in range(2)]
+    reqs.append(make_req(prompt=list(range(1, 33)), rid="long", max_tokens=8))
+    collected, finished = run_to_completion(core, reqs, max_steps=400)
+    assert finished == {"d0", "d1", "long"}
+    assert len(collected["long"]) == 8
+    assert core.sched.preemption_count <= 4, (
+        f"excessive preemption churn: {core.sched.preemption_count}")
