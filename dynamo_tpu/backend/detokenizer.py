@@ -17,17 +17,7 @@ from dynamo_tpu.protocols.common import BackendOutput, FinishReason, LLMEngineOu
 from dynamo_tpu.tokenizer import BaseTokenizer, DecodeStream
 
 
-def _longest_partial_suffix(text: str, stops: list[str]) -> int:
-    """Length of the longest suffix of ``text`` that is a proper prefix of
-    any stop string (the amount of text to jail)."""
-    best = 0
-    for stop in stops:
-        upper = min(len(stop) - 1, len(text))
-        for k in range(upper, 0, -1):
-            if stop.startswith(text[-k:]):
-                best = max(best, k)
-                break
-    return best
+from dynamo_tpu.utils.text import longest_partial_suffix as _longest_partial_suffix
 
 
 @dataclass

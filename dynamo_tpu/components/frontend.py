@@ -126,6 +126,8 @@ class ModelWatcher:
             name, tokenizer, generate,
             defaults=ModelDefaults(max_model_len=card.get("max_model_len", 8192)),
             stats=stats_fn,
+            tool_parser=card.get("tool_call_parser"),
+            reasoning_parser=card.get("reasoning_parser"),
         )
         self._pipelines[name] = (client, router)
         log.info("model added: %s via %s (router=%s)", name, endpoint, mode)

@@ -33,6 +33,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--endpoint", default="generate")
     p.add_argument("--coordinator", default=None)
     p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--tool-call-parser", default=None)
+    p.add_argument("--reasoning-parser", default=None)
     p.add_argument("--num-blocks", type=int, default=0)
     p.add_argument("--max-batch-size", type=int, default=32)
     p.add_argument("--max-model-len", type=int, default=8192)
@@ -60,6 +62,8 @@ def model_card(ns: argparse.Namespace, name: str) -> dict:
         "block_size": ns.block_size,
         "max_model_len": ns.max_model_len,
         "kv_events": not ns.no_kv_events,
+        "tool_call_parser": ns.tool_call_parser,
+        "reasoning_parser": ns.reasoning_parser,
     }
 
 

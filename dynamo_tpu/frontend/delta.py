@@ -51,6 +51,24 @@ class ChatDeltaGenerator:
             )],
         )
 
+    def reasoning_chunk(self, reasoning: str) -> ChatCompletionChunk:
+        return ChatCompletionChunk(
+            id=self.id, model=self.model,
+            choices=[ChatChunkChoice(delta=ChatChoiceDelta(reasoning_content=reasoning))],
+        )
+
+    def tool_calls_chunk(self, calls: list) -> ChatCompletionChunk:
+        """Terminal chunk carrying the parsed calls (the jail withheld their
+        text) with finish_reason=tool_calls."""
+        return ChatCompletionChunk(
+            id=self.id, model=self.model,
+            choices=[ChatChunkChoice(
+                delta=ChatChoiceDelta(
+                    tool_calls=[c.to_openai(index=i) for i, c in enumerate(calls)]),
+                finish_reason="tool_calls",
+            )],
+        )
+
     def usage(self) -> Usage:
         return Usage(
             prompt_tokens=self.prompt_tokens,
@@ -59,13 +77,31 @@ class ChatDeltaGenerator:
         )
 
 
-def aggregate_chat(model: str, outs: list[BackendOutput], prompt_tokens: int) -> ChatCompletionResponse:
+def aggregate_chat(model: str, outs: list[BackendOutput], prompt_tokens: int,
+                   jail=None) -> ChatCompletionResponse:
+    """Aggregate deltas into one chat response; with a ``jail``
+    (parsers.StreamJail), tool calls and reasoning are parsed out of the
+    text and finish_reason becomes tool_calls when calls were made."""
     text = "".join(o.text for o in outs)
     finish = next((str(o.finish_reason) for o in outs if o.finish_reason), None)
     completion_tokens = sum(len(o.token_ids) for o in outs)
+    message = ChatMessage(role="assistant", content=text)
+    if jail is not None:
+        fed = jail.feed(text)
+        fin = jail.finish()
+        content = fed.content + fin.content
+        reasoning = fed.reasoning + fin.reasoning
+        message = ChatMessage(
+            role="assistant",
+            content=content or None,
+            reasoning_content=reasoning or None,
+            tool_calls=[c.to_openai() for c in fin.tool_calls] or None,
+        )
+        if fin.tool_calls:
+            finish = "tool_calls"
     return ChatCompletionResponse(
         model=model,
-        choices=[ChatChoice(message=ChatMessage(role="assistant", content=text), finish_reason=finish)],
+        choices=[ChatChoice(message=message, finish_reason=finish)],
         usage=Usage(
             prompt_tokens=prompt_tokens,
             completion_tokens=completion_tokens,

@@ -32,6 +32,9 @@ class ModelEntry:
     preprocessor: OpenAIPreprocessor
     stats: Callable[[], dict] | None = None
     clear_kv: Callable[[], Awaitable[None]] | None = None
+    # Parser names (dynamo_tpu.parsers registries); None = feature off.
+    tool_parser: str | None = None
+    reasoning_parser: str | None = None
 
 
 class ModelManager:
@@ -46,7 +49,19 @@ class ModelManager:
         defaults: ModelDefaults | None = None,
         stats: Callable[[], dict] | None = None,
         clear_kv: Callable[[], Awaitable[None]] | None = None,
+        tool_parser: str | None = None,
+        reasoning_parser: str | None = None,
     ) -> ModelEntry:
+        # Fail fast on bad parser names — a typo'd --tool-call-parser must
+        # surface at registration, not mid-SSE-stream on the first request.
+        if tool_parser:
+            from dynamo_tpu.parsers import get_tool_parser
+
+            get_tool_parser(tool_parser)
+        if reasoning_parser:
+            from dynamo_tpu.parsers import get_reasoning_parser
+
+            get_reasoning_parser(reasoning_parser)
         defaults = defaults or ModelDefaults()
         entry = ModelEntry(
             name=name,
@@ -56,6 +71,8 @@ class ModelManager:
             preprocessor=OpenAIPreprocessor(name, tokenizer, defaults),
             stats=stats,
             clear_kv=clear_kv,
+            tool_parser=tool_parser,
+            reasoning_parser=reasoning_parser,
         )
         self._models[name] = entry
         return entry

@@ -175,6 +175,26 @@ class HttpService:
             self._req_dur.observe(time.monotonic() - t_start, model=req.model)
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _make_jail(entry: ModelEntry, req):
+        """Per-request StreamJail when the model has parsers configured
+        (tool jail only engages when the request actually sent tools)."""
+        tool_cfg = None
+        reasoning = None
+        if entry.tool_parser and getattr(req, "tools", None):
+            from dynamo_tpu.parsers import get_tool_parser
+
+            tool_cfg = get_tool_parser(entry.tool_parser)
+        if entry.reasoning_parser:
+            from dynamo_tpu.parsers import get_reasoning_parser
+
+            reasoning = get_reasoning_parser(entry.reasoning_parser)
+        if tool_cfg is None and reasoning is None:
+            return None
+        from dynamo_tpu.parsers import StreamJail
+
+        return StreamJail(tool_cfg=tool_cfg, reasoning=reasoning)
+
     async def _aggregate_response(self, req, entry: ModelEntry, pre, chat: bool,
                                   t_start: float, route: str) -> web.Response:
         backend = DetokenizerBackend(entry.tokenizer, stops=pre.stop_conditions.stop)
@@ -197,7 +217,11 @@ class HttpService:
             if backend.hit_stop:
                 break
         self._output_tokens.inc(sum(len(o.token_ids) for o in outs), model=req.model)
-        resp = (aggregate_chat if chat else aggregate_completion)(req.model, outs, len(pre.token_ids))
+        if chat:
+            resp = aggregate_chat(req.model, outs, len(pre.token_ids),
+                                  jail=self._make_jail(entry, req))
+        else:
+            resp = aggregate_completion(req.model, outs, len(pre.token_ids))
         self._requests.inc(route=route, status="200")
         return web.Response(text=resp.model_dump_json(exclude_none=True), content_type="application/json")
 
@@ -212,6 +236,7 @@ class HttpService:
         backend = DetokenizerBackend(entry.tokenizer, stops=pre.stop_conditions.stop)
         gen = ChatDeltaGenerator(req.model, pre.request_id)
         gen.prompt_tokens = len(pre.token_ids)
+        jail = self._make_jail(entry, req) if chat else None
         first = True
         prev = t_start
         ntokens = 0
@@ -233,6 +258,31 @@ class HttpService:
                     break
                 out = backend.step(eo)
                 if chat:
+                    if jail is not None:
+                        jd = jail.feed(out.text)
+                        if jd.reasoning:
+                            await resp.write(encode_sse_json(gen.reasoning_chunk(jd.reasoning)))
+                        if out.finish_reason is not None:
+                            fin = jail.finish()
+                            tail = jd.content + fin.content
+                            if fin.reasoning:
+                                await resp.write(encode_sse_json(gen.reasoning_chunk(fin.reasoning)))
+                            if fin.tool_calls:
+                                if tail:
+                                    await resp.write(encode_sse_json(gen.chunk(
+                                        BackendOutput(text=tail, token_ids=out.token_ids))))
+                                else:
+                                    gen.completion_tokens += len(out.token_ids)
+                                await resp.write(encode_sse_json(gen.tool_calls_chunk(fin.tool_calls)))
+                                if backend.hit_stop:
+                                    break
+                                continue
+                            out = BackendOutput(text=tail, token_ids=out.token_ids,
+                                                finish_reason=out.finish_reason,
+                                                cum_log_probs=out.cum_log_probs)
+                        else:
+                            out = BackendOutput(text=jd.content, token_ids=out.token_ids,
+                                                cum_log_probs=out.cum_log_probs)
                     chunk = gen.chunk(out)
                     if chunk is not None:
                         await resp.write(encode_sse_json(chunk))

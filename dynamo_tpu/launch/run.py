@@ -56,6 +56,10 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p.add_argument("--input-jsonl", default=None)
     p.add_argument("--host-kv-blocks", type=int, default=0, help="G2 host KV tier capacity")
     p.add_argument("--disk-kv-path", default=None, help="G3 disk KV tier directory")
+    p.add_argument("--tool-call-parser", default=None,
+                   help="tool-call parser name (hermes, mistral, llama3_json, ...)")
+    p.add_argument("--reasoning-parser", default=None,
+                   help="reasoning parser name (basic, deepseek_r1, ...)")
     ns = p.parse_args(rest)
     ns.in_mode, ns.out_mode = in_mode, out_mode
     return ns
@@ -85,6 +89,8 @@ async def run_http(ns: argparse.Namespace) -> None:
         ns.model, tok, engine.generate,
         defaults=ModelDefaults(max_model_len=cfg.max_model_len, default_max_tokens=ns.max_tokens),
         stats=engine.stats,
+        tool_parser=ns.tool_call_parser,
+        reasoning_parser=ns.reasoning_parser,
     )
     svc = HttpService(models)
     await svc.start(ns.host, ns.port)

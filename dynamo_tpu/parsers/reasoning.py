@@ -1,0 +1,120 @@
+"""Reasoning (think-block) parsing, complete and streaming-incremental.
+
+Fills the reference's reasoning parser registry (reference:
+lib/parsers/src/reasoning/{mod,base_parser}.rs) — same parser names, one
+data-driven implementation: a config names the open/close markers and
+whether the model starts *inside* reasoning (deepseek-r1 emits no opening
+tag after its chat template).
+
+Streaming rules (mirroring BasicReasoningParser's semantics):
+- text inside open..close accumulates as ``reasoning_text``;
+- a partial marker at the end of the buffer is withheld until it either
+  completes or diverges;
+- a missing close tag means everything from open to stream end is
+  reasoning.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ParserResult:
+    normal_text: str = ""
+    reasoning_text: str = ""
+
+
+@dataclass(frozen=True)
+class ReasoningConfig:
+    open_token: str = "<think>"
+    close_token: str = "</think>"
+    # Model is already "thinking" at generation start (no open marker emitted).
+    force_reasoning: bool = False
+
+
+# Same registry names as the reference (reasoning/mod.rs:18-31).
+REASONING_PARSERS: dict[str, ReasoningConfig] = {
+    "basic": ReasoningConfig(),
+    "deepseek_r1": ReasoningConfig(force_reasoning=True),
+    "qwen3": ReasoningConfig(),
+    "nemotron_deci": ReasoningConfig(force_reasoning=False),
+    "kimi": ReasoningConfig(open_token="◁think▷", close_token="◁/think▷"),
+    "step3": ReasoningConfig(force_reasoning=True),
+    "mistral": ReasoningConfig(open_token="[THINK]", close_token="[/THINK]"),
+    "granite": ReasoningConfig(
+        open_token="Here is my thought process:",
+        close_token="Here is my response:"),
+}
+
+
+def get_reasoning_parser(name: str) -> "ReasoningParser":
+    try:
+        return ReasoningParser(REASONING_PARSERS[name])
+    except KeyError:
+        raise ValueError(
+            f"unknown reasoning parser {name!r} (have: {sorted(REASONING_PARSERS)})"
+        ) from None
+
+
+def _partial_suffix(text: str, token: str) -> int:
+    """Length of the longest proper prefix of ``token`` that ends ``text``."""
+    for k in range(min(len(token) - 1, len(text)), 0, -1):
+        if text.endswith(token[:k]):
+            return k
+    return 0
+
+
+class ReasoningParser:
+    """Stateful streaming parser; ``parse`` is the one-shot form."""
+
+    def __init__(self, cfg: ReasoningConfig):
+        self.cfg = cfg
+        self.in_reasoning = cfg.force_reasoning
+        self._buf = ""  # withheld partial-marker fragment
+
+    # -- one-shot ----------------------------------------------------------
+    @classmethod
+    def parse_complete(cls, text: str, cfg: ReasoningConfig) -> ParserResult:
+        p = cls(cfg)
+        res = p.step(text)
+        tail = p.finish()
+        return ParserResult(
+            normal_text=(res.normal_text + tail.normal_text),
+            reasoning_text=(res.reasoning_text + tail.reasoning_text),
+        )
+
+    # -- streaming ---------------------------------------------------------
+    def step(self, delta: str) -> ParserResult:
+        """Consume a delta; returns the text that can be released now."""
+        text = self._buf + delta
+        self._buf = ""
+        normal: list[str] = []
+        reasoning: list[str] = []
+        while text:
+            marker = self.cfg.close_token if self.in_reasoning else self.cfg.open_token
+            sink = reasoning if self.in_reasoning else normal
+            i = text.find(marker)
+            if i >= 0:
+                sink.append(text[:i])
+                text = text[i + len(marker):]
+                self.in_reasoning = not self.in_reasoning
+                continue
+            k = _partial_suffix(text, marker)
+            if k:
+                sink.append(text[:-k])
+                self._buf = text[-k:]
+            else:
+                sink.append(text)
+            break
+        return ParserResult("".join(normal), "".join(reasoning))
+
+    def finish(self) -> ParserResult:
+        """Flush the withheld fragment at stream end (an unfinished marker
+        is literal text of whichever side we are on)."""
+        buf, self._buf = self._buf, ""
+        if not buf:
+            return ParserResult()
+        if self.in_reasoning:
+            return ParserResult(reasoning_text=buf)
+        return ParserResult(normal_text=buf)

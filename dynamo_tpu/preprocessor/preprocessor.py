@@ -70,7 +70,8 @@ class OpenAIPreprocessor:
         if use_raw and messages and isinstance(messages[-1].get("content"), str):
             prompt = messages[-1]["content"]
         else:
-            prompt = self.tokenizer.apply_chat_template(messages, add_generation_prompt=True)
+            prompt = self.tokenizer.apply_chat_template(
+                messages, add_generation_prompt=True, tools=req.tools)
         token_ids = self.tokenizer.encode(prompt, add_bos=True)
         out = PreprocessedRequest(
             token_ids=token_ids,

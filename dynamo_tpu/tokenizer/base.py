@@ -26,7 +26,8 @@ class BaseTokenizer(Protocol):
 
     def encode(self, text: str, add_bos: bool = False) -> list[int]: ...
     def decode(self, ids: Sequence[int], skip_special: bool = True) -> str: ...
-    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str: ...
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True,
+                            tools: list[dict] | None = None) -> str: ...
 
 
 class ByteTokenizer:
@@ -53,10 +54,15 @@ class ByteTokenizer:
         # vocab up for sharding) — they decode to nothing.
         return bytes(i - self.OFFSET for i in ids if self.OFFSET <= i < self.OFFSET + 256)
 
-    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True,
+                            tools: list[dict] | None = None) -> str:
         # Minimal ChatML-style template (reference: minijinja templating in
         # lib/llm/src/preprocessor/prompt/; real models use their HF template).
         parts = []
+        if tools:
+            import json as _json
+
+            parts.append(f"<|system|>\nAvailable tools: {_json.dumps(tools)}\n")
         for m in messages:
             content = m.get("content") or ""
             if isinstance(content, list):
@@ -87,13 +93,15 @@ class HFTokenizer:
     def decode(self, ids: Sequence[int], skip_special: bool = True) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=skip_special)
 
-    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True,
+                            tools: list[dict] | None = None) -> str:
         try:
             return self._tok.apply_chat_template(
-                messages, tokenize=False, add_generation_prompt=add_generation_prompt
+                messages, tokenize=False, add_generation_prompt=add_generation_prompt,
+                tools=tools,
             )
         except Exception:
-            return ByteTokenizer.apply_chat_template(self, messages, add_generation_prompt)  # type: ignore[arg-type]
+            return ByteTokenizer.apply_chat_template(self, messages, add_generation_prompt, tools)  # type: ignore[arg-type]
 
 
 def load_tokenizer(name_or_path: str | None) -> BaseTokenizer:

@@ -1,0 +1,149 @@
+"""Frontend tool-call + reasoning integration: HTTP service with a canned
+engine, both aggregate and streaming chat completions.
+
+Mirrors the reference's jail-in-service behavior
+(lib/llm/src/protocols/openai/chat_completions/jail.rs + aggregator tests):
+tool-call text never reaches content, finish_reason becomes tool_calls,
+reasoning streams as reasoning_content.
+"""
+
+import json
+
+import aiohttp
+
+from dynamo_tpu.frontend.model_manager import ModelManager
+from dynamo_tpu.frontend.service import HttpService
+from dynamo_tpu.preprocessor.preprocessor import ModelDefaults
+from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput
+from dynamo_tpu.tokenizer import ByteTokenizer
+
+TOOL_TEXT = ('I will look that up. <tool_call>{"name": "get_weather", '
+             '"arguments": {"city": "Paris"}}</tool_call>')
+THINK_TEXT = "<think>check the map first</think>The capital is Paris."
+
+
+def canned_generate(text: str, chunk: int = 7):
+    """Engine stub: emits ``text`` as ByteTokenizer ids in small deltas."""
+    tok = ByteTokenizer()
+    ids = tok.encode(text)
+
+    async def generate(pre):
+        for i in range(0, len(ids), chunk):
+            part = ids[i : i + chunk]
+            last = i + chunk >= len(ids)
+            yield LLMEngineOutput(
+                token_ids=part,
+                finish_reason=FinishReason.STOP if last else None,
+            )
+
+    return generate
+
+
+async def _serve(text: str, **register_kw):
+    models = ModelManager()
+    models.register("m", ByteTokenizer(), canned_generate(text),
+                    defaults=ModelDefaults(), **register_kw)
+    svc = HttpService(models)
+    port = await svc.start(port=0)
+    return svc, f"http://127.0.0.1:{port}"
+
+
+BODY = {"model": "m", "messages": [{"role": "user", "content": "hi"}],
+        "tools": [{"type": "function",
+                   "function": {"name": "get_weather", "parameters": {}}}]}
+
+
+async def test_aggregate_tool_calls():
+    svc, base = await _serve(TOOL_TEXT, tool_parser="hermes")
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json=BODY) as r:
+                assert r.status == 200
+                data = await r.json()
+        choice = data["choices"][0]
+        assert choice["finish_reason"] == "tool_calls"
+        calls = choice["message"]["tool_calls"]
+        assert calls[0]["function"]["name"] == "get_weather"
+        assert json.loads(calls[0]["function"]["arguments"]) == {"city": "Paris"}
+        assert "tool_call" not in (choice["message"].get("content") or "")
+    finally:
+        await svc.stop()
+
+
+async def test_aggregate_no_tools_passthrough():
+    """Without tools in the request, the jail stays off even if the model
+    has a parser configured — text passes through verbatim."""
+    svc, base = await _serve(TOOL_TEXT, tool_parser="hermes")
+    try:
+        body = {k: v for k, v in BODY.items() if k != "tools"}
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                data = await r.json()
+        assert data["choices"][0]["message"]["content"] == TOOL_TEXT
+        assert data["choices"][0]["finish_reason"] == "stop"
+    finally:
+        await svc.stop()
+
+
+async def test_stream_tool_calls_jailed():
+    svc, base = await _serve(TOOL_TEXT, tool_parser="hermes")
+    try:
+        body = dict(BODY, stream=True)
+        content, tool_calls, finishes = "", [], []
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if not line.startswith("data: ") or line == "data: [DONE]":
+                        continue
+                    ev = json.loads(line[6:])
+                    if "error" in ev:
+                        raise AssertionError(ev)
+                    d = ev["choices"][0]["delta"]
+                    content += d.get("content") or ""
+                    tool_calls.extend(d.get("tool_calls") or [])
+                    if ev["choices"][0].get("finish_reason"):
+                        finishes.append(ev["choices"][0]["finish_reason"])
+        assert "<tool_call>" not in content, "jail leaked call text"
+        assert content.startswith("I will look that up.")
+        assert tool_calls and tool_calls[0]["function"]["name"] == "get_weather"
+        assert finishes == ["tool_calls"]
+    finally:
+        await svc.stop()
+
+
+async def test_stream_reasoning_content():
+    svc, base = await _serve(THINK_TEXT, reasoning_parser="basic")
+    try:
+        body = {"model": "m", "messages": [{"role": "user", "content": "q"}],
+                "stream": True}
+        content, reasoning = "", ""
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if not line.startswith("data: ") or line == "data: [DONE]":
+                        continue
+                    ev = json.loads(line[6:])
+                    d = ev["choices"][0]["delta"]
+                    content += d.get("content") or ""
+                    reasoning += d.get("reasoning_content") or ""
+        assert reasoning == "check the map first"
+        assert content == "The capital is Paris."
+    finally:
+        await svc.stop()
+
+
+async def test_aggregate_reasoning_content():
+    svc, base = await _serve(THINK_TEXT, reasoning_parser="basic")
+    try:
+        body = {"model": "m", "messages": [{"role": "user", "content": "q"}]}
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                data = await r.json()
+        msg = data["choices"][0]["message"]
+        assert msg["reasoning_content"] == "check the map first"
+        assert msg["content"] == "The capital is Paris."
+    finally:
+        await svc.stop()

@@ -1,0 +1,286 @@
+"""Tool-call + reasoning parser tests (complete + streaming + jail).
+
+Mirrors the reference's parser test matrix
+(lib/parsers/src/tool_calling/tests.rs, reasoning/base_parser.rs tests):
+per-family formats, multi-call messages, partial-marker streaming, and
+jail withholding semantics.
+"""
+
+import json
+
+import pytest
+
+from dynamo_tpu.parsers import (
+    ReasoningParser,
+    StreamJail,
+    get_reasoning_parser,
+    get_tool_parser,
+    parse_tool_calls,
+)
+from dynamo_tpu.parsers.reasoning import REASONING_PARSERS, ReasoningConfig
+
+
+# -- tool calls: complete parsing ------------------------------------------
+
+def test_hermes_single_call():
+    cfg = get_tool_parser("hermes")
+    text = ('I will check.\n<tool_call>\n{"name": "get_weather", '
+            '"arguments": {"city": "Paris"}}\n</tool_call>')
+    calls, normal = parse_tool_calls(text, cfg)
+    assert len(calls) == 1
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "Paris"}
+    assert normal == "I will check."
+
+
+def test_hermes_multiple_calls():
+    cfg = get_tool_parser("hermes")
+    text = ('<tool_call>{"name": "a", "arguments": {}}</tool_call>'
+            '<tool_call>{"name": "b", "arguments": {"x": 1}}</tool_call>')
+    calls, normal = parse_tool_calls(text, cfg)
+    assert [c.name for c in calls] == ["a", "b"]
+    assert normal is None
+
+
+def test_nemotron_list_payload():
+    cfg = get_tool_parser("nemotron_deci")
+    text = ('<TOOLCALL>[{"name": "f", "arguments": {"k": "v"}},'
+            ' {"name": "g", "parameters": {"n": 2}}]</TOOLCALL>')
+    calls, _ = parse_tool_calls(text, cfg)
+    assert [c.name for c in calls] == ["f", "g"]
+    assert json.loads(calls[1].arguments) == {"n": 2}
+
+
+def test_mistral_marker_and_bare_json():
+    cfg = get_tool_parser("mistral")
+    text = '[TOOL_CALLS] [{"name": "search", "arguments": {"q": "tpu"}}]'
+    calls, _ = parse_tool_calls(text, cfg)
+    assert calls[0].name == "search"
+    bare = '{"name": "search", "arguments": {"q": "x"}}'
+    calls, normal = parse_tool_calls(bare, cfg)
+    assert calls[0].name == "search" and normal is None
+
+
+def test_llama3_python_tag():
+    cfg = get_tool_parser("llama3_json")
+    text = '<|python_tag|>{"name": "calc", "parameters": {"expr": "1+1"}}'
+    calls, _ = parse_tool_calls(text, cfg)
+    assert calls[0].name == "calc"
+    assert json.loads(calls[0].arguments) == {"expr": "1+1"}
+
+
+def test_pythonic_calls():
+    cfg = get_tool_parser("pythonic")
+    text = 'Sure: [get_weather(city="SF", days=3), get_time()]'
+    calls, normal = parse_tool_calls(text, cfg)
+    assert [c.name for c in calls] == ["get_weather", "get_time"]
+    assert json.loads(calls[0].arguments) == {"city": "SF", "days": 3}
+    assert normal == "Sure:"
+
+
+def test_plain_text_no_calls():
+    cfg = get_tool_parser("hermes")
+    calls, normal = parse_tool_calls("Just a normal answer.", cfg)
+    assert calls == [] and normal == "Just a normal answer."
+
+
+def test_bare_json_not_a_tool_call_is_normal():
+    cfg = get_tool_parser("default")
+    text = '{"weather": "sunny"}'  # JSON but not name/arguments shape
+    calls, normal = parse_tool_calls(text, cfg)
+    assert calls == []
+    assert normal == text
+
+
+def test_unknown_parser_name():
+    with pytest.raises(ValueError):
+        get_tool_parser("nope")
+
+
+# -- reasoning: complete + streaming ---------------------------------------
+
+def test_reasoning_complete_basic():
+    res = ReasoningParser.parse_complete(
+        "<think>chain of thought</think>The answer is 4.",
+        REASONING_PARSERS["basic"])
+    assert res.reasoning_text == "chain of thought"
+    assert res.normal_text == "The answer is 4."
+
+
+def test_reasoning_deepseek_implicit_open():
+    res = ReasoningParser.parse_complete(
+        "thinking...</think>Answer.", REASONING_PARSERS["deepseek_r1"])
+    assert res.reasoning_text == "thinking..."
+    assert res.normal_text == "Answer."
+
+
+def test_reasoning_unclosed_block_all_reasoning():
+    res = ReasoningParser.parse_complete(
+        "<think>never closes", REASONING_PARSERS["basic"])
+    assert res.reasoning_text == "never closes"
+    assert res.normal_text == ""
+
+
+def test_reasoning_streaming_partial_markers():
+    """Markers split across deltas must not leak fragments."""
+    p = ReasoningParser(REASONING_PARSERS["basic"])
+    normal, reasoning = "", ""
+    for d in ["<th", "ink>ab", "c</th", "ink>d", "ef"]:
+        r = p.step(d)
+        normal += r.normal_text
+        reasoning += r.reasoning_text
+    r = p.finish()
+    normal += r.normal_text
+    reasoning += r.reasoning_text
+    assert reasoning == "abc"
+    assert normal == "def"
+
+
+def test_reasoning_false_partial_marker_released():
+    p = ReasoningParser(ReasoningConfig())
+    out = p.step("a < b")  # "<" then divergence
+    out2 = p.step(" and more")
+    assert out.normal_text + out2.normal_text == "a < b and more"
+
+
+# -- jail ------------------------------------------------------------------
+
+def _drive(jail, deltas):
+    content, reasoning = "", ""
+    for d in deltas:
+        out = jail.feed(d)
+        content += out.content
+        reasoning += out.reasoning
+    fin = jail.finish()
+    content += fin.content
+    reasoning += fin.reasoning
+    return content, reasoning, fin.tool_calls
+
+
+def test_jail_withholds_forming_call():
+    jail = StreamJail(tool_cfg=get_tool_parser("hermes"))
+    out1 = jail.feed("Looking it up <tool")
+    # "<tool" could be a marker prefix: withheld; the rest released
+    assert out1.content == "Looking it up "
+    out2 = jail.feed('_call>{"name": "f", "arguments": {}}')
+    assert out2.content == ""
+    fin = jail.finish()
+    assert [c.name for c in fin.tool_calls] == ["f"]
+
+
+def test_jail_end_marker_releases_midstream():
+    jail = StreamJail(tool_cfg=get_tool_parser("hermes"))
+    content, _, calls = _drive(jail, [
+        'pre ', '<tool_call>{"name": "f", "arguments": {}}</tool_call>', ' post'])
+    assert content == "pre  post"
+    assert [c.name for c in calls] == ["f"]
+
+
+def test_jail_false_alarm_releases_text():
+    jail = StreamJail(tool_cfg=get_tool_parser("hermes"))
+    content, _, calls = _drive(jail, ["a <tool", "box> b"])
+    assert content == "a <toolbox> b"
+    assert calls == []
+
+
+def test_jail_reasoning_and_tools_combined():
+    jail = StreamJail(
+        tool_cfg=get_tool_parser("hermes"),
+        reasoning=get_reasoning_parser("basic"),
+    )
+    content, reasoning, calls = _drive(jail, [
+        "<think>plan: call f</think>",
+        'ok <tool_call>{"name": "f", "arguments": {"x": 1}}</tool_call>',
+    ])
+    assert reasoning == "plan: call f"
+    assert content == "ok "
+    assert [c.name for c in calls] == ["f"]
+
+
+def test_jail_mid_text_brace_not_jailed():
+    """bare_json configs only treat message-start JSON as a call."""
+    jail = StreamJail(tool_cfg=get_tool_parser("default"))
+    content, _, calls = _drive(jail, ['the set {"a": 1} is small'])
+    assert content == 'the set {"a": 1} is small'
+    assert calls == []
+
+
+def test_jail_unterminated_call_parsed_at_finish():
+    jail = StreamJail(tool_cfg=get_tool_parser("llama3_json"))
+    content, _, calls = _drive(
+        jail, ['<|python_tag|>{"name": "f", "parameters": {"a": 2}}'])
+    assert content == ""
+    assert [c.name for c in calls] == ["f"]
+    assert json.loads(calls[0].arguments) == {"a": 2}
+
+
+# -- regressions from review ----------------------------------------------
+
+def test_pythonic_streaming_token_deltas():
+    """Pythonic calls must be jailed and parsed even with token-sized
+    deltas (the viable-prefix matcher, not just whole-buffer regex)."""
+    jail = StreamJail(tool_cfg=get_tool_parser("pythonic"))
+    content, _, calls = _drive(
+        jail, ["[", "get", "_weather", "(city", '="SF"', ")", "]"])
+    assert content == ""
+    assert [c.name for c in calls] == ["get_weather"]
+    assert json.loads(calls[0].arguments) == {"city": "SF"}
+
+
+def test_phi4_nested_array_arguments():
+    """']' inside a JSON argument must not terminate the call."""
+    cfg = get_tool_parser("phi4")
+    text = 'functools[{"name": "f", "arguments": {"x": [1, 2]}}]'
+    calls, normal = parse_tool_calls(text, cfg)
+    assert [c.name for c in calls] == ["f"]
+    assert json.loads(calls[0].arguments) == {"x": [1, 2]}
+    assert normal is None
+
+
+def test_phi4_streaming_nested_array():
+    jail = StreamJail(tool_cfg=get_tool_parser("phi4"))
+    content, _, calls = _drive(
+        jail, ['functools[{"name": "f", "argum', 'ents": {"x": [1, 2]}}] done'])
+    assert content == " done"
+    assert [c.name for c in calls] == ["f"]
+
+
+def test_trailing_text_after_eof_marker_call():
+    """Text the model emits after a marker-to-EOF call reaches the client."""
+    cfg = get_tool_parser("mistral")
+    calls, normal = parse_tool_calls(
+        '[TOOL_CALLS] [{"name": "s", "arguments": {}}] thanks!', cfg)
+    assert [c.name for c in calls] == ["s"]
+    assert normal == "thanks!"
+
+
+def test_mismatched_config_pairs_rejected():
+    from dynamo_tpu.parsers.tool_calls import ToolCallConfig
+
+    with pytest.raises(ValueError):
+        ToolCallConfig(start_tokens=("<a>",), end_tokens=("</a>", ""))
+
+
+def test_register_rejects_bad_parser_names():
+    from dynamo_tpu.frontend.model_manager import ModelManager
+    from dynamo_tpu.preprocessor.preprocessor import ModelDefaults
+    from dynamo_tpu.tokenizer import ByteTokenizer
+
+    async def fake(pre):
+        yield None
+
+    m = ModelManager()
+    with pytest.raises(ValueError):
+        m.register("x", ByteTokenizer(), fake, defaults=ModelDefaults(),
+                   tool_parser="hermse")
+    with pytest.raises(ValueError):
+        m.register("x", ByteTokenizer(), fake, defaults=ModelDefaults(),
+                   reasoning_parser="basicc")
+
+
+def test_pythonic_string_arg_with_bracket():
+    """A ']' inside a string literal must not close the call list."""
+    cfg = get_tool_parser("pythonic")
+    calls, _ = parse_tool_calls('[f(s="a]b")]', cfg)
+    assert [c.name for c in calls] == ["f"]
+    assert json.loads(calls[0].arguments) == {"s": "a]b"}
