@@ -81,7 +81,30 @@ class ModelWatcher:
             except Exception:
                 log.exception("model watch event failed: %s", ev)
 
+    @staticmethod
+    def _validated_parsers(card: dict) -> tuple[str | None, str | None]:
+        """Validate parser names from the card up front (before any client
+        is created — a bad name must not leak an EndpointClient per watch
+        event). Invalid names degrade to no-parser with an error log."""
+        from dynamo_tpu.parsers import get_reasoning_parser, get_tool_parser
+
+        tool, reasoning = card.get("tool_call_parser"), card.get("reasoning_parser")
+        try:
+            if tool:
+                get_tool_parser(tool)
+        except ValueError:
+            log.error("invalid tool_call_parser %r in model card; disabling", tool)
+            tool = None
+        try:
+            if reasoning:
+                get_reasoning_parser(reasoning)
+        except ValueError:
+            log.error("invalid reasoning_parser %r in model card; disabling", reasoning)
+            reasoning = None
+        return tool, reasoning
+
     async def _add_model(self, name: str, card: dict) -> None:
+        tool_parser, reasoning_parser = self._validated_parsers(card)
         endpoint = EndpointId.parse("dyn://" + card["endpoint"])
         log.debug("add_model %s: creating endpoint client", name)
         client = await EndpointClient.create(self.rt, endpoint)
@@ -126,8 +149,8 @@ class ModelWatcher:
             name, tokenizer, generate,
             defaults=ModelDefaults(max_model_len=card.get("max_model_len", 8192)),
             stats=stats_fn,
-            tool_parser=card.get("tool_call_parser"),
-            reasoning_parser=card.get("reasoning_parser"),
+            tool_parser=tool_parser,
+            reasoning_parser=reasoning_parser,
         )
         self._pipelines[name] = (client, router)
         log.info("model added: %s via %s (router=%s)", name, endpoint, mode)

@@ -54,7 +54,9 @@ class StreamJail:
         self._call_buf = ""      # confirmed tool-call text being buffered
         self._in_call = False
         self.tool_calls: list[ToolCall] = []
-        self._chars_seen = 0     # normal-side chars consumed (for bare-JSON rule)
+        # Bare-JSON rule: only counts at message start — i.e. before any
+        # non-whitespace normal text has been released.
+        self._nonws_seen = False
 
     # ------------------------------------------------------------------
     def _feed_normal(self, text: str) -> str:
@@ -85,12 +87,14 @@ class StreamJail:
                 tuple(self.tool_cfg.start_tokens) or ("\0",)
             ):
                 # Bare-JSON start only counts at the very beginning of the
-                # message — mid-text braces are normal content.
-                if self._chars_seen + i > 0 or self._pending[:i].strip():
+                # message (leading whitespace allowed) — mid-text braces
+                # are normal content.
+                if self._nonws_seen or self._pending[:i].strip():
                     i = -1
             if i >= 0:
                 released.append(self._pending[:i])
-                self._chars_seen += i
+                if self._pending[:i].strip():
+                    self._nonws_seen = True
                 self._call_buf = self._pending[i:]
                 self._pending = ""
                 self._in_call = True
@@ -101,7 +105,8 @@ class StreamJail:
             else:
                 release, self._pending = self._pending, ""
             released.append(release)
-            self._chars_seen += len(release)
+            if release.strip():
+                self._nonws_seen = True
             break
         return "".join(released)
 

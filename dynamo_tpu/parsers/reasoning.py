@@ -57,12 +57,12 @@ def get_reasoning_parser(name: str) -> "ReasoningParser":
         ) from None
 
 
+from dynamo_tpu.utils.text import longest_partial_suffix
+
+
 def _partial_suffix(text: str, token: str) -> int:
     """Length of the longest proper prefix of ``token`` that ends ``text``."""
-    for k in range(min(len(token) - 1, len(text)), 0, -1):
-        if text.endswith(token[:k]):
-            return k
-    return 0
+    return longest_partial_suffix(text, (token,))
 
 
 class ReasoningParser:

@@ -284,3 +284,12 @@ def test_pythonic_string_arg_with_bracket():
     calls, _ = parse_tool_calls('[f(s="a]b")]', cfg)
     assert [c.name for c in calls] == ["f"]
     assert json.loads(calls[0].arguments) == {"s": "a]b"}
+
+
+def test_jail_bare_json_with_leading_whitespace():
+    """A leading newline before a bare-JSON call must not defeat detection."""
+    jail = StreamJail(tool_cfg=get_tool_parser("mistral"))
+    content, _, calls = _drive(
+        jail, ['\n{"name": "search", "arguments": {"q": "x"}}'])
+    assert [c.name for c in calls] == ["search"]
+    assert content.strip() == ""

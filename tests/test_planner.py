@@ -199,3 +199,54 @@ async def test_virtual_connector_roundtrip():
         await client.close()
     finally:
         await server.stop()
+
+
+# -- SLA profiler (reference: benchmarks/profiler/profile_sla.py) ----------
+
+def test_profiler_round_trip(tmp_path):
+    """Sweep a live tiny engine → npz → interpolators → planner decision."""
+    from dynamo_tpu.planner.interpolator import (
+        DecodeInterpolator,
+        PrefillInterpolator,
+    )
+    from dynamo_tpu.planner.planner_core import Planner, PlannerConfig
+    from dynamo_tpu.planner.profiler import (
+        SlaProfiler,
+        engine_config_for_sweep,
+        load_profile,
+        save_profile,
+    )
+
+    isl_grid, conc_grid, ctx_grid = [16, 32], [1, 2], [16, 48]
+    cfg = engine_config_for_sweep("tiny-llama", isl_grid, conc_grid, ctx_grid,
+                                  decode_steps=4, block_size=4)
+    prof = SlaProfiler(cfg, chips=1)
+    data = prof.run(isl_grid, conc_grid, ctx_grid, decode_steps=4)
+
+    # sane measurements
+    assert (data["prefill_ttft_s"] > 0).all()
+    assert (data["decode_itl_s"] > 0).all()
+    assert data["decode_itl_s"].shape == (2, 2)
+
+    save_profile(tmp_path / "p.npz", data)
+    loaded = load_profile(tmp_path / "p.npz")
+
+    planner = Planner(
+        PlannerConfig(ttft_sla_s=10.0, itl_sla_s=10.0, max_replicas=8),
+        PrefillInterpolator.from_data(loaded),
+        DecodeInterpolator.from_data(loaded),
+    )
+    d = planner.compute_replicas(num_req=5.0, isl=24.0, osl=8.0)
+    assert 1 <= d.prefill_replicas <= 8
+    assert 1 <= d.decode_replicas <= 8
+
+
+def test_profiler_itl_scales_sanely():
+    """More concurrency must not *reduce* total decode throughput."""
+    from dynamo_tpu.planner.profiler import SlaProfiler, engine_config_for_sweep
+
+    cfg = engine_config_for_sweep("tiny-llama", [16], [1, 4], [32],
+                                  decode_steps=4, block_size=4)
+    prof = SlaProfiler(cfg, chips=1)
+    itl, thpt = prof.profile_decode([1, 4], [32], steps=4)
+    assert thpt[1, 0] >= thpt[0, 0] * 0.8  # batched decode ≥ solo (tolerance)
