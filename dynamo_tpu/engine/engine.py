@@ -163,7 +163,7 @@ class ModelRunner:
         return int(min(n, cap))
 
     # ------------------------------------------------------------------
-    def _build_step_fn(self, b: int, t: int, nblk: int):
+    def _build_step_fn(self, b: int, t: int, nblk: int, sp_prefill: bool = False):
         cfg = self.cfg
         trash_row = self.engine_cfg.max_batch_size
 
@@ -175,7 +175,7 @@ class ModelRunner:
                  temp, top_k, top_p, fp, pp, rp, do_sample):
             hidden, ck, cv = llama.forward(params, cfg, tokens, q_start, q_len, bt, ck, cv,
                                            attn_impl=attn_impl, moe_impl=moe_impl,
-                                           mesh=mesh)
+                                           mesh=mesh, sp_prefill=sp_prefill)
             logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
             st = SamplingState(
                 temperature=temp, top_k=top_k, top_p=top_p,
@@ -192,11 +192,12 @@ class ModelRunner:
 
         return jax.jit(step, donate_argnums=(1, 2, 3, 4))
 
-    def step_fn(self, b: int, t: int, nblk: int):
-        key = (b, t, nblk)
+    def step_fn(self, b: int, t: int, nblk: int, sp_prefill: bool = False):
+        key = (b, t, nblk, sp_prefill)
         if key not in self._step_fns:
-            log.info("compiling step fn B=%d T=%d NBLK=%d", b, t, nblk)
-            self._step_fns[key] = self._build_step_fn(b, t, nblk)
+            log.info("compiling step fn B=%d T=%d NBLK=%d sp_prefill=%s",
+                     b, t, nblk, sp_prefill)
+            self._step_fns[key] = self._build_step_fn(b, t, nblk, sp_prefill)
         return self._step_fns[key]
 
     def reset_slot(self, slot: int, seed: int | None) -> None:
@@ -220,6 +221,14 @@ class ModelRunner:
             b, t = _bucket(n, (1, 2, 4, 8)), _pow2_bucket(t_max, 16, ec.prefill_chunk)
         nblk_need = max(len(s.block_ids) for s, _, _ in rows)
         nblk = min(_pow2_bucket(max(nblk_need, 1), 4, self.max_nblk), self.max_nblk)
+        # Sequence-parallel prefill: a batch of fresh full-prompt chunks
+        # (every row starts at 0) on a seq>1 mesh rides ring attention.
+        sp_prefill = (
+            t > 1
+            and self.mesh is not None
+            and self.mesh.shape.get("seq", 1) > 1
+            and all(start == 0 for _, start, _ in rows)
+        )
 
         tokens = np.zeros((b, t), np.int32)
         q_start = np.zeros((b,), np.int32)
@@ -250,7 +259,7 @@ class ModelRunner:
             rp[i] = so.repetition_penalty or 1.0
             do_sample[i] = sample_rows[i]
 
-        fn = self.step_fn(b, t, nblk)
+        fn = self.step_fn(b, t, nblk, sp_prefill)
         (self.cache_k, self.cache_v, self.counts, self.keys, toks, lps) = fn(
             self.params, self.cache_k, self.cache_v, self.counts, self.keys,
             jnp.asarray(tokens), jnp.asarray(q_start), jnp.asarray(q_len),
@@ -272,6 +281,19 @@ class EngineCore:
         event_sink: Callable[[KvCacheEvent], None] | None = None,
     ):
         self.engine_cfg = engine_cfg
+        if engine_cfg.sp > 1 and engine_cfg.prefill_chunk < engine_cfg.max_model_len:
+            # Sequence-parallel engines prefill whole prompts as ONE
+            # seq-sharded chunk (ring attention needs the chunk to be the
+            # entire context); chunking would push later chunks onto the
+            # dense path and waste the sp axis.
+            log.info("sp=%d: raising prefill_chunk %d -> max_model_len %d",
+                     engine_cfg.sp, engine_cfg.prefill_chunk, engine_cfg.max_model_len)
+            engine_cfg.prefill_chunk = engine_cfg.max_model_len
+        if mesh is None and engine_cfg.mesh_shape() != {
+            "data": 1, "model": 1, "expert": 1, "seq": 1
+        }:
+            mesh = make_mesh(MeshConfig(dp=engine_cfg.dp, sp=engine_cfg.sp,
+                                        tp=engine_cfg.tp, ep=engine_cfg.ep))
         self.model_cfg = resolve_model_config(engine_cfg.model)
         self.runner = ModelRunner(self.model_cfg, engine_cfg, mesh=mesh, params=params,
                                   rng_seed=engine_cfg.seed)
@@ -619,8 +641,5 @@ class AsyncJaxEngine:
 
 def build_engine(engine_cfg: EngineConfig, mesh=None, params=None,
                  event_sink=None) -> AsyncJaxEngine:
-    if mesh is None and engine_cfg.mesh_shape() != {"data": 1, "model": 1, "expert": 1, "seq": 1}:
-        mesh = make_mesh(MeshConfig(dp=engine_cfg.dp, sp=engine_cfg.sp,
-                                    tp=engine_cfg.tp, ep=engine_cfg.ep))
     core = EngineCore(engine_cfg, mesh=mesh, params=params, event_sink=event_sink)
     return AsyncJaxEngine(core)

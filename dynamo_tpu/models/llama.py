@@ -247,6 +247,7 @@ def forward(
     attn_impl: str = "dense",
     moe_impl: str = "dense",
     mesh=None,
+    sp_prefill: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One engine step. Returns (last_hidden [B,H], cache_k, cache_v).
 
@@ -259,6 +260,7 @@ def forward(
     bs = cache_k.shape[2]
     tp = mesh.shape.get("model", 1) if mesh is not None else 1
     dp = mesh.shape.get("data", 1) if mesh is not None else 1
+    sp = mesh.shape.get("seq", 1) if mesh is not None else 1
     if attn_impl in ("pallas", "pallas_interpret") and tp > 1 and (
         cfg.num_kv_heads % tp != 0 or b % dp != 0
     ):
@@ -266,6 +268,13 @@ def forward(
         # path, partitioned by GSPMD (trace-time decision; logged once at
         # engine init where the head/mesh mismatch is known statically).
         attn_impl = "dense"
+    # Sequence-parallel prefill (ring attention over "seq"): exact for a
+    # fresh full-prompt chunk — its attention context is the chunk itself.
+    # Trace-time divisibility guards; fall back to the dense path otherwise.
+    use_ring = (
+        sp_prefill and sp > 1 and t > 1 and t % sp == 0
+        and cfg.num_kv_heads % tp == 0 and b % dp == 0
+    )
     positions = q_start[:, None] + jnp.arange(t)[None, :]          # [B, T]
     valid = jnp.arange(t)[None, :] < q_len[:, None]                # [B, T]
     kv_lens = q_start + q_len                                      # [B]
@@ -289,7 +298,11 @@ def forward(
         k = rope(k, positions, cfg.rope_theta)
         ck = _scatter_kv(ck, k, slot)
         cv = _scatter_kv(cv, v, slot)
-        if attn_impl in ("pallas", "pallas_interpret"):
+        if use_ring:
+            from dynamo_tpu.ops.ring_attention import ring_attention_prefill
+
+            attn = ring_attention_prefill(mesh, q, k, v, kv_lens)
+        elif attn_impl in ("pallas", "pallas_interpret"):
             from dynamo_tpu.ops.paged_attention import (
                 paged_attention_kernel,
                 paged_attention_sharded,

@@ -98,6 +98,37 @@ def ring_attention(
     return out.reshape(b, t, h, d).astype(q.dtype)
 
 
+def ring_attention_prefill(
+    mesh: Mesh,
+    q: jax.Array,      # [B, T, H, D] — full fresh prompt chunk (q_start = 0)
+    k: jax.Array,      # [B, T, KH, D]
+    v: jax.Array,
+    kv_len: jax.Array,  # [B] valid token count per row
+) -> jax.Array:
+    """Sequence-parallel prefill attention inside the serving step.
+
+    For a *fresh* full-prompt chunk (q_start == 0) the attention context is
+    exactly the chunk itself, so the paged cache never needs to be read:
+    shard the T axis over "seq" and ring-rotate K/V chunks over ICI.
+    Batch rides "data", heads ride "model" (both no-ops at size 1), so the
+    same wrapper serves sp-only and sp×tp×dp meshes.
+
+    Callers guard divisibility (T % sp, KH % tp, B % dp) and fall back to
+    the dense path otherwise — see models/llama.forward.
+    """
+    spec = P("data", "seq", "model", None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec, P("data")),
+        out_specs=spec, check_vma=False,
+    )
+    def _fn(q, k, v, kv_len):
+        return ring_attention(q, k, v, axis_name="seq", kv_len=kv_len)
+
+    return _fn(q, k, v, kv_len)
+
+
 def ring_attention_sharded(mesh: Mesh, *, axis_name: str = "seq") -> Callable:
     """Build a jitted global-view ring attention fn over ``mesh``.
 
