@@ -280,15 +280,28 @@ class EngineCore:
         params=None,
         event_sink: Callable[[KvCacheEvent], None] | None = None,
     ):
-        self.engine_cfg = engine_cfg
-        if engine_cfg.sp > 1 and engine_cfg.prefill_chunk < engine_cfg.max_model_len:
+        if engine_cfg.sp > 1 and (
+            engine_cfg.prefill_chunk < engine_cfg.max_model_len
+            or engine_cfg.max_tokens_per_step < engine_cfg.max_model_len
+        ):
             # Sequence-parallel engines prefill whole prompts as ONE
             # seq-sharded chunk (ring attention needs the chunk to be the
-            # entire context); chunking would push later chunks onto the
-            # dense path and waste the sp axis.
-            log.info("sp=%d: raising prefill_chunk %d -> max_model_len %d",
-                     engine_cfg.sp, engine_cfg.prefill_chunk, engine_cfg.max_model_len)
-            engine_cfg.prefill_chunk = engine_cfg.max_model_len
+            # entire context); chunking — whether by prefill_chunk or by the
+            # scheduler's per-step token budget — would push later chunks
+            # (start != 0) onto the dense path and waste the sp axis. Copy
+            # the config rather than mutating the caller's.
+            import dataclasses as _dc
+            log.info(
+                "sp=%d: raising prefill_chunk %d and max_tokens_per_step %d -> "
+                "max_model_len %d", engine_cfg.sp, engine_cfg.prefill_chunk,
+                engine_cfg.max_tokens_per_step, engine_cfg.max_model_len)
+            engine_cfg = _dc.replace(
+                engine_cfg,
+                prefill_chunk=max(engine_cfg.prefill_chunk, engine_cfg.max_model_len),
+                max_tokens_per_step=max(engine_cfg.max_tokens_per_step,
+                                        engine_cfg.max_model_len),
+            )
+        self.engine_cfg = engine_cfg
         if mesh is None and engine_cfg.mesh_shape() != {
             "data": 1, "model": 1, "expert": 1, "seq": 1
         }:

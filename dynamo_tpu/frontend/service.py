@@ -237,6 +237,7 @@ class HttpService:
         gen = ChatDeltaGenerator(req.model, pre.request_id)
         gen.prompt_tokens = len(pre.token_ids)
         jail = self._make_jail(entry, req) if chat else None
+        jail_flushed = False
         first = True
         prev = t_start
         ntokens = 0
@@ -264,6 +265,7 @@ class HttpService:
                             await resp.write(encode_sse_json(gen.reasoning_chunk(jd.reasoning)))
                         if out.finish_reason is not None:
                             fin = jail.finish()
+                            jail_flushed = True
                             tail = jd.content + fin.content
                             if fin.reasoning:
                                 await resp.write(encode_sse_json(gen.reasoning_chunk(fin.reasoning)))
@@ -299,6 +301,20 @@ class HttpService:
                         await resp.write(encode_sse_json(cr))
                 if backend.hit_stop:
                     break
+            if jail is not None and not jail_flushed:
+                # Stream ended without a finish_reason (engine error or stop
+                # mid-jail): flush withheld text — a bare-JSON/mistral payload
+                # the jail held to end-of-stream would otherwise vanish.
+                fin = jail.finish()
+                jail_flushed = True
+                if fin.reasoning:
+                    await resp.write(encode_sse_json(gen.reasoning_chunk(fin.reasoning)))
+                if fin.tool_calls:
+                    await resp.write(encode_sse_json(gen.tool_calls_chunk(fin.tool_calls)))
+                elif fin.content:
+                    tail_chunk = gen.chunk(BackendOutput(text=fin.content))
+                    if tail_chunk is not None:
+                        await resp.write(encode_sse_json(tail_chunk))
             await resp.write(DONE_EVENT)
             self._requests.inc(route="chat" if chat else "completions", status="200")
         except (ConnectionResetError, asyncio.CancelledError):

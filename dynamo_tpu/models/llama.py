@@ -30,6 +30,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("models.llama")
 
 Params = dict[str, Any]
 
@@ -265,8 +268,14 @@ def forward(
         cfg.num_kv_heads % tp != 0 or b % dp != 0
     ):
         # Heads/batch don't divide the mesh: fall back to the dense gather
-        # path, partitioned by GSPMD (trace-time decision; logged once at
-        # engine init where the head/mesh mismatch is known statically).
+        # path, partitioned by GSPMD. Trace-time decision — tracing happens
+        # once per (batch, chunk) bucket, so this logs once per bucket that
+        # actually serves the slow path rather than silently degrading.
+        reason = (f"num_kv_heads={cfg.num_kv_heads} mod tp={tp}"
+                  if cfg.num_kv_heads % tp != 0 else f"batch={b} mod dp={dp}")
+        log.warning(
+            "paged-attention kernel disabled for bucket (b=%d, t=%d): %s does "
+            "not divide; serving the dense gather path", b, t, reason)
         attn_impl = "dense"
     # Sequence-parallel prefill (ring attention over "seq"): exact for a
     # fresh full-prompt chunk — its attention context is the chunk itself.

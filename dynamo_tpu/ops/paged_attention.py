@@ -10,7 +10,8 @@ a running online softmax. No gathered context tensor ever exists.
 
 Works for both prefill chunks (T>1 query tokens) and decode (T=1) with the
 same causal position masking as the dense path. Numerical equivalence is
-tested in tests/test_ops.py; TPU lowering is proven by bench.py on hardware.
+tested in tests/test_ops.py (interpret mode); bench.py exercises TPU
+lowering on hardware and reports which attention impl actually ran.
 
 Design notes (reference has no TPU analog; its one kernel is a CUDA block
 copy, lib/llm/src/kernels/block_copy.cu — paged attention itself lives

@@ -101,12 +101,24 @@ class SlaProfiler:
         for _ in range(concurrency):
             self.core.add_request(
                 _request(context, steps + 2, self._rid(), seed=self._uid))
-        # Run prefills + the first decode step (compiles the decode bucket).
-        # num_decode_tokens is cumulative across the shared engine, so
-        # compare to its value on entry, not to zero.
+        # Run until EVERY request has finished prefill (the scheduler mixes
+        # prefill chunks into decode steps, so "first decode token seen" is
+        # NOT steady state — at high concurrency most of the batch would
+        # still be prefilling and the timed window would fold prefill-chunk
+        # compute into the ITL). Steady state = num_prefill_tokens stops
+        # growing and at least one decode token has landed.
         entered = self.core.metrics.num_decode_tokens
-        while self.core.metrics.num_decode_tokens == entered and self.core.has_work():
+        while self.core.has_work():
+            pre = self.core.metrics.num_prefill_tokens
             self.core.step()
+            if (self.core.metrics.num_prefill_tokens == pre
+                    and self.core.sched.num_waiting == 0
+                    and self.core.metrics.num_decode_tokens > entered):
+                # No prefill progressed this step AND nothing is queued
+                # waiting for a batch slot — a decode-only step with waiting
+                # requests would still see their prefills land inside the
+                # timed window once slots free up.
+                break
         base = self.core.metrics.num_decode_tokens
         t0 = time.perf_counter()
         while (self.core.metrics.num_decode_tokens - base < concurrency * steps

@@ -36,6 +36,7 @@ class KvRouterConfig:
     temperature: float = 0.0
     use_approx_indexer: bool = False   # engines without KV events
     approx_ttl_s: float = 120.0
+    sync_replicas: bool = False        # mirror ActiveSequences across routers
 
 
 class KvRouter:
@@ -107,6 +108,7 @@ class KvPushRouter:
         self.router = KvRouter(config)
         self._tasks: list[asyncio.Task] = []
         self._known_workers: set[WorkerId] = set()
+        self._synced: "SyncedActiveSequences | None" = None
 
     @classmethod
     async def create(cls, client: EndpointClient,
@@ -117,6 +119,16 @@ class KvPushRouter:
         assert coord is not None
         ev_sub = await coord.subscribe(kv_events_subject(ep.namespace, ep.component))
         met_sub = await coord.subscribe(load_metrics_subject(ep.namespace, ep.component))
+        if self.router.config.sync_replicas:
+            from dynamo_tpu.router.sequence import (
+                SyncedActiveSequences,
+                active_seq_subject,
+            )
+            synced = SyncedActiveSequences(
+                coord, active_seq_subject(ep.namespace, ep.component))
+            await synced.start()
+            self.router.active = synced
+            self._synced = synced
         self._tasks.append(asyncio.create_task(self._event_loop(ev_sub)))
         self._tasks.append(asyncio.create_task(self._metrics_loop(met_sub)))
         self._tasks.append(asyncio.create_task(self._instance_gc_loop()))
@@ -182,3 +194,5 @@ class KvPushRouter:
     async def close(self) -> None:
         for t in self._tasks:
             t.cancel()
+        if self._synced is not None:
+            await self._synced.close()

@@ -11,7 +11,8 @@ Hash scheme (kept simple and documented so fixtures are reproducible):
   seq_hash(block_0)    = block_hash(block_0)
   seq_hash(block_i)    = xxh3_64(le_u64(seq_hash(block_{i-1})) || le_u64(block_hash(block_i)))
 
-A C++ fast path (csrc/) is used when built; the Python fallback is exact.
+Implementation is pure Python over the xxhash C extension; hashing whole
+blocks via ``struct.pack`` keeps the per-block cost a single C call.
 """
 
 from __future__ import annotations

@@ -1,7 +1,10 @@
 """KV router unit tests (reference test model: inline tests in
 kv_router/{indexer,scheduler}.rs — radix matching + softmax selection)."""
 
+import asyncio
 import random
+
+import pytest
 
 from dynamo_tpu.router.events import BlockRemoved, BlockStored, RouterEvent
 from dynamo_tpu.router.indexer import ApproxKvIndexer, RadixIndexer
@@ -127,3 +130,47 @@ def test_kv_router_end_to_end_decision():
     wid3, _ = r.find_best_match("req3", tokens, worker_ids=[7, 8])
     assert wid3 == 7
     r.complete("req3")
+
+
+@pytest.mark.asyncio
+async def test_synced_active_sequences_mirrors_across_replicas():
+    """Two router replicas: a dispatch recorded on A becomes visible in B's
+    prediction (reference: sequence.rs:283 ActiveSequencesMultiWorker)."""
+    import contextlib
+
+    from dynamo_tpu.router.sequence import SyncedActiveSequences, active_seq_subject
+    from dynamo_tpu.transports.client import CoordinatorClient
+    from dynamo_tpu.transports.coordinator import CoordinatorServer
+
+    server = CoordinatorServer()
+    await server.start()
+    ca = await CoordinatorClient.connect(server.url)
+    cb = await CoordinatorClient.connect(server.url)
+    subj = active_seq_subject("test", "backend")
+    a = SyncedActiveSequences(ca, subj)
+    b = SyncedActiveSequences(cb, subj)
+    await a.start()
+    await b.start()
+    try:
+        a.add_request("r1", 7, prefill_blocks=5, overlap_blocks=2)
+        assert a.active_blocks(7) == 7  # local apply is synchronous
+        for _ in range(100):
+            if b.active_blocks(7) == 7:
+                break
+            await asyncio.sleep(0.02)
+        assert b.active_blocks(7) == 7
+        assert b.request_count(7) == 1
+
+        b.free("r1")  # either replica may observe stream end
+        for _ in range(100):
+            if a.active_blocks(7) == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert a.active_blocks(7) == 0
+    finally:
+        await a.close()
+        await b.close()
+        with contextlib.suppress(Exception):
+            await ca.close()
+            await cb.close()
+        await server.stop()
