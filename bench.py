@@ -192,6 +192,13 @@ def main() -> None:
         fail("bench_child", f"bench hung for {exc.timeout:.0f}s after a successful device probe")
         return
     sys.stderr.write(proc.stderr[-8000:])
+    if not any(ln.startswith("{") for ln in proc.stdout.splitlines()):
+        # Child died without emitting its JSON line (SIGKILL, OOM, libtpu
+        # abort) — synthesize one so the contract holds even then.
+        fail("bench_child",
+             f"child exited rc={proc.returncode} with no JSON; stderr tail: "
+             + proc.stderr[-1500:])
+        return
     sys.stdout.write(proc.stdout)
     sys.exit(proc.returncode)
 

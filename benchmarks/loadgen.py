@@ -1,0 +1,222 @@
+"""Async HTTP load generator for the OpenAI frontend — the genai-perf analog.
+
+Fills the role of the reference's benchmark harness
+(reference: benchmarks/README.md:19-40 — genai-perf profiles with controlled
+concurrency/ISL/OSL; recipes/llama-3-70b/vllm/agg/perf.yaml:40-50), measuring
+the BASELINE.md target metric: p50/p99 TTFT, p50/p99 ITL, and tokens/sec/chip
+against a live HTTP endpoint.
+
+Workload model: ``--concurrency`` closed-loop streams; each request sends a
+synthetic prompt of ~``--isl`` tokens and forces exactly ``--osl`` output
+tokens (``ignore_eos`` + ``max_tokens``, so finish_reason is always
+``length`` and output token counts are exact, not estimated). Per request we
+record TTFT (first content delta) and every inter-chunk gap (the engine
+emits one chunk per decode step, so chunk gaps are inter-token latencies).
+
+Prints ONE JSON object to stdout; ``--out`` additionally writes it to a file.
+
+Usage:
+    python -m benchmarks.loadgen --url http://127.0.0.1:8000 \
+        --model tiny-llama --concurrency 8 --requests 32 --isl 128 --osl 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import sys
+import time
+
+import aiohttp
+
+WORDS = (
+    "alpha bravo charlie delta echo foxtrot golf hotel india juliet kilo lima "
+    "mike november oscar papa quebec romeo sierra tango uniform victor whiskey "
+    "xray yankee zulu"
+).split()
+
+
+def make_prompt(isl: int, seed: int, chars_per_token: float) -> str:
+    """~isl tokens of unique-per-request text (the leading nonce defeats
+    cross-request prefix caching so TTFT measures real prefill).
+
+    ``chars_per_token`` comes from a live calibration probe (see
+    ``calibrate``), so ISL holds for BPE and byte-level tokenizers alike."""
+    rng = random.Random(seed)
+    budget = max(int(isl * chars_per_token), 8)
+    parts = [f"req{seed}nonce"]
+    size = len(parts[0])
+    while size < budget:
+        w = rng.choice(WORDS)
+        parts.append(w)
+        size += len(w) + 1
+    return " ".join(parts)
+
+
+async def calibrate(session: aiohttp.ClientSession, url: str, model: str) -> float:
+    """Measure the model's chars-per-token on this endpoint: send a known
+    character count, read usage.prompt_tokens back (non-streaming)."""
+    # Short enough to fit tiny test configs even under byte-level
+    # tokenization (~190 chars), long enough to average out BPE variance.
+    text = " ".join(random.Random(0).choice(WORDS) for _ in range(30))
+    body = {"model": model, "messages": [{"role": "user", "content": text}],
+            "max_tokens": 1, "temperature": 0.0}
+    async with session.post(f"{url}/v1/chat/completions", json=body) as resp:
+        resp.raise_for_status()
+        usage = (await resp.json()).get("usage") or {}
+    ptoks = usage.get("prompt_tokens") or len(text) // 4
+    return max(len(text) / max(ptoks, 1), 0.25)
+
+
+def percentile(values: list[float], p: float) -> float:
+    if not values:
+        return 0.0
+    xs = sorted(values)
+    idx = min(int(round(p / 100.0 * (len(xs) - 1))), len(xs) - 1)
+    return xs[idx]
+
+
+class RequestResult:
+    __slots__ = ("ok", "ttft_s", "itl_s", "output_tokens", "latency_s", "error")
+
+    def __init__(self) -> None:
+        self.ok = False
+        self.ttft_s = 0.0
+        self.itl_s: list[float] = []
+        self.output_tokens = 0
+        self.latency_s = 0.0
+        self.error = ""
+
+
+async def one_request(session: aiohttp.ClientSession, url: str, model: str,
+                      isl: int, osl: int, seed: int,
+                      chars_per_token: float) -> RequestResult:
+    res = RequestResult()
+    body = {
+        "model": model,
+        "messages": [{"role": "user", "content": make_prompt(isl, seed, chars_per_token)}],
+        "max_tokens": osl,
+        "temperature": 0.0,
+        "ignore_eos": True,
+        "stream": True,
+    }
+    t0 = time.perf_counter()
+    prev = t0
+    try:
+        async with session.post(f"{url}/v1/chat/completions", json=body) as resp:
+            if resp.status != 200:
+                res.error = f"http {resp.status}: {(await resp.text())[:200]}"
+                return res
+            async for raw in resp.content:
+                line = raw.decode("utf-8", errors="replace").strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                try:
+                    chunk = json.loads(payload)
+                except json.JSONDecodeError:
+                    continue
+                if "error" in chunk:
+                    res.error = str(chunk["error"])[:200]
+                    return res
+                delta = (chunk.get("choices") or [{}])[0].get("delta", {})
+                if delta.get("content"):
+                    now = time.perf_counter()
+                    if res.output_tokens == 0:
+                        res.ttft_s = now - t0
+                    else:
+                        res.itl_s.append(now - prev)
+                    prev = now
+                    res.output_tokens += 1
+        res.latency_s = time.perf_counter() - t0
+        res.ok = res.output_tokens > 0
+        if not res.ok:
+            res.error = "no content chunks"
+    except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+        res.error = f"{type(exc).__name__}: {exc}"
+    return res
+
+
+async def run_load(url: str, model: str, concurrency: int, num_requests: int,
+                   isl: int, osl: int, warmup: int) -> dict:
+    results: list[RequestResult] = []
+    counter = iter(range(10 ** 9))
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=30)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        cpt = await calibrate(session, url, model)
+        # Warmup (compile all engine buckets) — excluded from measurement.
+        for _ in range(warmup):
+            await one_request(session, url, model, isl, osl, next(counter), cpt)
+
+        t_start = time.perf_counter()
+        pending: set[asyncio.Task] = set()
+        issued = 0
+        while issued < num_requests or pending:
+            while issued < num_requests and len(pending) < concurrency:
+                pending.add(asyncio.create_task(one_request(
+                    session, url, model, isl, osl, next(counter), cpt)))
+                issued += 1
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED)
+            results.extend(t.result() for t in done)
+        wall = time.perf_counter() - t_start
+
+    good = [r for r in results if r.ok]
+    bad = [r for r in results if not r.ok]
+    ttfts = [r.ttft_s for r in good]
+    itls = [x for r in good for x in r.itl_s]
+    total_tokens = sum(r.output_tokens for r in good)
+    return {
+        "requests": len(results),
+        "failed": len(bad),
+        "errors": sorted({r.error for r in bad})[:5],
+        "concurrency": concurrency,
+        "isl": isl,
+        "osl": osl,
+        "wall_s": round(wall, 3),
+        "output_tok_s": round(total_tokens / wall, 2) if wall > 0 else 0.0,
+        "requests_per_s": round(len(good) / wall, 3) if wall > 0 else 0.0,
+        "ttft_p50_s": round(percentile(ttfts, 50), 4),
+        "ttft_p99_s": round(percentile(ttfts, 99), 4),
+        "ttft_avg_s": round(sum(ttfts) / len(ttfts), 4) if ttfts else 0.0,
+        "itl_p50_s": round(percentile(itls, 50), 5),
+        "itl_p99_s": round(percentile(itls, 99), 5),
+        "e2e_p50_s": round(percentile([r.latency_s for r in good], 50), 4),
+        "e2e_p99_s": round(percentile([r.latency_s for r in good], 99), 4),
+    }
+
+
+def main(argv: list[str] | None = None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default="http://127.0.0.1:8000")
+    ap.add_argument("--model", default="tiny-llama")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--isl", type=int, default=128)
+    ap.add_argument("--osl", type=int, default=32)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--chips", type=int, default=1,
+                    help="chips serving the endpoint (for tok/s/chip)")
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    ns = ap.parse_args(argv)
+
+    result = asyncio.run(run_load(
+        ns.url, ns.model, ns.concurrency, ns.requests, ns.isl, ns.osl, ns.warmup))
+    result["chips"] = ns.chips
+    result["output_tok_s_per_chip"] = round(result["output_tok_s"] / ns.chips, 2)
+    print(json.dumps(result))
+    if ns.out:
+        with open(ns.out, "w") as f:
+            json.dump(result, f, indent=2)
+    if result["failed"]:
+        print(f"loadgen: {result['failed']} failed requests: {result['errors']}",
+              file=sys.stderr)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -309,12 +309,12 @@ class HttpService:
                 jail_flushed = True
                 if fin.reasoning:
                     await resp.write(encode_sse_json(gen.reasoning_chunk(fin.reasoning)))
-                if fin.tool_calls:
-                    await resp.write(encode_sse_json(gen.tool_calls_chunk(fin.tool_calls)))
-                elif fin.content:
+                if fin.content:
                     tail_chunk = gen.chunk(BackendOutput(text=fin.content))
                     if tail_chunk is not None:
                         await resp.write(encode_sse_json(tail_chunk))
+                if fin.tool_calls:
+                    await resp.write(encode_sse_json(gen.tool_calls_chunk(fin.tool_calls)))
             await resp.write(DONE_EVENT)
             self._requests.inc(route="chat" if chat else "completions", status="200")
         except (ConnectionResetError, asyncio.CancelledError):

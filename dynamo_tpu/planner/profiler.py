@@ -98,9 +98,17 @@ class SlaProfiler:
     def measure_itl(self, concurrency: int, context: int, steps: int) -> float:
         """Steady-state seconds per all-decode step at a (concurrency,
         context) operating point."""
+        # Token budget: the wait-for-steady-state warmup below runs mixed
+        # prefill+decode steps in which early-admitted requests already
+        # decode, so give each request enough headroom that `steps` decode
+        # tokens are still left once the whole batch reaches steady state.
+        warmup_steps = max(
+            -(-concurrency * context // self.core.engine_cfg.max_tokens_per_step),
+            concurrency // max(self.core.engine_cfg.max_batch_size, 1) + 1,
+        )
         for _ in range(concurrency):
             self.core.add_request(
-                _request(context, steps + 2, self._rid(), seed=self._uid))
+                _request(context, steps + 2 + warmup_steps, self._rid(), seed=self._uid))
         # Run until EVERY request has finished prefill (the scheduler mixes
         # prefill chunks into decode steps, so "first decode token seen" is
         # NOT steady state — at high concurrency most of the batch would

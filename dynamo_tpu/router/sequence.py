@@ -149,6 +149,17 @@ class SyncedActiveSequences(ActiveSequences):
     async def close(self) -> None:
         for t in self._tasks:
             t.cancel()
+        # Flush whatever the send loop had not yet published (e.g. 'free'
+        # ops from streams that finished during shutdown) so peers don't
+        # carry stale predictions until the TTL sweep.
+        rest = []
+        while not self._outbox.empty():
+            rest.append(self._outbox.get_nowait())
+        if rest:
+            try:
+                await self._coord.publish(self._subject, msgpack.packb(rest))
+            except Exception:
+                log.warning("final active-seq sync flush failed; peers converge via TTL")
 
     # -- local mutators: apply + broadcast ------------------------------
     def add_request(self, request_id: str, worker_id: WorkerId,
