@@ -87,6 +87,14 @@ class EngineMetrics:
         }
 
 
+@dataclass
+class PendingStep:
+    """A dispatched-but-unmaterialized engine step: per batch,
+    (kind, rows, sample_rows, device tokens, device logprobs)."""
+
+    batches: list[tuple[str, list, list[bool], Any, Any]] = field(default_factory=list)
+
+
 class ModelRunner:
     """Device-state owner + bucketed compiled step functions."""
 
@@ -131,6 +139,10 @@ class ModelRunner:
         self.counts = jnp.zeros((maxb + 1, cfg.vocab_size), jnp.int32)
         base = jax.random.split(jax.random.key(engine_cfg.seed), maxb + 1)
         self.keys = jax.vmap(jax.random.key_data)(base).astype(jnp.uint32)
+        # Per-slot latest sampled token, ON DEVICE: lets the next decode step
+        # consume this step's token without a host round-trip — the core of
+        # the pipelined (host/device-overlapped) step loop. Row maxb = trash.
+        self.slot_toks = jnp.zeros((maxb + 1,), jnp.int32)
         self._step_fns: dict[tuple[int, int, int], Callable] = {}
         self.max_nblk = -(-engine_cfg.max_model_len // engine_cfg.block_size)
         from dynamo_tpu.ops.paged_attention import select_attn_impl
@@ -171,8 +183,14 @@ class ModelRunner:
         moe_impl = "ep" if self.engine_cfg.ep > 1 else "dense"
         mesh = self.mesh
 
-        def step(params, ck, cv, counts, keys, tokens, q_start, q_len, bt, slots,
-                 temp, top_k, top_p, fp, pp, rp, do_sample):
+        def step(params, ck, cv, counts, keys, slot_toks, tokens, q_start, q_len,
+                 bt, slots, temp, top_k, top_p, fp, pp, rp, do_sample, from_slot):
+            # Device-fed decode input: rows whose previous token was sampled
+            # by an in-flight step read it from slot_toks instead of the host
+            # tokens array (which holds 0 for them) — XLA's execution order
+            # guarantees the producing step has run.
+            first = jnp.where(from_slot, slot_toks[slots], tokens[:, 0])
+            tokens = tokens.at[:, 0].set(first)
             hidden, ck, cv = llama.forward(params, cfg, tokens, q_start, q_len, bt, ck, cv,
                                            attn_impl=attn_impl, moe_impl=moe_impl,
                                            mesh=mesh, sp_prefill=sp_prefill)
@@ -188,9 +206,10 @@ class ModelRunner:
             write_slots = jnp.where(do_sample, slots, trash_row)
             counts = counts.at[write_slots].set(new_counts)
             keys = keys.at[write_slots].set(new_keys)
-            return ck, cv, counts, keys, toks, lps
+            slot_toks = slot_toks.at[write_slots].set(toks)
+            return ck, cv, counts, keys, slot_toks, toks, lps
 
-        return jax.jit(step, donate_argnums=(1, 2, 3, 4))
+        return jax.jit(step, donate_argnums=(1, 2, 3, 4, 5))
 
     def step_fn(self, b: int, t: int, nblk: int, sp_prefill: bool = False):
         key = (b, t, nblk, sp_prefill)
@@ -206,12 +225,15 @@ class ModelRunner:
             k = jax.random.key_data(jax.random.key(seed)).astype(jnp.uint32)
             self.keys = self.keys.at[slot].set(k)
 
-    def run(
+    def dispatch(
         self,
         rows: list[tuple[Seq, int, int]],  # (seq, start, length) per row
         sample_rows: list[bool],
-    ) -> tuple[np.ndarray, np.ndarray]:
-        """Execute one bucketed step; returns (tokens [B], logprobs [B]) on host."""
+    ) -> tuple[jax.Array, jax.Array]:
+        """Enqueue one bucketed step on the device WITHOUT blocking; returns
+        device arrays (tokens [B], logprobs [B]) still being computed. The
+        caller overlaps host work (scheduling, output assembly for earlier
+        steps) with the device, then materializes via ``np.asarray``."""
         ec = self.engine_cfg
         n = len(rows)
         t_max = max(length for _, _, length in rows)
@@ -242,10 +264,16 @@ class ModelRunner:
         pp = np.zeros((b,), np.float32)
         rp = np.ones((b,), np.float32)
         do_sample = np.zeros((b,), bool)
+        from_slot = np.zeros((b,), bool)
 
         for i, (seq, start, length) in enumerate(rows):
-            chunk = seq.tokens[start : start + length]
-            tokens[i, : len(chunk)] = chunk
+            if seq.pending_device_token and length == 1:
+                # The input token was sampled by a still-in-flight step; the
+                # compiled step reads it from slot_toks on device.
+                from_slot[i] = True
+            else:
+                chunk = seq.tokens[start : start + length]
+                tokens[i, : len(chunk)] = chunk
             q_start[i] = start
             q_len[i] = length
             bt[i, : len(seq.block_ids)] = seq.block_ids
@@ -260,13 +288,26 @@ class ModelRunner:
             do_sample[i] = sample_rows[i]
 
         fn = self.step_fn(b, t, nblk, sp_prefill)
-        (self.cache_k, self.cache_v, self.counts, self.keys, toks, lps) = fn(
+        (self.cache_k, self.cache_v, self.counts, self.keys, self.slot_toks,
+         toks, lps) = fn(
             self.params, self.cache_k, self.cache_v, self.counts, self.keys,
+            self.slot_toks,
             jnp.asarray(tokens), jnp.asarray(q_start), jnp.asarray(q_len),
             jnp.asarray(bt), jnp.asarray(slots), jnp.asarray(temp),
             jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(fp),
             jnp.asarray(pp), jnp.asarray(rp), jnp.asarray(do_sample),
+            jnp.asarray(from_slot),
         )
+        return toks, lps
+
+    def run(
+        self,
+        rows: list[tuple[Seq, int, int]],
+        sample_rows: list[bool],
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Dispatch one step and block for host results (tokens, logprobs)."""
+        toks, lps = self.dispatch(rows, sample_rows)
+        n = len(rows)
         return np.asarray(toks)[:n], np.asarray(lps)[:n]
 
 
@@ -397,8 +438,21 @@ class EngineCore:
             return FinishReason.LENGTH
         return None
 
-    def step(self) -> dict[str, LLMEngineOutput]:
-        """Run one engine step; returns per-request output deltas."""
+    def step_begin(self) -> "PendingStep | None":
+        """Plan one engine step and DISPATCH it to the device without
+        blocking on results. Host-side state is advanced speculatively
+        (positions, block growth — everything value-independent), so the
+        caller may plan+dispatch the NEXT step while this one computes:
+        the sampled tokens stay on device (slot_toks) and feed the next
+        decode step directly. Value-dependent effects (token append, hash
+        commit, stop conditions) happen in :meth:`step_finalize`, which
+        lags by however many steps the caller keeps in flight.
+
+        This is the host/device overlap the reference-class engines get
+        from async scheduling — expressed TPU-style: the host never waits
+        to build step N+1, and a finished/stopped stream costs at most one
+        speculative row, discarded at finalize.
+        """
         plan = self.sched.plan()
         if self.kvbm is not None:
             # Write back blocks evicted during planning before their slots
@@ -407,8 +461,7 @@ class EngineCore:
             self.kvbm.flush_pending()
         self.metrics.num_preemptions = self.sched.preemption_count
         if plan.empty:
-            return {}
-        outputs: dict[str, LLMEngineOutput] = {}
+            return None
         self.metrics.num_steps += 1
 
         for seq in [w.seq for w in plan.prefill] + plan.decode:
@@ -419,11 +472,11 @@ class EngineCore:
         # Decode and prefill run as two bucketed programs in the same step
         # (decode first — see scheduler module docstring for why they are
         # not one padded batch).
-        batches: list[tuple[list, list[bool]]] = []
+        pending = PendingStep()
+        batches: list[tuple[str, list, list[bool]]] = []
         if plan.decode:
             rows = [(s, s.num_computed, 1) for s in plan.decode]
-            batches.append((rows, [True] * len(rows)))
-            self.metrics.num_decode_tokens += len(rows)
+            batches.append(("decode", rows, [True] * len(rows)))
         if plan.prefill:
             rows = [(w.seq, w.start, w.length) for w in plan.prefill]
             # Sample only on the chunk completing a *fresh* prompt; a
@@ -434,13 +487,44 @@ class EngineCore:
                 and len(w.seq.tokens) == w.seq.prompt_len
                 for w in plan.prefill
             ]
-            batches.append((rows, sample_rows))
-            self.metrics.num_prefill_tokens += sum(w.length for w in plan.prefill)
+            batches.append(("prefill", rows, sample_rows))
 
-        for rows, sample_rows in batches:
-            toks, lps = self.runner.run(rows, sample_rows)
+        for kind, rows, sample_rows in batches:
+            toks, lps = self.runner.dispatch(rows, sample_rows)
+            # Value-independent bookkeeping, done at dispatch so the next
+            # plan() sees advanced positions. Token metrics count at
+            # finalize, so discarded speculative rows don't inflate them.
             for i, (seq, start, length) in enumerate(rows):
                 seq.num_computed = start + length
+                if sample_rows[i]:
+                    seq.pending_device_token = True
+            pending.batches.append((kind, rows, sample_rows, toks, lps))
+        return pending
+
+    def step_finalize(self, pending: "PendingStep") -> dict[str, LLMEngineOutput]:
+        """Materialize a dispatched step's tokens and apply value-dependent
+        effects: append tokens, commit full blocks (hash chain), evaluate
+        stop conditions, assemble per-request outputs."""
+        outputs: dict[str, LLMEngineOutput] = {}
+        for kind, rows, sample_rows, toks_dev, lps_dev in pending.batches:
+            n = len(rows)
+            toks = np.asarray(toks_dev)[:n]
+            lps = np.asarray(lps_dev)[:n]
+            for i, (seq, start, length) in enumerate(rows):
+                if seq.phase is Phase.FINISHED:
+                    # Finished (stop/abort) while this step was in flight:
+                    # its speculative row is discarded.
+                    continue
+                if kind == "decode":
+                    self.metrics.num_decode_tokens += 1
+                else:
+                    self.metrics.num_prefill_tokens += length
+                if sample_rows[i]:
+                    seq.pending_device_token = False
+                # A seq preempted while in flight is WAITING with
+                # num_computed reset to 0 — commit is then a no-op, and the
+                # sampled token still belongs to the stream (resume only
+                # recomputes KV), so the normal path below is correct.
                 self.sched.commit_computed_blocks(seq)
                 if not sample_rows[i]:
                     continue  # intermediate prefill chunk: no token emitted
@@ -459,6 +543,11 @@ class EngineCore:
                     del self._seqs[seq.request_id]
                 outputs[seq.request_id] = out
         return outputs
+
+    def step(self) -> dict[str, LLMEngineOutput]:
+        """Run one engine step synchronously; returns per-request deltas."""
+        pending = self.step_begin()
+        return self.step_finalize(pending) if pending is not None else {}
 
     # -- disagg / KV-transfer primitives (engine-core thread only) ---------
     @property
@@ -550,6 +639,12 @@ class AsyncJaxEngine:
 
     # ------------------------------------------------------------------
     def _run(self) -> None:
+        # Pipelined step loop: keep ONE step in flight. Each iteration plans
+        # and dispatches step N+1 BEFORE blocking on step N's tokens, so host
+        # work (scheduling, numpy prep, output assembly, SSE handoff) runs
+        # while the device computes — the overlap reference-class engines get
+        # from async scheduling (see EngineCore.step_begin).
+        pending: PendingStep | None = None
         while not self._stop:
             moved = False
             while True:
@@ -583,23 +678,27 @@ class AsyncJaxEngine:
                         # cancelled asyncio.run): the future's owner is gone;
                         # dropping the result must not kill this thread.
                         log.warning("exec result dropped: caller loop closed")
-            if not self.core.has_work():
+            if not self.core.has_work() and pending is None:
                 if not moved:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                 continue
             try:
-                outputs = self.core.step()
+                nxt = self.core.step_begin() if self.core.has_work() else None
+                if pending is not None:
+                    outputs = self.core.step_finalize(pending)
+                    for rid, out in outputs.items():
+                        self._post(rid, out)
+                pending = nxt
             except Exception as exc:
                 # Engine-fatal: fail + drain all in-flight state so the loop
                 # doesn't spin hot retrying the same failing step.
                 log.exception("engine step failed; failing all in-flight requests")
+                pending = None
                 self.core.fail_all(str(exc))
                 for rid in list(self._streams):
                     self._post(rid, LLMEngineOutput(finish_reason=FinishReason.ERROR, error=str(exc)))
                 continue
-            for rid, out in outputs.items():
-                self._post(rid, out)
 
     @staticmethod
     def _resolve(fut: asyncio.Future, result, exc: Exception | None) -> None:

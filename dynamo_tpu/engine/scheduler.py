@@ -51,6 +51,10 @@ class Seq:
     slot_initialized: bool = False  # sampling state (seed, counts) reset done
     block_seq: TokenBlockSequence = field(init=False)
     prefix_hit_blocks: int = 0     # engine-local prefix cache hits (stats)
+    # True while a dispatched-but-unmaterialized step holds this seq's
+    # latest sampled token on device (pipelined step loop): the next decode
+    # input reads slot_toks instead of seq.tokens.
+    pending_device_token: bool = False
 
     def __post_init__(self) -> None:
         self.tokens = list(self.req.token_ids)
@@ -232,6 +236,11 @@ class Scheduler:
         for seq in list(self.running):
             if not seq.in_decode:
                 continue
+            if seq.num_computed >= self.max_model_len:
+                # At capacity: the finalize of an in-flight step will finish
+                # this seq (pipelined stepping plans ahead of stop checks);
+                # decoding past max_model_len would outgrow the block table.
+                continue
             while not self._grow_for_decode(seq):
                 # preempt the most recently admitted other seq
                 victims = [s for s in reversed(self.running) if s is not seq]
@@ -261,8 +270,13 @@ class Scheduler:
 
     # ------------------------------------------------------------------
     def commit_computed_blocks(self, seq: Seq) -> None:
-        """Commit every fully-computed block (emits stored events via pool)."""
-        n_full = seq.num_computed // seq.block_size
+        """Commit every fully-computed block (emits stored events via pool).
+
+        Bounded by len(tokens) as well as num_computed: under pipelined
+        stepping num_computed runs ahead of the appended tokens, and a block
+        can only be committed once every token value in it is known (the
+        hash chain needs the values)."""
+        n_full = min(seq.num_computed, len(seq.tokens)) // seq.block_size
         hashes = seq.block_seq.sequence_hashes()
         while seq.committed_blocks < n_full:
             i = seq.committed_blocks

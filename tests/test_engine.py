@@ -230,3 +230,59 @@ def test_no_admit_evict_thrash_under_pressure():
     assert len(collected["long"]) == 8
     assert core.sched.preemption_count <= 4, (
         f"excessive preemption churn: {core.sched.preemption_count}")
+
+
+def run_pipelined(core: EngineCore, reqs, max_steps=500):
+    """Drive the engine with one step in flight (step_begin before
+    step_finalize of the previous step) — the AsyncJaxEngine loop shape."""
+    for r in reqs:
+        core.add_request(r)
+    collected = {r.request_id: [] for r in reqs}
+    finished = set()
+    pending = None
+    for _ in range(max_steps):
+        if not core.has_work() and pending is None:
+            break
+        nxt = core.step_begin() if core.has_work() else None
+        if pending is not None:
+            for rid, out in core.step_finalize(pending).items():
+                collected[rid].extend(out.token_ids)
+                if out.finish_reason is not None:
+                    finished.add(rid)
+        pending = nxt
+    return collected, finished
+
+
+def test_pipelined_matches_sync_greedy():
+    """The overlapped loop must produce bit-identical streams to the sync
+    loop: device-fed decode tokens (slot_toks) and lagged stop checks are
+    invisible to the client."""
+    reqs_a = [make_req(prompt=[3 * i + j for j in range(5 + i)], max_tokens=6 + i,
+                       rid=f"sync{i}") for i in range(4)]
+    core_a = EngineCore(tiny_config())
+    got_a, fin_a = run_to_completion(core_a, reqs_a)
+
+    reqs_b = [make_req(prompt=[3 * i + j for j in range(5 + i)], max_tokens=6 + i,
+                       rid=f"pipe{i}") for i in range(4)]
+    core_b = EngineCore(tiny_config())
+    got_b, fin_b = run_pipelined(core_b, reqs_b)
+
+    assert len(fin_a) == len(reqs_a) and len(fin_b) == len(reqs_b)
+    for i in range(4):
+        assert got_b[f"pipe{i}"] == got_a[f"sync{i}"], f"stream {i} diverged"
+    # Exactly max_tokens each — the speculative overrun row was discarded.
+    for i in range(4):
+        assert len(got_b[f"pipe{i}"]) == 6 + i
+
+
+def test_pipelined_mid_flight_abort():
+    """Abort between dispatch and finalize discards the in-flight row."""
+    core = EngineCore(tiny_config())
+    req = make_req(max_tokens=50, rid="victim")
+    core.add_request(req)
+    pending = core.step_begin()
+    assert pending is not None
+    core.abort("victim")
+    outs = core.step_finalize(pending)
+    assert "victim" not in outs
+    assert not core.has_work()
