@@ -267,7 +267,11 @@ class ModelRunner:
         from_slot = np.zeros((b,), bool)
 
         for i, (seq, start, length) in enumerate(rows):
-            if seq.pending_device_token and length == 1:
+            # Decode rows only (start at/after the prefill target): a
+            # length-1 resume-prefill chunk must read its host token, not
+            # the in-flight sampled one.
+            if (seq.inflight_samples > 0 and length == 1
+                    and start >= seq.prefill_target()):
                 # The input token was sampled by a still-in-flight step; the
                 # compiled step reads it from slot_toks on device.
                 from_slot[i] = True
@@ -497,7 +501,7 @@ class EngineCore:
             for i, (seq, start, length) in enumerate(rows):
                 seq.num_computed = start + length
                 if sample_rows[i]:
-                    seq.pending_device_token = True
+                    seq.inflight_samples += 1
             pending.batches.append((kind, rows, sample_rows, toks, lps))
         return pending
 
@@ -520,7 +524,7 @@ class EngineCore:
                 else:
                     self.metrics.num_prefill_tokens += length
                 if sample_rows[i]:
-                    seq.pending_device_token = False
+                    seq.inflight_samples -= 1
                 # A seq preempted while in flight is WAITING with
                 # num_computed reset to 0 — commit is then a no-op, and the
                 # sampled token still belongs to the stream (resume only

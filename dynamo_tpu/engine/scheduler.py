@@ -51,10 +51,12 @@ class Seq:
     slot_initialized: bool = False  # sampling state (seed, counts) reset done
     block_seq: TokenBlockSequence = field(init=False)
     prefix_hit_blocks: int = 0     # engine-local prefix cache hits (stats)
-    # True while a dispatched-but-unmaterialized step holds this seq's
-    # latest sampled token on device (pipelined step loop): the next decode
-    # input reads slot_toks instead of seq.tokens.
-    pending_device_token: bool = False
+    # Count of dispatched-but-unmaterialized sampled steps whose token for
+    # this seq lives only on device (pipelined step loop). While > 0, the
+    # next decode input reads slot_toks instead of seq.tokens — a bool is
+    # not enough: with step N in flight, step N-1's finalize must not make
+    # step N+1's dispatch read the (not yet appended) host token.
+    inflight_samples: int = 0
 
     def __post_init__(self) -> None:
         self.tokens = list(self.req.token_ids)
