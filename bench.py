@@ -5,7 +5,8 @@ Prints ONE JSON line:
 
 Workload: llama-3-8b-lite (real llama-3-8b layer shapes, 8 layers), batch 32,
 prompt 128, 64 greedy decode tokens each, prefix caching off. Throughput is
-measured over decode steps after the first (compile excluded).
+measured over decode steps after the first (compile excluded), driven through
+the same pipelined step loop production uses (EngineCore.step_begin/finalize).
 
 ``vs_baseline`` is the fraction of the chip's HBM-bandwidth roofline for
 batched decode (reading every param byte once per step):
@@ -13,11 +14,13 @@ batched decode (reading every param byte once per step):
 (v5e: 819 GB/s). The reference publishes no absolute tok/s (BASELINE.md), so
 the roofline is the honest fixed yardstick; 1.0 = bandwidth-bound perfection.
 
-Failure contract (round-2 verdict): a bench that cannot reach a device exits
-NONZERO with the error in the JSON — it never reports value 0 with rc 0, so
-"no device" is distinguishable from "zero throughput". Device init goes
-through a subprocess probe with a long timeout (the axon TPU tunnel has been
-observed to take >150s to cold-start) and retries.
+Timing contract (round-3 verdict): ONE overall deadline (DYN_BENCH_DEADLINE,
+default 540s) bounds the whole run — probe, compile, measurement. The bench
+NEVER outlives it: every stage gets the remaining budget, the decode loop
+breaks early when short on time (reporting what it measured), and on any
+failure the JSON line is emitted well before a driver-side timeout could
+rc-124 us with nothing on stdout. A bench that cannot reach a device exits
+NONZERO with the error in the JSON — it never reports value 0 with rc 0.
 
 The JSON also records which attention implementation actually served the
 decode steps (``attn_impl``) and the platform/device kind, so a silent
@@ -32,6 +35,8 @@ import subprocess
 import sys
 import time
 
+_START = time.monotonic()
+
 MODEL = os.environ.get("DYN_BENCH_MODEL", "llama-3-8b-lite")
 BATCH = int(os.environ.get("DYN_BENCH_BATCH", "32"))
 PROMPT_LEN = int(os.environ.get("DYN_BENCH_PROMPT", "128"))
@@ -45,12 +50,19 @@ DECODE_TOKENS = int(os.environ.get("DYN_BENCH_DECODE", "64"))
 # not supported: a silent CPU fallback would report a CPU number as the
 # official result.
 PLATFORM = os.environ.get("DYN_BENCH_PLATFORM") or os.environ.get("JAX_PLATFORMS")
-PROBE_TIMEOUT = float(os.environ.get("DYN_BENCH_PROBE_TIMEOUT", "900"))
-PROBE_RETRIES = int(os.environ.get("DYN_BENCH_PROBE_RETRIES", "3"))
+DEADLINE = float(os.environ.get("DYN_BENCH_DEADLINE", "540"))
+# Cold axon-tunnel inits have been observed >150s; 240s covers that while two
+# attempts still fit the default 540s deadline.
+PROBE_TIMEOUT = float(os.environ.get("DYN_BENCH_PROBE_TIMEOUT", "240"))
+PROBE_RETRIES = int(os.environ.get("DYN_BENCH_PROBE_RETRIES", "2"))
 HBM_BW = {"tpu v6": 1638e9, "tpu v5p": 2765e9, "tpu v5": 819e9,
           "tpu v4": 1228e9, "cpu": 50e9}
 
 METRIC = f"decode_throughput_{MODEL.replace('-', '_')}_bs{BATCH}"
+
+
+def remaining() -> float:
+    return DEADLINE - (time.monotonic() - _START)
 
 
 def _platform_env() -> dict:
@@ -75,19 +87,22 @@ def fail(stage: str, error: str) -> None:
 
 def probe_devices() -> None:
     """Initialize jax in a subprocess (a wedged TPU tunnel can't hang the
-    bench itself) with a long timeout and retries. Raises on failure."""
+    bench itself), bounded by the overall deadline. Raises on failure."""
     code = "import jax; d = jax.devices()[0]; print(d.platform, '|', getattr(d, 'device_kind', '?'))"
     env = dict(os.environ, **_platform_env())
     last = "no attempts made"
     for attempt in range(1, PROBE_RETRIES + 1):
+        budget = min(PROBE_TIMEOUT, remaining() - 30.0)
+        if budget <= 5.0:
+            raise RuntimeError(f"deadline exhausted before probe attempt {attempt}; last: {last}")
         t0 = time.monotonic()
         try:
             out = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True,
-                timeout=PROBE_TIMEOUT, text=True, env=env,
+                timeout=budget, text=True, env=env,
             )
         except subprocess.TimeoutExpired:
-            last = f"attempt {attempt}: device init timed out after {PROBE_TIMEOUT:.0f}s"
+            last = f"attempt {attempt}: device init timed out after {budget:.0f}s"
             print(last, file=sys.stderr)
             continue
         if out.returncode == 0:
@@ -97,11 +112,11 @@ def probe_devices() -> None:
         last = (f"attempt {attempt}: device init failed rc={out.returncode}: "
                 f"{out.stderr.strip()[-800:]}")
         print(last, file=sys.stderr)
-        time.sleep(min(10.0 * attempt, 30.0))
+        time.sleep(min(5.0 * attempt, 15.0))
     raise RuntimeError(f"device probe failed after {PROBE_RETRIES} attempts; last: {last}")
 
 
-def run_bench() -> dict:
+def run_bench(deadline_at: float) -> dict:
     import jax
 
     from dynamo_tpu.engine.engine import EngineCore
@@ -111,6 +126,9 @@ def run_bench() -> dict:
         StopConditions,
     )
     from dynamo_tpu.utils.config import EngineConfig
+
+    def left() -> float:
+        return deadline_at - time.monotonic()
 
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "cpu").lower()
@@ -133,15 +151,35 @@ def run_bench() -> dict:
             sampling_options=SamplingOptions(temperature=0.0),
         ))
 
-    # prefill + first decode step (includes both compiles)
-    while core.metrics.num_decode_tokens == 0 and core.has_work():
+    # prefill + first decode step (includes both compiles), deadline-bounded
+    # so a pathological compile still exits cleanly through the JSON contract
+    # instead of being SIGKILLed mid-dispatch by the parent.
+    while core.metrics.num_decode_tokens == 0 and core.has_work() and left() > 30.0:
         core.step()
     base_tokens = core.metrics.num_decode_tokens
+    if base_tokens == 0:
+        raise RuntimeError(
+            f"no decode step completed within the deadline ({DEADLINE:.0f}s)")
+    # Pipelined measurement loop — the production AsyncJaxEngine shape: plan
+    # and dispatch step N+1 before materializing step N, so the device never
+    # idles on host work. Break early (partial but valid measurement) if the
+    # deadline nears.
+    pending = None
     t0 = time.perf_counter()
-    while core.has_work():
-        core.step()
+    while (core.has_work() or pending is not None) and left() > 30.0:
+        nxt = core.step_begin() if core.has_work() else None
+        if pending is not None:
+            core.step_finalize(pending)
+        pending = nxt
+    if pending is not None:
+        core.step_finalize(pending)
     dt = time.perf_counter() - t0
     measured = core.metrics.num_decode_tokens - base_tokens
+    if measured == 0:
+        # Never report 0 tok/s as a "successful" run — the contract reserves
+        # value 0 for a device that truly served nothing, which is an error.
+        raise RuntimeError(
+            "deadline left no decode steps to measure after warm-up")
     tok_s = measured / dt if dt > 0 else 0.0
 
     # roofline
@@ -167,8 +205,9 @@ def main() -> None:
         # Child: env was set at spawn, so the PJRT plugin saw it at
         # interpreter start (setting JAX_PLATFORMS after startup is ignored —
         # the axon plugin configures jax programmatically via sitecustomize).
+        deadline_at = time.monotonic() + remaining()
         try:
-            result = run_bench()
+            result = run_bench(deadline_at)
         except Exception as exc:  # noqa: BLE001
             import traceback
             traceback.print_exc()
@@ -181,14 +220,26 @@ def main() -> None:
         probe_devices()
     except Exception as exc:  # noqa: BLE001 - converted to the JSON contract
         fail("device_probe", str(exc))
+    budget = remaining() - 15.0
+    if budget <= 30.0:
+        # Require real headroom: the child needs its 10s clean-exit margin
+        # below the parent kill timeout to actually mean something.
+        fail("bench_child", "deadline exhausted after device probe")
     env = dict(os.environ, **_platform_env(), _DYN_BENCH_CHILD="1")
+    # Child-side deadline sits inside the parent's kill timeout so the child
+    # exits cleanly (emitting its JSON) before the parent would SIGKILL it —
+    # killing a process mid-TPU-dispatch can wedge the device tunnel.
+    env["DYN_BENCH_DEADLINE"] = str(max(budget - 10.0, 10.0))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)], env=env, text=True,
-            capture_output=True, timeout=max(PROBE_TIMEOUT * 2, 1800),
+            capture_output=True, timeout=budget,
         )
     except subprocess.TimeoutExpired as exc:
-        sys.stderr.write((exc.stderr or b"").decode(errors="replace")[-4000:])
+        err = exc.stderr
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        sys.stderr.write((err or "")[-4000:])
         fail("bench_child", f"bench hung for {exc.timeout:.0f}s after a successful device probe")
         return
     sys.stderr.write(proc.stderr[-8000:])
