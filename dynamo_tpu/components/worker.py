@@ -39,6 +39,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--max-batch-size", type=int, default=32)
     p.add_argument("--max-model-len", type=int, default=8192)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--decode-window", type=int, default=1,
+                   help="decode steps fused per device dispatch")
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--speedup-ratio", type=float, default=10.0, help="mocker only")
     p.add_argument("--no-kv-events", action="store_true")
@@ -103,6 +105,7 @@ async def amain(ns: argparse.Namespace) -> None:
             max_batch_size=ns.max_batch_size,
             max_model_len=ns.max_model_len,
             tp=ns.tp,
+            decode_window=ns.decode_window,
             host_kv_blocks=ns.host_kv_blocks,
             disk_kv_path=ns.disk_kv_path,
         ), event_sink=sink))

@@ -29,6 +29,7 @@ if TYPE_CHECKING:
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from dynamo_tpu.engine.cache import KVCacheSpec, allocate_cache
 from dynamo_tpu.engine.prefix_pool import PrefixPool
@@ -211,12 +212,63 @@ class ModelRunner:
 
         return jax.jit(step, donate_argnums=(1, 2, 3, 4, 5))
 
-    def step_fn(self, b: int, t: int, nblk: int, sp_prefill: bool = False):
-        key = (b, t, nblk, sp_prefill)
+    def _build_window_fn(self, b: int, nblk: int, w: int):
+        """Fused decode window: ``w`` single-token steps in ONE compiled
+        dispatch, `lax.scan`-sequenced on device with each step's sampled
+        token feeding the next — zero host round trips inside the window.
+        This is the TPU answer to per-token dispatch latency (the reference's
+        engines decode step-by-step because their scheduler lives next to
+        the GPU; ours may sit a network tunnel away from the chip). Stop
+        conditions lag ≤ w-1 tokens; finalize discards overrun, so emitted
+        streams are bit-identical to w=1 (tests/test_engine.py windowed
+        equivalence tests)."""
+        cfg = self.cfg
+        trash_row = self.engine_cfg.max_batch_size
+        attn_impl = self.attn_impl
+        moe_impl = "ep" if self.engine_cfg.ep > 1 else "dense"
+        mesh = self.mesh
+
+        def step(params, ck, cv, counts, keys, slot_toks, tokens, q_start, q_len,
+                 bt, slots, temp, top_k, top_p, fp, pp, rp, do_sample, from_slot):
+            first = jnp.where(from_slot, slot_toks[slots], tokens[:, 0])
+            write_slots = jnp.where(do_sample, slots, trash_row)
+
+            def body(carry, j):
+                ck, cv, counts, keys, slot_toks, cur = carry
+                hidden, ck, cv = llama.forward(
+                    params, cfg, cur[:, None], q_start + j, q_len, bt, ck, cv,
+                    attn_impl=attn_impl, moe_impl=moe_impl, mesh=mesh)
+                logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
+                st = SamplingState(
+                    temperature=temp, top_k=top_k, top_p=top_p,
+                    frequency_penalty=fp, presence_penalty=pp,
+                    repetition_penalty=rp, keys=keys[slots],
+                    token_counts=counts[slots],
+                )
+                toks, lps, new_keys = sample(logits, st)
+                new_counts = record_tokens(st.token_counts, toks, do_sample)
+                counts = counts.at[write_slots].set(new_counts)
+                keys = keys.at[write_slots].set(new_keys)
+                slot_toks = slot_toks.at[write_slots].set(toks)
+                return (ck, cv, counts, keys, slot_toks, toks), (toks, lps)
+
+            (ck, cv, counts, keys, slot_toks, _), (toks_w, lps_w) = lax.scan(
+                body, (ck, cv, counts, keys, slot_toks, first),
+                jnp.arange(w, dtype=jnp.int32))
+            return ck, cv, counts, keys, slot_toks, toks_w.T, lps_w.T  # [B, W]
+
+        return jax.jit(step, donate_argnums=(1, 2, 3, 4, 5))
+
+    def step_fn(self, b: int, t: int, nblk: int, sp_prefill: bool = False,
+                window: int = 1):
+        key = (b, t, nblk, sp_prefill, window)
         if key not in self._step_fns:
-            log.info("compiling step fn B=%d T=%d NBLK=%d sp_prefill=%s",
-                     b, t, nblk, sp_prefill)
-            self._step_fns[key] = self._build_step_fn(b, t, nblk, sp_prefill)
+            log.info("compiling step fn B=%d T=%d NBLK=%d sp_prefill=%s W=%d",
+                     b, t, nblk, sp_prefill, window)
+            if window > 1:
+                self._step_fns[key] = self._build_window_fn(b, nblk, window)
+            else:
+                self._step_fns[key] = self._build_step_fn(b, t, nblk, sp_prefill)
         return self._step_fns[key]
 
     def reset_slot(self, slot: int, seed: int | None) -> None:
@@ -229,17 +281,22 @@ class ModelRunner:
         self,
         rows: list[tuple[Seq, int, int]],  # (seq, start, length) per row
         sample_rows: list[bool],
+        window: int = 1,
     ) -> tuple[jax.Array, jax.Array]:
         """Enqueue one bucketed step on the device WITHOUT blocking; returns
-        device arrays (tokens [B], logprobs [B]) still being computed. The
-        caller overlaps host work (scheduling, output assembly for earlier
-        steps) with the device, then materializes via ``np.asarray``."""
+        device arrays (tokens [B] or [B, window], logprobs likewise) still
+        being computed. The caller overlaps host work (scheduling, output
+        assembly for earlier steps) with the device, then materializes via
+        ``np.asarray``. ``window > 1`` (decode rows only) fuses that many
+        steps into the dispatch — the caller must have grown each seq's
+        block table to cover ``window`` more tokens."""
         ec = self.engine_cfg
         n = len(rows)
         t_max = max(length for _, _, length in rows)
         if t_max == 1:
             b, t = _bucket(n, ec.decode_bucket), 1
         else:
+            window = 1  # windows are a decode-dispatch concept
             b, t = _bucket(n, (1, 2, 4, 8)), _pow2_bucket(t_max, 16, ec.prefill_chunk)
         nblk_need = max(len(s.block_ids) for s, _, _ in rows)
         nblk = min(_pow2_bucket(max(nblk_need, 1), 4, self.max_nblk), self.max_nblk)
@@ -291,7 +348,7 @@ class ModelRunner:
             rp[i] = so.repetition_penalty or 1.0
             do_sample[i] = sample_rows[i]
 
-        fn = self.step_fn(b, t, nblk, sp_prefill)
+        fn = self.step_fn(b, t, nblk, sp_prefill, window)
         (self.cache_k, self.cache_v, self.counts, self.keys, self.slot_toks,
          toks, lps) = fn(
             self.params, self.cache_k, self.cache_v, self.counts, self.keys,
@@ -367,6 +424,7 @@ class EngineCore:
             prefill_chunk=engine_cfg.prefill_chunk,
             max_model_len=engine_cfg.max_model_len,
             max_tokens_per_step=engine_cfg.max_tokens_per_step,
+            decode_window=engine_cfg.decode_window,
         )
         self.metrics = EngineMetrics()
         self._seqs: dict[str, Seq] = {}
@@ -477,10 +535,10 @@ class EngineCore:
         # (decode first — see scheduler module docstring for why they are
         # not one padded batch).
         pending = PendingStep()
-        batches: list[tuple[str, list, list[bool]]] = []
+        batches: list[tuple[str, list, list[bool], int]] = []
         if plan.decode:
             rows = [(s, s.num_computed, 1) for s in plan.decode]
-            batches.append(("decode", rows, [True] * len(rows)))
+            batches.append(("decode", rows, [True] * len(rows), plan.decode_window))
         if plan.prefill:
             rows = [(w.seq, w.start, w.length) for w in plan.prefill]
             # Sample only on the chunk completing a *fresh* prompt; a
@@ -491,15 +549,16 @@ class EngineCore:
                 and len(w.seq.tokens) == w.seq.prompt_len
                 for w in plan.prefill
             ]
-            batches.append(("prefill", rows, sample_rows))
+            batches.append(("prefill", rows, sample_rows, 1))
 
-        for kind, rows, sample_rows in batches:
-            toks, lps = self.runner.dispatch(rows, sample_rows)
+        for kind, rows, sample_rows, window in batches:
+            toks, lps = self.runner.dispatch(rows, sample_rows, window=window)
             # Value-independent bookkeeping, done at dispatch so the next
             # plan() sees advanced positions. Token metrics count at
             # finalize, so discarded speculative rows don't inflate them.
+            advance = window if kind == "decode" else None
             for i, (seq, start, length) in enumerate(rows):
-                seq.num_computed = start + length
+                seq.num_computed = start + (advance or length)
                 if sample_rows[i]:
                     seq.inflight_samples += 1
             pending.batches.append((kind, rows, sample_rows, toks, lps))
@@ -512,34 +571,51 @@ class EngineCore:
         outputs: dict[str, LLMEngineOutput] = {}
         for kind, rows, sample_rows, toks_dev, lps_dev in pending.batches:
             n = len(rows)
-            toks = np.asarray(toks_dev)[:n]
-            lps = np.asarray(lps_dev)[:n]
+            # Normalize to [n, W]: single-step dispatches return [B], fused
+            # decode windows [B, W] — one finalize path serves both.
+            toks = np.asarray(toks_dev)[:n].reshape(n, -1)
+            lps = np.asarray(lps_dev)[:n].reshape(n, -1)
+            width = toks.shape[1]
             for i, (seq, start, length) in enumerate(rows):
                 if seq.phase is Phase.FINISHED:
                     # Finished (stop/abort) while this step was in flight:
                     # its speculative row is discarded.
                     continue
-                if kind == "decode":
-                    self.metrics.num_decode_tokens += 1
-                else:
+                if kind != "decode":
                     self.metrics.num_prefill_tokens += length
                 if sample_rows[i]:
                     seq.inflight_samples -= 1
-                # A seq preempted while in flight is WAITING with
-                # num_computed reset to 0 — commit is then a no-op, and the
-                # sampled token still belongs to the stream (resume only
-                # recomputes KV), so the normal path below is correct.
-                self.sched.commit_computed_blocks(seq)
                 if not sample_rows[i]:
-                    continue  # intermediate prefill chunk: no token emitted
-                token = int(toks[i])
-                seq.tokens.append(token)
-                seq.block_seq.append(token)
+                    # Intermediate prefill chunk: no token emitted. (A seq
+                    # preempted while in flight is WAITING with num_computed
+                    # reset to 0 — commit is then a no-op.)
+                    self.sched.commit_computed_blocks(seq)
+                    continue
+                # Append window tokens until a stop fires; the rest of the
+                # window is discarded (its KV lives in blocks this seq owns,
+                # freed at finish).
+                emitted: list[int] = []
+                reason = None
+                for j in range(width):
+                    token = int(toks[i, j])
+                    seq.tokens.append(token)
+                    seq.block_seq.append(token)
+                    emitted.append(token)
+                    reason = self._check_stop(seq, token)
+                    if reason is not None:
+                        break
+                if kind == "decode":
+                    self.metrics.num_decode_tokens += len(emitted)
+                self.sched.commit_computed_blocks(seq)
                 if seq.prefix_hit_blocks:
                     self.metrics.prefix_hit_blocks += seq.prefix_hit_blocks
                     seq.prefix_hit_blocks = 0
-                reason = self._check_stop(seq, token)
-                out = LLMEngineOutput(token_ids=[token], cum_log_probs=float(lps[i]))
+                per_tok = [float(x) for x in lps[i, : len(emitted)]]
+                out = LLMEngineOutput(
+                    token_ids=emitted,
+                    cum_log_probs=sum(per_tok),
+                    log_probs=per_tok,
+                )
                 if reason is not None:
                     out.finish_reason = reason
                     self.sched.finish(seq, reason)

@@ -100,6 +100,9 @@ class PrefillWork:
 class StepPlan:
     prefill: list[PrefillWork] = field(default_factory=list)
     decode: list[Seq] = field(default_factory=list)
+    # Decode steps fused into this dispatch (power of two). Every decode seq
+    # has blocks allocated for `decode_window` more tokens.
+    decode_window: int = 1
 
     @property
     def empty(self) -> bool:
@@ -114,12 +117,14 @@ class Scheduler:
         prefill_chunk: int,
         max_model_len: int,
         max_tokens_per_step: int = 8192,
+        decode_window: int = 1,
     ):
         self.pool = pool
         self.max_batch_size = max_batch_size
         self.prefill_chunk = prefill_chunk
         self.max_model_len = max_model_len
         self.max_tokens_per_step = max_tokens_per_step
+        self.decode_window = max(decode_window, 1)
         self.waiting: deque[Seq] = deque()
         self.running: list[Seq] = []
         self._slot_free: list[int] = list(range(max_batch_size - 1, -1, -1))
@@ -185,9 +190,10 @@ class Scheduler:
         self.running.append(seq)
         return True
 
-    def _grow_for_decode(self, seq: Seq) -> bool:
-        """Ensure block capacity for one more token; False if allocation failed."""
-        need = seq.blocks_needed(seq.num_computed + 1)
+    def _grow_for_decode(self, seq: Seq, tokens_ahead: int = 1) -> bool:
+        """Ensure block capacity for `tokens_ahead` more tokens; False if
+        allocation failed."""
+        need = seq.blocks_needed(seq.num_computed + tokens_ahead)
         if need > len(seq.block_ids):
             try:
                 seq.block_ids.extend(self.pool.allocate(need - len(seq.block_ids)))
@@ -234,6 +240,24 @@ class Scheduler:
 
         # Decode batch first (every decodable stream advances every step);
         # grow blocks, preempting from the back on pressure.
+        # Window: fuse up to decode_window steps into one dispatch. Shrink to
+        # (a) fit every seq under max_model_len (the block table must cover
+        # every fused position) and (b) the useful horizon — past the point
+        # every stream will have hit max_tokens, fused steps are pure waste
+        # (their tokens are discarded at finalize).
+        cands = [s for s in self.running
+                 if s.in_decode and s.num_computed < self.max_model_len]
+        w = self.decode_window
+        if w > 1 and cands:
+            cap = min(self.max_model_len - s.num_computed for s in cands)
+            useful = 1
+            for s in cands:
+                mt = s.req.stop_conditions.max_tokens
+                # decode positions already computed (incl. in-flight windows)
+                out_est = max(s.num_computed - s.prefill_target(), 0)
+                useful = max(useful, (mt - out_est) if mt is not None else cap)
+            w = max(1, min(w, cap, useful))
+            w = 1 << (w.bit_length() - 1)  # pow2 bucket bounds compile count
         decodable: list[Seq] = []
         for seq in list(self.running):
             if not seq.in_decode:
@@ -243,7 +267,7 @@ class Scheduler:
                 # this seq (pipelined stepping plans ahead of stop checks);
                 # decoding past max_model_len would outgrow the block table.
                 continue
-            while not self._grow_for_decode(seq):
+            while not self._grow_for_decode(seq, w):
                 # preempt the most recently admitted other seq
                 victims = [s for s in reversed(self.running) if s is not seq]
                 if not victims:
@@ -258,10 +282,12 @@ class Scheduler:
             # could not grow even after preemption: preempt seq itself
             self.preempt(seq)
         plan.decode = decodable[: self.max_batch_size]
+        plan.decode_window = w if plan.decode else 1
 
         # Prefill chunks for seqs short of their target, within what's left
-        # of the step token budget after the decode rows.
-        budget = self.max_tokens_per_step - len(plan.decode)
+        # of the step token budget after the decode rows (a fused window
+        # computes window tokens per row).
+        budget = self.max_tokens_per_step - len(plan.decode) * plan.decode_window
         for seq in self.running:
             target = seq.prefill_target()
             if seq.num_computed < target and budget > 0:

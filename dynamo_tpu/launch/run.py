@@ -54,6 +54,9 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--max-tokens", type=int, default=256, help="default max output tokens")
     p.add_argument("--input-jsonl", default=None)
+    p.add_argument("--decode-window", type=int, default=1,
+                   help="decode steps fused per device dispatch (stop checks "
+                        "lag by up to window-1 tokens; output is unchanged)")
     p.add_argument("--host-kv-blocks", type=int, default=0, help="G2 host KV tier capacity")
     p.add_argument("--disk-kv-path", default=None, help="G3 disk KV tier directory")
     p.add_argument("--tool-call-parser", default=None,
@@ -73,6 +76,7 @@ def build_local_engine(ns: argparse.Namespace) -> tuple[AsyncJaxEngine, EngineCo
         block_size=ns.block_size,
         num_blocks=ns.num_blocks,
         tp=ns.tp,
+        decode_window=ns.decode_window,
         host_kv_blocks=ns.host_kv_blocks,
         disk_kv_path=ns.disk_kv_path,
     )

@@ -111,6 +111,13 @@ class EngineConfig:
     # Attention implementation: "auto" (pallas on TPU, dense elsewhere),
     # "dense", "pallas", or "pallas_interpret" (CPU-testable kernel path).
     attn_impl: str = "auto"
+    # Fused decode window: run up to this many decode steps inside ONE
+    # compiled dispatch (lax.scan on device, sampled tokens feeding back
+    # without touching the host). Amortizes the per-dispatch host round
+    # trip — the dominant decode cost when the host is far from the chip.
+    # Stop conditions lag by at most window-1 tokens; overrun is discarded
+    # at finalize, so emitted streams are bit-identical to window=1.
+    decode_window: int = 1
 
     def mesh_shape(self) -> dict[str, int]:
         return {"data": self.dp, "model": self.tp, "expert": self.ep, "seq": self.sp}

@@ -286,3 +286,82 @@ def test_pipelined_mid_flight_abort():
     outs = core.step_finalize(pending)
     assert "victim" not in outs
     assert not core.has_work()
+
+
+# ---------------------------------------------------------------------------
+# Fused decode windows (decode_window > 1): emitted streams must be
+# bit-identical to single-step decoding — stop-condition lag and window
+# overrun are invisible to the client.
+# ---------------------------------------------------------------------------
+
+def _stream_pair(cfg_kw_a, cfg_kw_b, reqs_fn, pipelined=False):
+    reqs_a = reqs_fn("a")
+    core_a = EngineCore(tiny_config(**cfg_kw_a))
+    got_a, fin_a = run_to_completion(core_a, reqs_a)
+    reqs_b = reqs_fn("b")
+    core_b = EngineCore(tiny_config(**cfg_kw_b))
+    runner = run_pipelined if pipelined else run_to_completion
+    got_b, fin_b = runner(core_b, reqs_b)
+    assert len(fin_a) == len(reqs_a) and len(fin_b) == len(reqs_b)
+    return got_a, got_b
+
+
+def test_windowed_matches_sync_greedy():
+    def reqs(tag):
+        return [make_req(prompt=[3 * i + j for j in range(5 + i)],
+                         max_tokens=6 + 2 * i, rid=f"{tag}{i}") for i in range(4)]
+
+    got_a, got_b = _stream_pair({}, {"decode_window": 4}, reqs)
+    for i in range(4):
+        assert got_b[f"b{i}"] == got_a[f"a{i}"], f"stream {i} diverged"
+        assert len(got_b[f"b{i}"]) == 6 + 2 * i  # overrun discarded
+
+
+def test_windowed_sampled_reproducible():
+    """Seeded sampling with penalties advances per-slot PRNG keys once per
+    token in both modes — windowed must reproduce the sync stream."""
+    def reqs(tag):
+        return [make_req(prompt=[7 * i + j for j in range(6)], max_tokens=10,
+                         temperature=0.8, seed=42 + i,
+                         frequency_penalty=0.3, rid=f"{tag}{i}")
+                for i in range(3)]
+
+    got_a, got_b = _stream_pair({}, {"decode_window": 4}, reqs)
+    for i in range(3):
+        assert got_b[f"b{i}"] == got_a[f"a{i}"], f"stream {i} diverged"
+
+
+def test_windowed_pipelined_matches_sync():
+    """Window + one-step-in-flight pipelining (the production loop shape)."""
+    def reqs(tag):
+        return [make_req(prompt=[5 * i + j for j in range(4 + i)],
+                         max_tokens=7 + i, rid=f"{tag}{i}") for i in range(3)]
+
+    got_a, got_b = _stream_pair({}, {"decode_window": 4}, reqs, pipelined=True)
+    for i in range(3):
+        assert got_b[f"b{i}"] == got_a[f"a{i}"], f"stream {i} diverged"
+
+
+def test_windowed_under_block_pressure():
+    """A pool small enough to force preemption still converges to the same
+    streams: windowed growth (w blocks ahead) preempts and resumes cleanly."""
+    def reqs(tag):
+        return [make_req(prompt=[11 * i + j for j in range(8)], max_tokens=12,
+                         rid=f"{tag}{i}") for i in range(4)]
+
+    # 24 usable blocks: 4 seqs * (8 prompt + 12 out + window slack)/4 > pool
+    got_a, got_b = _stream_pair({"num_blocks": 25}, {"num_blocks": 25, "decode_window": 4}, reqs)
+    for i in range(4):
+        assert got_b[f"b{i}"] == got_a[f"a{i}"], f"stream {i} diverged"
+
+
+def test_windowed_max_model_len_cap():
+    """Windows shrink so the block table never outgrows max_model_len."""
+    def reqs(tag):
+        return [make_req(prompt=list(range(10, 22)), max_tokens=64, rid=f"{tag}0")]
+
+    # max_model_len 20 caps output at 8 tokens; window 8 must shrink near cap
+    kw = dict(max_model_len=20, num_blocks=16)
+    got_a, got_b = _stream_pair(kw, {**kw, "decode_window": 8}, reqs)
+    assert got_b["b0"] == got_a["a0"]
+    assert len(got_b["b0"]) == 20 - 12
