@@ -52,6 +52,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="decode mode: where the prefill pool lives")
     p.add_argument("--min-prefill-blocks", type=int, default=2,
                    help="decode mode: prompt blocks below which prefill stays local")
+    # Multi-host engine (reference: lib/llm/src/engines.rs:29-44 MultiNodeConfig).
+    p.add_argument("--num-nodes", type=int, default=1,
+                   help="processes forming ONE SPMD engine (1 = single-host)")
+    p.add_argument("--node-rank", type=int, default=0)
+    p.add_argument("--leader-addr", default=None,
+                   help="host:port of the rank-0 jax coordinator; followers "
+                        "default to resolving it via the coordination service")
     return p.parse_args(argv)
 
 
@@ -73,6 +80,85 @@ async def amain(ns: argparse.Namespace) -> None:
     cfg = RuntimeConfig.from_settings(coordinator_url=ns.coordinator)
     rt = await DistributedRuntime.create(cfg)
     assert rt.client is not None and rt.primary_lease is not None
+
+    # Multi-host SPMD engine: all ranks join one jax.distributed group and
+    # form ONE global mesh; rank 0 serves, others replay its op stream
+    # (reference: MultiNodeConfig, lib/llm/src/engines.rs:29-44).
+    op_channel = None
+    if ns.num_nodes > 1:
+        if ns.engine != "jax":
+            raise SystemExit("--num-nodes > 1 requires --engine jax")
+        if ns.disagg != "none" or ns.host_kv_blocks or ns.disk_kv_path:
+            raise SystemExit("multi-host engines do not yet support disagg/KVBM tiers")
+        from dynamo_tpu.parallel import multihost as mh
+
+        group = f"{ns.namespace}.{ns.component}"
+        leader_addr = ns.leader_addr
+        op_port = 0
+        loop = asyncio.get_running_loop()
+        if ns.node_rank == 0:
+            # Bind the op channel FIRST (port 0 → OS-assigned and owned from
+            # here on); only the jax coordinator port keeps a small
+            # bind-probe window, since jax itself binds it later.
+            op_channel = mh.LeaderOpChannel(0, ns.num_nodes - 1)
+            op_port = op_channel.port
+            if not leader_addr:
+                import socket as _socket
+
+                host = rt.advertise_address.rsplit(":", 1)[0]
+                with _socket.socket() as s:
+                    s.bind(("", 0))
+                    leader_addr = f"{host}:{s.getsockname()[1]}"
+            await mh.publish_leader_addr(rt.client, group, leader_addr,
+                                         op_port, rt.primary_lease.id)
+        elif not leader_addr:
+            leader_addr, op_port = await mh.resolve_leader_addr(rt.client, group)
+        else:
+            # Explicit --leader-addr on a follower: the op port is still the
+            # leader's OS-assigned one — fetch it from the published record
+            # (falling back to the port+1 convention if nothing is there).
+            try:
+                _, op_port = await mh.resolve_leader_addr(rt.client, group,
+                                                          timeout=30.0)
+            except TimeoutError:
+                op_port = 0
+        mncfg = mh.MultiNodeConfig(ns.num_nodes, ns.node_rank, leader_addr,
+                                   op_port=op_port)
+        # Blocks until every rank joins the group.
+        await loop.run_in_executor(None, mh.initialize_distributed, mncfg)
+
+        if ns.node_rank != 0:
+            # Follower: build the engine from the leader's hello, replay its
+            # op stream until it disconnects. No endpoint, no model card, no
+            # publishers — followers are invisible to routing.
+            from dynamo_tpu.engine.engine import EngineCore
+
+            host, port = leader_addr.rsplit(":", 1)[0], mncfg.resolved_op_port()
+            sock = await loop.run_in_executor(None, mh.connect_to_leader, host, port)
+
+            def core_factory(hello: dict) -> EngineCore:
+                return EngineCore(EngineConfig(
+                    model=hello["model"], num_blocks=hello["num_blocks"],
+                    block_size=hello["block_size"],
+                    max_batch_size=hello["max_batch_size"],
+                    max_model_len=hello["max_model_len"],
+                    prefill_chunk=hello["prefill_chunk"],
+                    max_tokens_per_step=hello["max_tokens_per_step"],
+                    decode_bucket=tuple(hello["decode_bucket"]),
+                    decode_window=hello["decode_window"],
+                    seed=hello["seed"],
+                    enable_prefix_caching=hello["enable_prefix_caching"],
+                    dp=hello["dp"], tp=hello["tp"],
+                    ep=hello["ep"], sp=hello["sp"],
+                ))
+
+            log.info("follower rank %d replaying leader op stream", ns.node_rank)
+            print(f"FOLLOWER_READY rank={ns.node_rank}", flush=True)
+            await loop.run_in_executor(None, mh.follower_loop, core_factory, sock)
+            await rt.shutdown()
+            return
+
+        await loop.run_in_executor(None, op_channel.accept_followers)
 
     publisher = None
     if not ns.no_kv_events:
@@ -108,8 +194,20 @@ async def amain(ns: argparse.Namespace) -> None:
             decode_window=ns.decode_window,
             host_kv_blocks=ns.host_kv_blocks,
             disk_kv_path=ns.disk_kv_path,
-        ), event_sink=sink))
+        ), event_sink=sink,
+            op_sink=op_channel.broadcast if op_channel is not None else None))
         stats_fn = engine.stats
+        if op_channel is not None:
+            # Ship the leader-resolved engine essentials (num_blocks above
+            # all) so follower schedulers can never diverge on capacity.
+            import dataclasses as _dc
+
+            from dynamo_tpu.parallel import multihost as mh
+
+            resolved = _dc.replace(engine.core.engine_cfg,
+                                   num_blocks=engine.core.runner.spec.num_blocks)
+            op_channel.broadcast(mh.leader_hello(resolved))
+            await loop.run_in_executor(None, op_channel.wait_ready)
 
     if ns.disagg != "none" and ns.engine != "jax":
         raise SystemExit("--disagg requires --engine jax (KV handoff needs a real cache)")
@@ -179,6 +277,8 @@ async def amain(ns: argparse.Namespace) -> None:
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     log.info("worker draining")
+    if op_channel is not None:
+        op_channel.close()  # followers see EOF and drain
     await metrics_pub.stop()
     if kv_source is not None:
         await kv_source.stop()

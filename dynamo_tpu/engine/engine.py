@@ -110,6 +110,14 @@ class ModelRunner:
         self.cfg = cfg
         self.engine_cfg = engine_cfg
         self.mesh = mesh
+        # Replicated placement for host-built step inputs and sampling state.
+        # On a mesh this makes every array an explicit global array — required
+        # under multi-host jax (each process holds the full replicated value),
+        # and a no-op-equivalent on one host.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        self._repl = (NamedSharding(mesh, PartitionSpec())
+                      if mesh is not None else None)
         key = jax.random.key(rng_seed)
         if params is not None:
             self.params = params
@@ -130,6 +138,19 @@ class ModelRunner:
                         "RANDOM weights (convert the checkpoint to "
                         "safetensors to load it)", engine_cfg.model)
                 self.params = llama.init_params(cfg, key)
+        if mesh is not None:
+            # Explicitly place params per their logical-axis rules: on one
+            # host this pins the TP/EP layout (instead of leaving GSPMD to
+            # re-shard uncommitted arrays per bucket); on multi-host it is
+            # mandatory — every process must contribute its shard of the
+            # global param arrays. Random init is seed-deterministic, so all
+            # processes hold identical host values to shard from. Leaves the
+            # loader already placed pass through untouched (global_put
+            # returns correctly-sharded arrays as-is).
+            from dynamo_tpu.parallel.mesh import shard_params
+
+            self.params = shard_params(
+                self.params, llama.param_logical_axes(cfg), mesh)
         num_blocks = engine_cfg.num_blocks or self._auto_num_blocks()
         self.spec = KVCacheSpec.for_model(cfg, num_blocks, engine_cfg.block_size)
         self.cache_k, self.cache_v = allocate_cache(self.spec, mesh)
@@ -137,13 +158,13 @@ class ModelRunner:
         # Row maxb is the trash row: padding/non-sampling rows write their
         # sampling-state updates there so real slots are never clobbered by
         # duplicate scatter indices and PRNG keys only advance on real samples.
-        self.counts = jnp.zeros((maxb + 1, cfg.vocab_size), jnp.int32)
+        self.counts = self._place(jnp.zeros((maxb + 1, cfg.vocab_size), jnp.int32))
         base = jax.random.split(jax.random.key(engine_cfg.seed), maxb + 1)
-        self.keys = jax.vmap(jax.random.key_data)(base).astype(jnp.uint32)
+        self.keys = self._place(jax.vmap(jax.random.key_data)(base).astype(jnp.uint32))
         # Per-slot latest sampled token, ON DEVICE: lets the next decode step
         # consume this step's token without a host round-trip — the core of
         # the pipelined (host/device-overlapped) step loop. Row maxb = trash.
-        self.slot_toks = jnp.zeros((maxb + 1,), jnp.int32)
+        self.slot_toks = self._place(jnp.zeros((maxb + 1,), jnp.int32))
         self._step_fns: dict[tuple[int, int, int], Callable] = {}
         self.max_nblk = -(-engine_cfg.max_model_len // engine_cfg.block_size)
         from dynamo_tpu.ops.paged_attention import select_attn_impl
@@ -156,6 +177,14 @@ class ModelRunner:
                 "num_kv_heads=%d does not divide tp=%d: pallas attention will "
                 "fall back to the dense gather path", cfg.num_kv_heads,
                 mesh.shape["model"])
+
+    def _place(self, x):
+        """Replicate onto the mesh (global array) or leave as-is off-mesh."""
+        if self._repl is None:
+            return jnp.asarray(x)
+        from dynamo_tpu.parallel.mesh import global_put
+
+        return global_put(x, self._repl)
 
     def _auto_num_blocks(self) -> int:
         """Size the device KV pool from free memory (TPU) or a small default."""
@@ -210,7 +239,23 @@ class ModelRunner:
             slot_toks = slot_toks.at[write_slots].set(toks)
             return ck, cv, counts, keys, slot_toks, toks, lps
 
-        return jax.jit(step, donate_argnums=(1, 2, 3, 4, 5))
+        return jax.jit(step, donate_argnums=(1, 2, 3, 4, 5),
+                       **self._jit_shardings())
+
+    def _jit_shardings(self) -> dict:
+        """Pin step-output shardings on a mesh: cache keeps its TP layout;
+        sampling state and sampled tokens come back fully replicated so the
+        host can materialize them on EVERY process (multi-host finalize) and
+        the next dispatch feeds them straight back without resharding."""
+        if self.mesh is None:
+            return {}
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dynamo_tpu.parallel.mesh import kv_cache_spec
+
+        repl = NamedSharding(self.mesh, P())
+        cache = NamedSharding(self.mesh, kv_cache_spec())
+        return {"out_shardings": (cache, cache, repl, repl, repl, repl, repl)}
 
     def _build_window_fn(self, b: int, nblk: int, w: int):
         """Fused decode window: ``w`` single-token steps in ONE compiled
@@ -257,7 +302,8 @@ class ModelRunner:
                 jnp.arange(w, dtype=jnp.int32))
             return ck, cv, counts, keys, slot_toks, toks_w.T, lps_w.T  # [B, W]
 
-        return jax.jit(step, donate_argnums=(1, 2, 3, 4, 5))
+        return jax.jit(step, donate_argnums=(1, 2, 3, 4, 5),
+                       **self._jit_shardings())
 
     def step_fn(self, b: int, t: int, nblk: int, sp_prefill: bool = False,
                 window: int = 1):
@@ -349,15 +395,16 @@ class ModelRunner:
             do_sample[i] = sample_rows[i]
 
         fn = self.step_fn(b, t, nblk, sp_prefill, window)
+        place = self._place
         (self.cache_k, self.cache_v, self.counts, self.keys, self.slot_toks,
          toks, lps) = fn(
             self.params, self.cache_k, self.cache_v, self.counts, self.keys,
             self.slot_toks,
-            jnp.asarray(tokens), jnp.asarray(q_start), jnp.asarray(q_len),
-            jnp.asarray(bt), jnp.asarray(slots), jnp.asarray(temp),
-            jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(fp),
-            jnp.asarray(pp), jnp.asarray(rp), jnp.asarray(do_sample),
-            jnp.asarray(from_slot),
+            place(tokens), place(q_start), place(q_len),
+            place(bt), place(slots), place(temp),
+            place(top_k), place(top_p), place(fp),
+            place(pp), place(rp), place(do_sample),
+            place(from_slot),
         )
         return toks, lps
 
@@ -688,6 +735,11 @@ class EngineCore:
         return rids
 
 
+class OpChannelDown(RuntimeError):
+    """The multi-host op broadcast channel failed — the engine cannot
+    continue (a rank's devices would be missing from every collective)."""
+
+
 class AsyncJaxEngine:
     """Async facade: background step-loop thread + asyncio output streams.
 
@@ -695,8 +747,13 @@ class AsyncJaxEngine:
     of vLLM's AsyncLLM under the reference (components/src/dynamo/vllm/
     handlers.py generate())."""
 
-    def __init__(self, core: EngineCore):
+    def __init__(self, core: EngineCore, op_sink: Callable[[dict], None] | None = None):
         self.core = core
+        # Multi-host leader hook (parallel/multihost.py): every state-
+        # changing op is broadcast to follower ranks BEFORE being applied
+        # locally, so their engine state machines replay identically.
+        self._op_sink = op_sink
+        self._channel_down = False
         self._loop: asyncio.AbstractEventLoop | None = None
         self._inbox: thread_queue.Queue = thread_queue.Queue()
         self._streams: dict[str, asyncio.Queue] = {}
@@ -717,6 +774,20 @@ class AsyncJaxEngine:
         if self._started:
             await asyncio.get_running_loop().run_in_executor(None, self._thread.join, 5.0)
 
+    def _emit_op(self, op: dict) -> None:
+        """Broadcast one op to follower ranks; a failed broadcast is fatal
+        for the whole multi-host engine (its devices leave the collective
+        group), so stop the loop and surface OpChannelDown."""
+        if self._op_sink is None:
+            return
+        try:
+            self._op_sink(op)
+        except Exception as exc:
+            log.exception("op-channel broadcast failed; stopping engine loop")
+            self._channel_down = True
+            self._stop = True
+            raise OpChannelDown(str(exc)) from exc
+
     # ------------------------------------------------------------------
     def _run(self) -> None:
         # Pipelined step loop: keep ONE step in flight. Each iteration plans
@@ -734,12 +805,33 @@ class AsyncJaxEngine:
                     break
                 moved = True
                 if kind == "add":
+                    try:
+                        self._emit_op({"op": "add", "req": payload.to_dict()})
+                    except OpChannelDown as exc:
+                        self._post(payload.request_id, LLMEngineOutput(
+                            finish_reason=FinishReason.ERROR, error=str(exc)))
+                        break
                     err = self.core.add_request(payload)
                     if err is not None:
                         self._post(payload.request_id, err)
                 elif kind == "abort":
+                    try:
+                        self._emit_op({"op": "abort", "rid": payload})
+                    except OpChannelDown:
+                        break  # _stop is set; streams fail below
                     self.core.abort(payload)
                     self._post(payload, LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
+                elif kind == "exec" and self._op_sink is not None:
+                    # Disagg/KVBM core access mutates device state outside
+                    # the replicated op stream — running it would desync the
+                    # followers' SPMD programs. Refuse loudly.
+                    fn, fut, fut_loop = payload
+                    exc = RuntimeError(
+                        "run_in_core is not supported on a multi-host leader")
+                    try:
+                        fut_loop.call_soon_threadsafe(self._resolve, fut, None, exc)
+                    except RuntimeError:
+                        pass
                 elif kind == "exec":
                     # Arbitrary core access (KV export/import/pin for disagg)
                     # marshaled onto this thread — the only thread allowed to
@@ -758,12 +850,24 @@ class AsyncJaxEngine:
                         # cancelled asyncio.run): the future's owner is gone;
                         # dropping the result must not kill this thread.
                         log.warning("exec result dropped: caller loop closed")
+            if self._channel_down:
+                # Op channel died mid-drain: fail everything in flight
+                # (checked before the idle-continue so an idle engine still
+                # reports the failure to its streams).
+                self.core.fail_all("multi-host op channel down")
+                for rid in list(self._streams):
+                    self._post(rid, LLMEngineOutput(
+                        finish_reason=FinishReason.ERROR,
+                        error="multi-host op channel down"))
+                break
             if not self.core.has_work() and pending is None:
                 if not moved:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                 continue
             try:
+                if self.core.has_work() or pending is not None:
+                    self._emit_op({"op": "step"})
                 nxt = self.core.step_begin() if self.core.has_work() else None
                 if pending is not None:
                     outputs = self.core.step_finalize(pending)
@@ -776,6 +880,14 @@ class AsyncJaxEngine:
                 log.exception("engine step failed; failing all in-flight requests")
                 pending = None
                 self.core.fail_all(str(exc))
+                if self._op_sink is not None and not isinstance(exc, OpChannelDown):
+                    # Followers must mirror the wipe or their replayed state
+                    # machines diverge from ours. (If the channel itself died,
+                    # _stop is already set and there is no one to tell.)
+                    try:
+                        self._emit_op({"op": "fail_all", "error": str(exc)})
+                    except OpChannelDown:
+                        pass
                 for rid in list(self._streams):
                     self._post(rid, LLMEngineOutput(finish_reason=FinishReason.ERROR, error=str(exc)))
                 continue
@@ -832,6 +944,6 @@ class AsyncJaxEngine:
 
 
 def build_engine(engine_cfg: EngineConfig, mesh=None, params=None,
-                 event_sink=None) -> AsyncJaxEngine:
+                 event_sink=None, op_sink=None) -> AsyncJaxEngine:
     core = EngineCore(engine_cfg, mesh=mesh, params=params, event_sink=event_sink)
-    return AsyncJaxEngine(core)
+    return AsyncJaxEngine(core, op_sink=op_sink)

@@ -311,7 +311,9 @@ def load_params(
             node = node.setdefault(key, {})
         leaf_axes = leaf_axes[path[-1]]
         if mesh is not None:
-            placed = jax.device_put(arr, param_sharding_rules(mesh, leaf_axes))
+            from dynamo_tpu.parallel.mesh import global_put
+
+            placed = global_put(arr, param_sharding_rules(mesh, leaf_axes))
         else:
             placed = jnp.asarray(arr)
         node[path[-1]] = placed

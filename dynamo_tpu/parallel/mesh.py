@@ -83,6 +83,33 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def global_put(x, sharding: NamedSharding):
+    """Build a (possibly cross-process) global array from host data.
+
+    ``jax.device_put`` to a non-fully-addressable sharding internally runs a
+    ``process_allgather`` to verify every rank passed an equivalent sharding
+    — a hidden COLLECTIVE, so ranks that reach it at different times (e.g.
+    the multi-host leader sharding params while followers still await the
+    hello frame) deadlock. ``make_array_from_callback`` assembles the global
+    array purely from local shards, no rendezvous; callers guarantee every
+    rank holds the same host value (deterministic init / identical
+    checkpoint), which is the same contract device_put documents.
+    """
+    import jax
+
+    if isinstance(x, jax.Array) and x.sharding == sharding:
+        return x  # already placed (e.g. loader-sharded checkpoint leaves)
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        raise ValueError(
+            "global_put cannot re-shard a multi-host array to a different "
+            f"layout (have {x.sharding}, want {sharding}); produce the host "
+            "value on every rank instead")
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+
 def shard_params(params, logical_axes, mesh: Mesh):
     """Place a params pytree on the mesh per its logical-axis annotations.
 
@@ -93,7 +120,7 @@ def shard_params(params, logical_axes, mesh: Mesh):
     import jax
 
     def place(leaf, axes):
-        return jax.device_put(leaf, param_sharding_rules(mesh, axes))
+        return global_put(leaf, param_sharding_rules(mesh, axes))
 
     return jax.tree.map(place, params, logical_axes, is_leaf=lambda x: isinstance(x, tuple) and all(
         isinstance(a, (str, type(None))) for a in x))

@@ -1,0 +1,331 @@
+"""Multi-host engine bring-up: one SPMD engine spanning N processes.
+
+Fills the role of the reference's multi-node engine configuration
+(reference: lib/llm/src/engines.rs:29-44 — ``MultiNodeConfig { num_nodes,
+node_rank, leader_addr }``; the sglang slurm launch pattern,
+components/backends/sglang/slurm_jobs/) — the JAX way:
+
+- Every rank calls :func:`initialize_distributed`
+  (``jax.distributed.initialize``), after which ``jax.devices()`` is the
+  GLOBAL device set and one :class:`~dynamo_tpu.parallel.mesh.MeshConfig`
+  mesh spans all hosts. Collectives ride ICI within a slice and DCN across
+  slices — inserted by XLA, never hand-written.
+- Multi-controller JAX requires every process to execute the *same program
+  sequence with the same shapes*. The engine's host-side state machine
+  (scheduler, prefix pool, sampling seeds) is deterministic given the same
+  request/abort stream, so the **leader** (rank 0) serves the endpoint and
+  broadcasts every state-changing op — ``add``, ``abort``, ``step`` — over
+  a framed TCP op channel *before* applying it locally. **Followers**
+  replay the identical op stream, reach identical dispatch decisions, and
+  execute the identical XLA programs, which lines the collectives up.
+- The leader's resolved engine essentials (num_blocks above all — it may be
+  auto-sized from device memory, which can differ per host) ship in the
+  ``hello`` frame; followers construct their EngineCore from it, so the
+  schedulers can never diverge on capacity.
+
+Leader discovery mirrors the reference's etcd pattern: rank 0 publishes
+``leader_addr`` under the coordination service; other ranks poll for it
+(:func:`publish_leader_addr` / :func:`resolve_leader_addr`).
+
+Scope: aggregated serving. Disagg KV export/import and KVBM host tiers are
+single-host features today — ``run_in_core`` exec ops are refused on a
+multi-host leader rather than silently desyncing the followers.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import msgpack
+
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("multihost")
+
+LEADER_KEY_FMT = "multinode/{group}/leader"
+# The op channel listens one port above the jax coordinator by convention.
+OP_PORT_OFFSET = 1
+
+
+@dataclass(frozen=True)
+class MultiNodeConfig:
+    """Analog of the reference's MultiNodeConfig (engines.rs:29-44)."""
+
+    num_nodes: int = 1
+    node_rank: int = 0
+    # host:port of the rank-0 jax distributed coordinator.
+    leader_addr: str = ""
+    # Op-channel port (0 = coordinator port + OP_PORT_OFFSET).
+    op_port: int = 0
+
+    @property
+    def is_leader(self) -> bool:
+        return self.node_rank == 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.num_nodes > 1
+
+    def resolved_op_port(self) -> int:
+        if self.op_port:
+            return self.op_port
+        return int(self.leader_addr.rsplit(":", 1)[1]) + OP_PORT_OFFSET
+
+
+def initialize_distributed(mn: MultiNodeConfig) -> None:
+    """``jax.distributed.initialize`` with the MultiNodeConfig; call ONCE
+    per process, before any other jax use."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=mn.leader_addr,
+        num_processes=mn.num_nodes,
+        process_id=mn.node_rank,
+    )
+    log.info("jax.distributed up: rank %d/%d, %d global devices",
+             mn.node_rank, mn.num_nodes, len(jax.devices()))
+    # Establish the cross-process collective context NOW, while every rank
+    # is still in lockstep from the init barrier. The backend's context
+    # creation (Gloo on CPU) is a rendezvous with a short timeout; deferring
+    # it to the engine's first real collective means uneven EngineCore
+    # build/compile times can blow the window (observed: 30s GetKeyValue
+    # timeout on the leader while the follower was still compiling).
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    warm_mesh = Mesh(np.array(devs), ("all",))
+    x = jax.device_put(jnp.ones((len(devs),), jnp.float32),
+                       NamedSharding(warm_mesh, P("all")))
+    total = float(jnp.sum(x).block_until_ready())  # all-reduce across ranks
+    assert total == float(len(devs)), f"collective warmup wrong: {total}"
+    log.info("cross-process collective context established (%d devices)", len(devs))
+
+
+# ---------------------------------------------------------------------------
+# Leader discovery over the coordination service
+# ---------------------------------------------------------------------------
+
+async def publish_leader_addr(client, group: str, leader_addr: str,
+                              op_port: int = 0, lease_id: int = 0) -> None:
+    """Rank 0: advertise the jax coordinator address AND the (already-bound)
+    op-channel port (etcd-pattern analog of the reference's leader bootstrap,
+    lib/runtime/src/utils/leader_worker_barrier.rs). Publishing the real
+    bound op port — instead of a port+1 convention — removes the race where
+    an unrelated process grabs the conventional port between bind attempts."""
+    import json
+
+    payload = json.dumps({"leader_addr": leader_addr, "op_port": op_port})
+    await client.put(LEADER_KEY_FMT.format(group=group), payload.encode(), lease_id)
+
+
+async def resolve_leader_addr(client, group: str, timeout: float = 60.0) -> tuple[str, int]:
+    """Ranks > 0: poll the coordination service for (leader_addr, op_port)."""
+    import json
+
+    deadline = time.monotonic() + timeout
+    key = LEADER_KEY_FMT.format(group=group)
+    while time.monotonic() < deadline:
+        val = await client.get(key)
+        if val:
+            obj = json.loads(val.decode())
+            return obj["leader_addr"], int(obj.get("op_port", 0))
+        import asyncio
+
+        await asyncio.sleep(0.2)
+    raise TimeoutError(f"no leader address published at {key} within {timeout}s")
+
+
+# ---------------------------------------------------------------------------
+# Sync framed sockets (the engine-core thread is synchronous; these are the
+# blocking cousins of transports/wire.py's asyncio codec, same framing)
+# ---------------------------------------------------------------------------
+
+def send_frame(sock: socket.socket, obj: Any) -> None:
+    payload = msgpack.packb(obj, use_bin_type=True)
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> Any | None:
+    """Read one frame; None on clean EOF."""
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (length,) = struct.unpack(">I", header)
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    return msgpack.unpackb(payload, raw=False)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+# ---------------------------------------------------------------------------
+# Leader op channel
+# ---------------------------------------------------------------------------
+
+class LeaderOpChannel:
+    """Rank 0's broadcast channel: accepts num_nodes-1 follower connections,
+    then replicates every state-changing engine op to all of them in order.
+
+    ``broadcast`` is called from the engine-core thread; sends are blocking
+    (frames are tiny and followers read eagerly — a follower that stalls
+    stalls the engine, which is the correct failure mode for SPMD: running
+    ahead would hang in a collective anyway)."""
+
+    def __init__(self, port: int, num_followers: int):
+        self.num_followers = num_followers
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("0.0.0.0", port))  # port 0 → OS-assigned, race-free
+        self.port = self._server.getsockname()[1]
+        self._server.listen(num_followers)
+        self._conns: list[socket.socket] = []
+        self._lock = threading.Lock()
+
+    def accept_followers(self, timeout: float = 300.0) -> None:
+        self._server.settimeout(timeout)
+        while len(self._conns) < self.num_followers:
+            conn, addr = self._server.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            log.info("follower %d/%d connected from %s",
+                     len(self._conns), self.num_followers, addr)
+
+    def wait_ready(self, timeout: float = 600.0) -> None:
+        """Block until every follower has acked readiness (EngineCore built,
+        op replay about to start). Serving before this would let the
+        leader's first dispatch race far ahead of followers still building
+        their engines."""
+        for conn in self._conns:
+            conn.settimeout(timeout)
+            ack = recv_frame(conn)
+            if ack is None or ack.get("op") != "ready":
+                raise RuntimeError(f"follower sent {ack!r} instead of ready")
+            conn.settimeout(None)
+        log.info("all %d followers ready", self.num_followers)
+
+    def broadcast(self, op: dict) -> None:
+        with self._lock:
+            dead = []
+            for conn in self._conns:
+                try:
+                    send_frame(conn, op)
+                except OSError as exc:
+                    log.error("follower send failed (%s); dropping conn", exc)
+                    dead.append(conn)
+            for conn in dead:
+                self._conns.remove(conn)
+                conn.close()
+            if dead:
+                # A lost follower means its devices stop participating in
+                # collectives — the next dispatch would hang. Fail loudly.
+                raise RuntimeError(
+                    f"lost {len(dead)} follower connection(s); multi-host "
+                    "engine cannot continue")
+
+    def close(self) -> None:
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._server.close()
+
+
+def connect_to_leader(host: str, port: int, timeout: float = 300.0) -> socket.socket:
+    deadline = time.monotonic() + timeout
+    last: Exception | None = None
+    while time.monotonic() < deadline:
+        try:
+            sock = socket.create_connection((host, port), timeout=10.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(None)
+            return sock
+        except OSError as exc:
+            last = exc
+            time.sleep(0.3)
+    raise TimeoutError(f"could not reach leader op channel {host}:{port}: {last}")
+
+
+# ---------------------------------------------------------------------------
+# Follower loop
+# ---------------------------------------------------------------------------
+
+def follower_loop(core_factory: Callable[[dict], Any], sock: socket.socket) -> None:
+    """Replay the leader's op stream against a locally-built EngineCore.
+
+    ``core_factory(hello)`` builds the EngineCore AFTER the leader's hello
+    frame arrives, from the leader's resolved engine essentials — so
+    capacity-dependent scheduling (num_blocks) can never diverge. Runs until
+    the leader disconnects (clean EOF) — the follower then drains its
+    in-flight step and returns.
+    """
+    hello = recv_frame(sock)
+    if hello is None or hello.get("op") != "hello":
+        raise RuntimeError(f"expected hello from leader, got {hello!r}")
+    core = core_factory(hello)
+    send_frame(sock, {"op": "ready"})
+    from dynamo_tpu.protocols.common import PreprocessedRequest
+
+    pending = None
+    while True:
+        op = recv_frame(sock)
+        if op is None:
+            break
+        kind = op["op"]
+        if kind == "add":
+            core.add_request(PreprocessedRequest.from_dict(op["req"]))
+        elif kind == "abort":
+            core.abort(op["rid"])
+        elif kind == "step":
+            nxt = core.step_begin() if core.has_work() else None
+            if pending is not None:
+                core.step_finalize(pending)
+            pending = nxt
+        elif kind == "fail_all":
+            # Mirror the leader's engine-fatal wipe (AsyncJaxEngine._run).
+            pending = None
+            core.fail_all(op.get("error", "leader fail_all"))
+        else:
+            raise RuntimeError(f"unknown multihost op {kind!r}")
+    if pending is not None:
+        core.step_finalize(pending)
+    log.info("leader disconnected; follower loop done")
+
+
+def leader_hello(engine_cfg) -> dict:
+    """The engine essentials every rank must agree on, as resolved by the
+    leader (num_blocks may have been auto-sized from ITS device memory)."""
+    return {
+        "op": "hello",
+        "model": engine_cfg.model,
+        "num_blocks": engine_cfg.num_blocks,
+        "block_size": engine_cfg.block_size,
+        "max_batch_size": engine_cfg.max_batch_size,
+        "max_model_len": engine_cfg.max_model_len,
+        "prefill_chunk": engine_cfg.prefill_chunk,
+        "max_tokens_per_step": engine_cfg.max_tokens_per_step,
+        # Bucket ladders shape the compiled dispatches — a mismatch means
+        # different XLA programs across ranks and hung collectives.
+        "decode_bucket": list(engine_cfg.decode_bucket),
+        "decode_window": engine_cfg.decode_window,
+        "seed": engine_cfg.seed,
+        "enable_prefix_caching": engine_cfg.enable_prefix_caching,
+        "dp": engine_cfg.dp, "tp": engine_cfg.tp,
+        "ep": engine_cfg.ep, "sp": engine_cfg.sp,
+    }

@@ -1,0 +1,132 @@
+"""One rank of the 2-process multi-host engine test (spawned by
+tests/test_multihost.py with JAX_PLATFORMS=cpu and 2 virtual devices per
+process → a 4-device global mesh).
+
+rank 0: leader — serves 3 requests through AsyncJaxEngine (the production
+pipelined loop) while broadcasting the op stream; prints the collected
+token streams as JSON.
+rank 1: follower — replays the op stream through follower_loop.
+
+Usage: python multihost_rank.py <rank> <coordinator_port> [mode]
+mode "single": no jax.distributed — a 4-device single-process reference run
+of the same workload (the equality oracle for the leader's output).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import faulthandler
+faulthandler.dump_traceback_later(500, exit=True)
+import dataclasses
+import json
+import sys
+
+from dynamo_tpu.engine.engine import AsyncJaxEngine, EngineCore
+from dynamo_tpu.parallel import multihost as mh
+from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+from dynamo_tpu.utils.config import EngineConfig
+
+
+def engine_cfg() -> EngineConfig:
+    return EngineConfig(
+        model="tiny-llama",
+        block_size=4,
+        num_blocks=64,
+        max_batch_size=8,
+        max_model_len=128,
+        prefill_chunk=32,
+        decode_bucket=(4, 8),
+        tp=2,   # tiny-llama has 2 kv heads; model axis must divide them
+        dp=2,
+        decode_window=2,   # exercise fused windows across hosts too
+    )
+
+
+def make_reqs() -> list[PreprocessedRequest]:
+    reqs = []
+    for i in range(3):
+        r = PreprocessedRequest(
+            token_ids=[3 * i + j for j in range(5 + i)],
+            stop_conditions=StopConditions(max_tokens=6 + i, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+        )
+        r.request_id = f"mh{i}"
+        reqs.append(r)
+    return reqs
+
+
+async def leader(coord_port: int) -> None:
+    mn = mh.MultiNodeConfig(num_nodes=2, node_rank=0,
+                            leader_addr=f"127.0.0.1:{coord_port}")
+    mh.initialize_distributed(mn)
+    channel = mh.LeaderOpChannel(mn.resolved_op_port(), num_followers=1)
+    await asyncio.get_running_loop().run_in_executor(None, channel.accept_followers, 120.0)
+
+    cfg = engine_cfg()
+    core = EngineCore(cfg)
+    channel.broadcast(mh.leader_hello(
+        dataclasses.replace(cfg, num_blocks=core.runner.spec.num_blocks)))
+    await asyncio.get_running_loop().run_in_executor(None, channel.wait_ready)
+    engine = AsyncJaxEngine(core, op_sink=channel.broadcast)
+
+    async def one(req: PreprocessedRequest) -> list[int]:
+        toks: list[int] = []
+        async for out in engine.generate(req):
+            toks.extend(out.token_ids)
+        return toks
+
+    results = await asyncio.gather(*(one(r) for r in make_reqs()))
+    await engine.shutdown()
+    channel.close()
+    print("RESULT " + json.dumps({r.request_id: t for r, t in zip(make_reqs(), results)}),
+          flush=True)
+
+
+def follower(coord_port: int) -> None:
+    mn = mh.MultiNodeConfig(num_nodes=2, node_rank=1,
+                            leader_addr=f"127.0.0.1:{coord_port}")
+    mh.initialize_distributed(mn)
+    sock = mh.connect_to_leader("127.0.0.1", mn.resolved_op_port(), timeout=120.0)
+
+    def core_factory(hello: dict) -> EngineCore:
+        return EngineCore(EngineConfig(
+            model=hello["model"], num_blocks=hello["num_blocks"],
+            block_size=hello["block_size"], max_batch_size=hello["max_batch_size"],
+            max_model_len=hello["max_model_len"], prefill_chunk=hello["prefill_chunk"],
+            max_tokens_per_step=hello["max_tokens_per_step"],
+            decode_window=hello["decode_window"], seed=hello["seed"],
+            enable_prefix_caching=hello["enable_prefix_caching"],
+            dp=hello["dp"], tp=hello["tp"], ep=hello["ep"], sp=hello["sp"],
+            decode_bucket=tuple(hello["decode_bucket"]),
+        ))
+
+    mh.follower_loop(core_factory, sock)
+    print("FOLLOWER_DONE", flush=True)
+
+
+async def single() -> None:
+    """Single-process 4-device reference run of the same workload."""
+    engine = AsyncJaxEngine(EngineCore(engine_cfg()))
+
+    async def one(req: PreprocessedRequest) -> list[int]:
+        toks: list[int] = []
+        async for out in engine.generate(req):
+            toks.extend(out.token_ids)
+        return toks
+
+    results = await asyncio.gather(*(one(r) for r in make_reqs()))
+    await engine.shutdown()
+    print("RESULT " + json.dumps({r.request_id: t for r, t in zip(make_reqs(), results)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    rank = int(sys.argv[1])
+    port = int(sys.argv[2])
+    mode = sys.argv[3] if len(sys.argv) > 3 else "multi"
+    if mode == "single":
+        asyncio.run(single())
+    elif rank == 0:
+        asyncio.run(leader(port))
+    else:
+        follower(port)

@@ -1,0 +1,82 @@
+"""Multi-host engine: 2 processes x 2 virtual CPU devices = one 4-device
+SPMD engine (reference: MultiNodeConfig, lib/llm/src/engines.rs:29-44).
+
+The leader (rank 0) serves through the production AsyncJaxEngine loop while
+broadcasting its op stream; the follower replays it. The leader's emitted
+token streams must equal a single-process 4-device run of the identical
+workload — proof the replicated state machines and the cross-process
+collectives (Gloo on CPU; ICI/DCN on TPU) compute the same thing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+RANK_SCRIPT = str(Path(__file__).parent / "multihost_rank.py")
+REPO = str(Path(__file__).parent.parent)
+
+
+def _env(n_local_devices: int = 2) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_local_devices}"
+    ).strip()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _parse_result(stdout: str) -> dict:
+    for line in stdout.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise AssertionError(f"no RESULT line in output:\n{stdout[-2000:]}")
+
+
+@pytest.mark.slow
+def test_two_process_engine_matches_single_process():
+    port = _free_port()
+    follower = subprocess.Popen(
+        [sys.executable, RANK_SCRIPT, "1", str(port)], env=_env(),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        leader = subprocess.run(
+            [sys.executable, RANK_SCRIPT, "0", str(port)], env=_env(),
+            capture_output=True, text=True, timeout=420)
+        f_out, _ = follower.communicate(timeout=60)
+    finally:
+        if follower.poll() is None:
+            follower.kill()
+    assert leader.returncode == 0, (
+        f"leader failed rc={leader.returncode}\nstdout:{leader.stdout[-1500:]}"
+        f"\nstderr:{leader.stderr[-1500:]}")
+    multi = _parse_result(leader.stdout)
+    assert follower.returncode == 0 and "FOLLOWER_DONE" in f_out, (
+        f"follower failed rc={follower.returncode}:\n{f_out[-1500:]}")
+
+    ref = subprocess.run(
+        [sys.executable, RANK_SCRIPT, "0", "0", "single"], env=_env(4),
+        capture_output=True, text=True, timeout=420)
+    assert ref.returncode == 0, ref.stderr[-1500:]
+    single = _parse_result(ref.stdout)
+
+    assert set(multi) == {"mh0", "mh1", "mh2"}
+    for rid in single:
+        assert multi[rid] == single[rid], f"stream {rid} diverged across hosts"
+        assert len(multi[rid]) == 6 + int(rid[-1])  # exact max_tokens each
