@@ -1,0 +1,90 @@
+"""Standalone KV-aware router component.
+
+Fills the role of the reference's dynamo.router component
+(reference: components/src/dynamo/router/__main__.py:30-120): a process
+serving a ``generate`` endpoint that KV-routes each PreprocessedRequest
+over a target worker pool via KvPushRouter — so any caller (above all the
+disagg decode fleet dispatching remote prefills) gets prefix-aware
+placement without embedding a router brain of its own. Multiple router
+replicas can share load predictions with --sync-replicas
+(SyncedActiveSequences; reference: sequence.rs ActiveSequencesMultiWorker).
+
+``python -m dynamo_tpu.components.router --target dyn://dynamo.prefill.generate``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+from dynamo_tpu.router.kv_router import KvPushRouter, KvRouterConfig
+from dynamo_tpu.runtime.client import EndpointClient
+from dynamo_tpu.runtime.protocols import EndpointId
+from dynamo_tpu.runtime.runtime import DistributedRuntime, RequestContext
+from dynamo_tpu.utils.config import RuntimeConfig
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+log = get_logger("router.component")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("dynamo-router")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="router")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--target", default="dyn://dynamo.prefill.generate",
+                   help="worker-pool endpoint to KV-route over")
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--overlap-weight", type=float, default=1.0)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--sync-replicas", action="store_true",
+                   help="mirror ActiveSequences predictions across router replicas")
+    p.add_argument("--use-approx", action="store_true",
+                   help="ApproxKvIndexer for pools that publish no KV events")
+    return p.parse_args(argv)
+
+
+async def amain(ns: argparse.Namespace) -> None:
+    cfg = RuntimeConfig.from_settings(coordinator_url=ns.coordinator)
+    rt = await DistributedRuntime.create(cfg)
+    assert rt.client is not None
+
+    target_client = await EndpointClient.create(rt, EndpointId.parse(ns.target))
+    router = await KvPushRouter.create(target_client, KvRouterConfig(
+        block_size=ns.block_size,
+        overlap_weight=ns.overlap_weight,
+        temperature=ns.temperature,
+        sync_replicas=ns.sync_replicas,
+        use_approx_indexer=ns.use_approx,
+    ))
+
+    async def handler(payload: dict, ctx: RequestContext):
+        async for item in router.generate(payload):
+            if ctx.is_cancelled():
+                return
+            yield item
+
+    ep = rt.namespace(ns.namespace).component(ns.component).endpoint(ns.endpoint)
+    await ep.serve(handler)
+    log.info("router ready: %s -> %s", ns.endpoint, ns.target)
+    print(f"ROUTER_READY target={ns.target}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    log.info("router draining")
+    await router.close()
+    await rt.shutdown()
+
+
+def main() -> None:
+    configure_logging()
+    asyncio.run(amain(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--disagg", choices=["none", "prefill", "decode"], default="none")
     p.add_argument("--prefill-endpoint", default="dyn://dynamo.prefill.generate",
                    help="decode mode: where the prefill pool lives")
+    p.add_argument("--prefill-router", choices=["kv", "round-robin"], default="kv",
+                   help="decode mode: prefix-aware (KvPushRouter) or plain "
+                        "round-robin dispatch over the prefill pool; use "
+                        "round-robin when --prefill-endpoint points at a "
+                        "standalone dynamo_tpu.components.router, which is "
+                        "KV-aware itself")
     p.add_argument("--min-prefill-blocks", type=int, default=2,
                    help="decode mode: prompt blocks below which prefill stays local")
     # Multi-host engine (reference: lib/llm/src/engines.rs:29-44 MultiNodeConfig).
@@ -115,13 +121,11 @@ async def amain(ns: argparse.Namespace) -> None:
             leader_addr, op_port = await mh.resolve_leader_addr(rt.client, group)
         else:
             # Explicit --leader-addr on a follower: the op port is still the
-            # leader's OS-assigned one — fetch it from the published record
-            # (falling back to the port+1 convention if nothing is there).
-            try:
-                _, op_port = await mh.resolve_leader_addr(rt.client, group,
-                                                          timeout=30.0)
-            except TimeoutError:
-                op_port = 0
+            # leader's OS-assigned one — it MUST come from the published
+            # record (a worker leader never listens on the port+1
+            # convention; guessing would dial a dead or unrelated port).
+            _, op_port = await mh.resolve_leader_addr(rt.client, group,
+                                                      timeout=120.0)
         mncfg = mh.MultiNodeConfig(ns.num_nodes, ns.node_rank, leader_addr,
                                    op_port=op_port)
         # Blocks until every rank joins the group.
@@ -137,20 +141,7 @@ async def amain(ns: argparse.Namespace) -> None:
             sock = await loop.run_in_executor(None, mh.connect_to_leader, host, port)
 
             def core_factory(hello: dict) -> EngineCore:
-                return EngineCore(EngineConfig(
-                    model=hello["model"], num_blocks=hello["num_blocks"],
-                    block_size=hello["block_size"],
-                    max_batch_size=hello["max_batch_size"],
-                    max_model_len=hello["max_model_len"],
-                    prefill_chunk=hello["prefill_chunk"],
-                    max_tokens_per_step=hello["max_tokens_per_step"],
-                    decode_bucket=tuple(hello["decode_bucket"]),
-                    decode_window=hello["decode_window"],
-                    seed=hello["seed"],
-                    enable_prefix_caching=hello["enable_prefix_caching"],
-                    dp=hello["dp"], tp=hello["tp"],
-                    ep=hello["ep"], sp=hello["sp"],
-                ))
+                return EngineCore(mh.engine_config_from_hello(hello))
 
             log.info("follower rank %d replaying leader op stream", ns.node_rank)
             print(f"FOLLOWER_READY rank={ns.node_rank}", flush=True)
@@ -234,11 +225,26 @@ async def amain(ns: argparse.Namespace) -> None:
 
         prefill_client = await EndpointClient.create(
             rt, EndpointId.parse(ns.prefill_endpoint))
-        prefill_router = PushRouter(prefill_client)
+        if ns.prefill_router == "kv":
+            # Prefix-aware prefill dispatch: repeated prefixes land on the
+            # prefill worker already holding their KV (reference routes
+            # disagg prefill through the standalone KV router,
+            # components/src/dynamo/router/__main__.py:30-120 — here the
+            # router brain rides inside the decode worker).
+            from dynamo_tpu.router.kv_router import KvPushRouter, KvRouterConfig
 
-        async def prefill_call(payload, request_id):
-            async for item in prefill_router.generate(payload, request_id):
-                yield item
+            kv_prefill_router = await KvPushRouter.create(
+                prefill_client, KvRouterConfig(block_size=ns.block_size))
+
+            async def prefill_call(payload, request_id):
+                async for item in kv_prefill_router.generate(payload):
+                    yield item
+        else:
+            prefill_router = PushRouter(prefill_client)
+
+            async def prefill_call(payload, request_id):
+                async for item in prefill_router.generate(payload, request_id):
+                    yield item
 
         decode = DisaggDecodeHandler(
             engine, prefill_call, block_size=ns.block_size,

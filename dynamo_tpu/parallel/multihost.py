@@ -293,10 +293,20 @@ def follower_loop(core_factory: Callable[[dict], Any], sock: socket.socket) -> N
         elif kind == "abort":
             core.abort(op["rid"])
         elif kind == "step":
-            nxt = core.step_begin() if core.has_work() else None
-            if pending is not None:
-                core.step_finalize(pending)
-            pending = nxt
+            # Mirror the leader's engine-fatal handling: a deterministic
+            # step error raises HERE too (identical programs); wipe and keep
+            # replaying so the leader's own fail_all + recovery still has a
+            # live follower. A crash instead would kill this rank before the
+            # fail_all frame even arrives.
+            try:
+                nxt = core.step_begin() if core.has_work() else None
+                if pending is not None:
+                    core.step_finalize(pending)
+                pending = nxt
+            except Exception as exc:
+                log.exception("follower step failed; wiping in-flight state")
+                pending = None
+                core.fail_all(str(exc))
         elif kind == "fail_all":
             # Mirror the leader's engine-fatal wipe (AsyncJaxEngine._run).
             pending = None
@@ -308,24 +318,36 @@ def follower_loop(core_factory: Callable[[dict], Any], sock: socket.socket) -> N
     log.info("leader disconnected; follower loop done")
 
 
+# Every EngineConfig field that shapes the compiled XLA programs or the
+# scheduler's decisions — the set every rank of one SPMD engine must agree
+# on. ONE list, consumed by both leader_hello and engine_config_from_hello,
+# so a new field can't be added to one side and silently default on the
+# other.
+_HELLO_FIELDS = (
+    "model", "dtype", "attn_impl", "num_blocks", "block_size",
+    "max_batch_size", "max_model_len", "prefill_chunk", "max_tokens_per_step",
+    "decode_bucket", "decode_window", "seed", "enable_prefix_caching",
+    "dp", "tp", "ep", "sp",
+)
+
+
 def leader_hello(engine_cfg) -> dict:
     """The engine essentials every rank must agree on, as resolved by the
-    leader (num_blocks may have been auto-sized from ITS device memory)."""
-    return {
-        "op": "hello",
-        "model": engine_cfg.model,
-        "num_blocks": engine_cfg.num_blocks,
-        "block_size": engine_cfg.block_size,
-        "max_batch_size": engine_cfg.max_batch_size,
-        "max_model_len": engine_cfg.max_model_len,
-        "prefill_chunk": engine_cfg.prefill_chunk,
-        "max_tokens_per_step": engine_cfg.max_tokens_per_step,
-        # Bucket ladders shape the compiled dispatches — a mismatch means
-        # different XLA programs across ranks and hung collectives.
-        "decode_bucket": list(engine_cfg.decode_bucket),
-        "decode_window": engine_cfg.decode_window,
-        "seed": engine_cfg.seed,
-        "enable_prefix_caching": engine_cfg.enable_prefix_caching,
-        "dp": engine_cfg.dp, "tp": engine_cfg.tp,
-        "ep": engine_cfg.ep, "sp": engine_cfg.sp,
-    }
+    leader (num_blocks may have been auto-sized from ITS device memory).
+    Bucket ladders and dtype/attn choices shape the compiled dispatches —
+    a mismatch means different XLA programs across ranks and hung
+    collectives."""
+    out = {"op": "hello"}
+    for f in _HELLO_FIELDS:
+        v = getattr(engine_cfg, f)
+        out[f] = list(v) if isinstance(v, tuple) else v
+    return out
+
+
+def engine_config_from_hello(hello: dict):
+    """Build the follower's EngineConfig from the leader's hello frame."""
+    from dynamo_tpu.utils.config import EngineConfig
+
+    kw = {f: hello[f] for f in _HELLO_FIELDS}
+    kw["decode_bucket"] = tuple(kw["decode_bucket"])
+    return EngineConfig(**kw)

@@ -168,6 +168,8 @@ class KvPushRouter:
         worker_ids = self.client.instance_ids()
         wid, overlap = self.router.find_best_match(req.request_id, req.token_ids, worker_ids)
         req.estimated_prefix_hit_blocks = overlap
+        log.debug("routed %s -> worker %x (overlap %d blocks)",
+                  req.request_id, wid, overlap)
         first = True
         # Track real KV block growth during decode so the load predictor sees
         # long generations (reference: sequence.rs decode-block accounting).

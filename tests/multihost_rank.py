@@ -89,16 +89,7 @@ def follower(coord_port: int) -> None:
     sock = mh.connect_to_leader("127.0.0.1", mn.resolved_op_port(), timeout=120.0)
 
     def core_factory(hello: dict) -> EngineCore:
-        return EngineCore(EngineConfig(
-            model=hello["model"], num_blocks=hello["num_blocks"],
-            block_size=hello["block_size"], max_batch_size=hello["max_batch_size"],
-            max_model_len=hello["max_model_len"], prefill_chunk=hello["prefill_chunk"],
-            max_tokens_per_step=hello["max_tokens_per_step"],
-            decode_window=hello["decode_window"], seed=hello["seed"],
-            enable_prefix_caching=hello["enable_prefix_caching"],
-            dp=hello["dp"], tp=hello["tp"], ep=hello["ep"], sp=hello["sp"],
-            decode_bucket=tuple(hello["decode_bucket"]),
-        ))
+        return EngineCore(mh.engine_config_from_hello(hello))
 
     mh.follower_loop(core_factory, sock)
     print("FOLLOWER_DONE", flush=True)

@@ -1,0 +1,146 @@
+"""Standalone router component e2e: coordinator + mocker pool + router
+process, prefix-heavy traffic concentrating on the prefix holder.
+
+Reference pattern: the disagg prefill fleet is routed through the
+standalone KV router (components/src/dynamo/router/__main__.py:30-120);
+here mocker workers stand in for the prefill pool (they publish true KV
+events, so the router's radix index mirrors their caches).
+"""
+
+from __future__ import annotations
+
+import re
+import socket
+import time
+
+import pytest
+
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from tests.utils_process import ManagedProcess
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def router_cluster():
+    coord_port = free_port()
+    coordinator = ManagedProcess(
+        ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
+         "--port", str(coord_port)], name="coordinator").start()
+    time.sleep(1.0)
+    url = f"tcp://127.0.0.1:{coord_port}"
+    workers = [
+        ManagedProcess(
+            ["-m", "dynamo_tpu.components.worker", "--engine", "mocker",
+             "--coordinator", url, "--component", "pool", "--block-size", "4",
+             "--speedup-ratio", "50", "--max-model-len", "512",
+             "--num-blocks", "128"],
+            name=f"pool{i}").start()
+        for i in range(2)
+    ]
+    for w in workers:
+        w.wait_for_line("WORKER_READY", 30)
+    router = ManagedProcess(
+        ["-m", "dynamo_tpu.components.router", "--coordinator", url,
+         "--target", "dyn://dynamo.pool.generate", "--block-size", "4"],
+        name="router", env={"DYN_LOG": "debug"}).start()  # per-decision logs
+    router.wait_for_line("ROUTER_READY", 30)
+    yield {"coord_url": url, "router": router, "workers": workers,
+           "coordinator": coordinator}
+    router.stop()
+    for w in workers:
+        w.stop()
+    coordinator.stop()
+
+
+async def _call_router(coord_url: str, reqs: list[PreprocessedRequest],
+                       concurrent: bool = False) -> None:
+    from dynamo_tpu.runtime.client import EndpointClient, PushRouter
+    from dynamo_tpu.runtime.protocols import EndpointId
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    rt = await DistributedRuntime.create(RuntimeConfig(coordinator_url=coord_url))
+    try:
+        client = await EndpointClient.create(
+            rt, EndpointId("dynamo", "router", "generate"))
+        deadline = time.time() + 20
+        while not client.instance_ids() and time.time() < deadline:
+            import asyncio
+
+            await asyncio.sleep(0.1)
+        push = PushRouter(client)
+
+        async def one(req):
+            async for _ in push.generate(req.to_dict(), req.request_id):
+                pass
+
+        if concurrent:
+            import asyncio
+
+            await asyncio.gather(*(one(r) for r in reqs))
+        else:
+            for req in reqs:
+                await one(req)
+    finally:
+        await rt.shutdown()
+
+
+def _routed_workers(router: ManagedProcess, rid_prefix: str) -> list[str]:
+    out = []
+    for line in router.logs().splitlines():
+        m = re.search(r"routed (\S+) -> worker ([0-9a-f]+)", line)
+        if m and m.group(1).startswith(rid_prefix):
+            out.append(m.group(2))
+    return out
+
+
+@pytest.mark.asyncio
+async def test_prefix_heavy_traffic_concentrates(router_cluster):
+    shared = list(range(100, 164))  # 16 blocks of shared prefix
+    reqs = []
+    for i in range(6):
+        r = PreprocessedRequest(
+            token_ids=shared + [1000 + i],
+            stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+        )
+        r.request_id = f"warm{i}"
+        reqs.append(r)
+    await _call_router(router_cluster["coord_url"], reqs)
+
+    routed = _routed_workers(router_cluster["router"], "warm")
+    assert len(routed) == 6, f"expected 6 routing decisions, saw {routed}"
+    # First request seeds one worker's cache; once its KV events land, every
+    # later repeat of the prefix must land on that same worker.
+    tail = routed[2:]
+    assert len(set(tail)) == 1, f"prefix traffic did not concentrate: {routed}"
+    assert tail[0] == routed[1] or tail[0] == routed[0], routed
+
+
+@pytest.mark.asyncio
+async def test_distinct_prefixes_spread(router_cluster):
+    reqs = []
+    for i in range(6):
+        r = PreprocessedRequest(
+            token_ids=[2000 + 97 * i + j for j in range(64)],
+            stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+        )
+        r.request_id = f"cold{i}"
+        reqs.append(r)
+    # Concurrent: in-flight requests raise a worker's predicted load, so the
+    # cost function spreads distinct prefixes across the pool.
+    await _call_router(router_cluster["coord_url"], reqs, concurrent=True)
+    routed = _routed_workers(router_cluster["router"], "cold")
+    assert len(routed) == 6
+    # No shared prefix → load balancing should use both workers.
+    assert len(set(routed)) == 2, f"cold traffic pinned to one worker: {routed}"
