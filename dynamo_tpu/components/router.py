@@ -39,6 +39,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--overlap-weight", type=float, default=1.0)
     p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--snapshot-interval", type=float, default=5.0,
+                   help="radix snapshot dump period (s); 0 disables")
     p.add_argument("--sync-replicas", action="store_true",
                    help="mirror ActiveSequences predictions across router replicas")
     p.add_argument("--use-approx", action="store_true",
@@ -58,6 +60,7 @@ async def amain(ns: argparse.Namespace) -> None:
         temperature=ns.temperature,
         sync_replicas=ns.sync_replicas,
         use_approx_indexer=ns.use_approx,
+        snapshot_interval_s=ns.snapshot_interval,
     ))
 
     async def handler(payload: dict, ctx: RequestContext):

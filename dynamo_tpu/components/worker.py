@@ -233,8 +233,16 @@ async def amain(ns: argparse.Namespace) -> None:
             # router brain rides inside the decode worker).
             from dynamo_tpu.router.kv_router import KvPushRouter, KvRouterConfig
 
+            # Each decode worker is one replica of the prefill-router
+            # fleet: share load predictions (SyncedActiveSequences) so
+            # concurrent decode workers don't make load-blind correlated
+            # placements, and leave snapshot dumping to standalone routers
+            # (N decode workers re-putting the full index every cycle would
+            # race each other for no benefit).
             kv_prefill_router = await KvPushRouter.create(
-                prefill_client, KvRouterConfig(block_size=ns.block_size))
+                prefill_client, KvRouterConfig(
+                    block_size=ns.block_size, sync_replicas=True,
+                    snapshot_interval_s=0.0))
 
             async def prefill_call(payload, request_id):
                 async for item in kv_prefill_router.generate(payload):

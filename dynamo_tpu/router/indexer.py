@@ -47,10 +47,14 @@ class RadixIndexer:
         self._nodes: dict[int, _Node] = {}
         self._worker_hashes: dict[WorkerId, set[int]] = defaultdict(set)
         self.events_applied = 0
+        # Bumped on EVERY mutation (events AND worker purges) — the snapshot
+        # dirty-check keys on this, so a dead worker's removal re-dumps too.
+        self.version = 0
 
     # ------------------------------------------------------------------
     def apply_event(self, ev: RouterEvent) -> None:
         self.events_applied += 1
+        self.version += 1
         if isinstance(ev.event, BlockStored):
             parent = ev.event.parent_hash
             for h in ev.event.block_hashes:
@@ -72,6 +76,7 @@ class RadixIndexer:
 
     def remove_worker(self, worker_id: WorkerId) -> None:
         """Purge a dead worker (reference: indexer.rs:628)."""
+        self.version += 1
         for h in self._worker_hashes.pop(worker_id, set()):
             node = self._nodes.get(h)
             if node is not None:

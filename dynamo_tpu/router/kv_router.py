@@ -29,6 +29,12 @@ from dynamo_tpu.utils.logging import get_logger
 log = get_logger("router.kv")
 
 
+def radix_snapshot_key(namespace: str, component: str) -> str:
+    """Coordinator-KV key for the radix warm-start snapshot (the reference
+    keeps these in a NATS object-store 'radix-bucket', kv_router.rs:57-74)."""
+    return f"radix/{namespace}/{component}/snapshot"
+
+
 @dataclass
 class KvRouterConfig:
     block_size: int = 16
@@ -37,6 +43,12 @@ class KvRouterConfig:
     use_approx_indexer: bool = False   # engines without KV events
     approx_ttl_s: float = 120.0
     sync_replicas: bool = False        # mirror ActiveSequences across routers
+    # Radix snapshot warm-start (reference: kv_router.rs:71-74 radix-bucket,
+    # indexer.rs:656 dump_tree_as_events): routers periodically dump their
+    # index as replayable events to the coordinator KV; a new/restarted
+    # replica loads it before consuming live events, so its first routing
+    # decision already sees the fleet's caches. 0 disables dumping.
+    snapshot_interval_s: float = 5.0
 
 
 class KvRouter:
@@ -119,6 +131,20 @@ class KvPushRouter:
         assert coord is not None
         ev_sub = await coord.subscribe(kv_events_subject(ep.namespace, ep.component))
         met_sub = await coord.subscribe(load_metrics_subject(ep.namespace, ep.component))
+        # Warm-start AFTER subscribing (no event gap) and BEFORE serving:
+        # replaying the snapshot is idempotent against any live events that
+        # race in — stored-events only add holders to nodes.
+        snap_key = radix_snapshot_key(ep.namespace, ep.component)
+        try:
+            blob = await coord.get(snap_key)
+            if blob:
+                events = [RouterEvent.from_dict(d)
+                          for d in msgpack.unpackb(blob, raw=False)]
+                self.router.apply_events(events)
+                log.info("warm-started radix index from snapshot: %d events, "
+                         "%d blocks", len(events), self.router.indexer.block_count())
+        except Exception:
+            log.exception("radix snapshot load failed; starting cold")
         if self.router.config.sync_replicas:
             from dynamo_tpu.router.sequence import (
                 SyncedActiveSequences,
@@ -132,7 +158,31 @@ class KvPushRouter:
         self._tasks.append(asyncio.create_task(self._event_loop(ev_sub)))
         self._tasks.append(asyncio.create_task(self._metrics_loop(met_sub)))
         self._tasks.append(asyncio.create_task(self._instance_gc_loop()))
+        if self.router.config.snapshot_interval_s > 0:
+            self._tasks.append(asyncio.create_task(self._snapshot_loop(snap_key)))
         return self
+
+    async def _snapshot_loop(self, key: str) -> None:
+        """Periodically dump the radix index as replayable events (last
+        writer wins — replicas converge on the same event stream, so any
+        replica's dump warm-starts the next)."""
+        coord = self.client.runtime.client
+        last_version = -1
+        while True:
+            await asyncio.sleep(self.router.config.snapshot_interval_s)
+            version = self.router.indexer.version
+            if version == last_version:
+                continue
+            try:
+                events = self.router.indexer.dump_events()
+                blob = msgpack.packb([e.to_dict() for e in events], use_bin_type=True)
+                await coord.put(key, blob)
+                # Only a SUCCESSFUL put retires this version — a transient
+                # coordinator error must be retried next cycle even if no
+                # new events arrive.
+                last_version = version
+            except Exception:
+                log.exception("radix snapshot dump failed")
 
     async def _event_loop(self, sub) -> None:
         async for _subject, payload in sub:

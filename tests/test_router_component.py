@@ -144,3 +144,66 @@ async def test_distinct_prefixes_spread(router_cluster):
     assert len(routed) == 6
     # No shared prefix → load balancing should use both workers.
     assert len(set(routed)) == 2, f"cold traffic pinned to one worker: {routed}"
+
+
+@pytest.mark.asyncio
+async def test_router_restart_warm_start():
+    """Kill the router, start a fresh replica: its FIRST routing decision
+    must already see the fleet's prefix caches (loaded from the radix
+    snapshot in the coordinator KV — reference: kv_router.rs:71-74), not
+    start cold and mis-route until live events repopulate it."""
+    coord_port = free_port()
+    coordinator = ManagedProcess(
+        ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
+         "--port", str(coord_port)], name="coordinator").start()
+    time.sleep(1.0)
+    url = f"tcp://127.0.0.1:{coord_port}"
+    worker = ManagedProcess(
+        ["-m", "dynamo_tpu.components.worker", "--engine", "mocker",
+         "--coordinator", url, "--component", "pool", "--block-size", "4",
+         "--speedup-ratio", "50", "--max-model-len", "512",
+         "--num-blocks", "128"], name="pool").start()
+    router_args = ["-m", "dynamo_tpu.components.router", "--coordinator", url,
+                   "--target", "dyn://dynamo.pool.generate", "--block-size", "4",
+                   "--snapshot-interval", "0.3"]
+    try:
+        worker.wait_for_line("WORKER_READY", 30)
+        router = ManagedProcess(router_args, name="router1",
+                                env={"DYN_LOG": "debug"}).start()
+        router.wait_for_line("ROUTER_READY", 30)
+
+        shared = list(range(300, 364))
+
+        def req(rid: str) -> PreprocessedRequest:
+            r = PreprocessedRequest(
+                token_ids=list(shared),
+                stop_conditions=StopConditions(max_tokens=3, ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0),
+            )
+            r.request_id = rid
+            return r
+
+        await _call_router(url, [req("seed0")])
+        # Let the worker's KV events land and a snapshot cycle run.
+        import asyncio
+
+        await asyncio.sleep(1.5)
+        router.stop()
+
+        router2 = ManagedProcess(router_args, name="router2",
+                                 env={"DYN_LOG": "debug"}).start()
+        router2.wait_for_line("ROUTER_READY", 30)
+        await _call_router(url, [req("afterrestart")])
+        routed = []
+        for line in router2.logs().splitlines():
+            m = re.search(r"routed (afterrestart) -> worker [0-9a-f]+ \(overlap (\d+)", line)
+            if m:
+                routed.append(int(m.group(2)))
+        assert routed, f"no routing decision logged:\n{router2.logs()[-2000:]}"
+        assert routed[0] > 0, (
+            f"first decision after restart was cold (overlap {routed[0]}):\n"
+            + router2.logs()[-2000:])
+        router2.stop()
+    finally:
+        worker.stop()
+        coordinator.stop()
