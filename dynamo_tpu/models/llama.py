@@ -338,6 +338,12 @@ def forward(
         x = rms_norm(hid, lp["mlp_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:
             if moe_impl == "ep":
+                # Dropless ragged dispatch (serving default for ep>1): exact
+                # under any routing skew — see models/moe.py.
+                from dynamo_tpu.models.moe import moe_mlp_dropless
+
+                mlp_out = moe_mlp_dropless(x, lp, cfg, mesh=mesh)
+            elif moe_impl == "ep_capacity":
                 from dynamo_tpu.models.moe import moe_mlp_ep
 
                 mlp_out = moe_mlp_ep(x, lp, cfg)

@@ -120,6 +120,7 @@ class KvPushRouter:
         self.router = KvRouter(config)
         self._tasks: list[asyncio.Task] = []
         self._known_workers: set[WorkerId] = set()
+        self._snapshot_workers: set[WorkerId] = set()
         self._synced: "SyncedActiveSequences | None" = None
 
     @classmethod
@@ -141,6 +142,13 @@ class KvPushRouter:
                 events = [RouterEvent.from_dict(d)
                           for d in msgpack.unpackb(blob, raw=False)]
                 self.router.apply_events(events)
+                # Workers that exist only in the snapshot (died along with
+                # the previous router, before a cleaned dump) must be
+                # reconciled against discovery once it syncs — the normal GC
+                # only purges workers it saw LIVE first, so without this a
+                # phantom worker's entries would persist (and be re-dumped)
+                # forever.
+                self._snapshot_workers = {e.worker_id for e in events}
                 log.info("warm-started radix index from snapshot: %d events, "
                          "%d blocks", len(events), self.router.indexer.block_count())
         except Exception:
@@ -207,6 +215,13 @@ class KvPushRouter:
         while True:
             await asyncio.sleep(0.5)
             live = set(self.client.known_instance_ids())
+            if self._snapshot_workers and live:
+                # Discovery has synced: snapshot-only workers that are not
+                # live died with the previous router — purge them once.
+                for wid in self._snapshot_workers - live:
+                    log.info("purging snapshot-only worker %x", wid)
+                    self.router.remove_worker(wid)
+                self._snapshot_workers = set()
             for wid in self._known_workers - live:
                 log.info("purging dead worker %x from router state", wid)
                 self.router.remove_worker(wid)
