@@ -146,6 +146,9 @@ def run_bench(deadline_at: float) -> dict:
         prefill_chunk=PROMPT_LEN,
         decode_bucket=(BATCH,),
         decode_window=WINDOW,
+        # The bench measures throughput; DYN_BENCH_MODEL may name a
+        # weights-less dir and random weights are acceptable for timing.
+        allow_random_weights=True,
         enable_prefix_caching=False,
     ))
     for i in range(BATCH):

@@ -39,6 +39,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--max-batch-size", type=int, default=32)
     p.add_argument("--max-model-len", type=int, default=8192)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--allow-random-weights", action="store_true",
+                   help="serve RANDOM weights when the model path has no "
+                        "loadable safetensors (tests/benches only)")
     p.add_argument("--decode-window", type=int, default=1,
                    help="decode steps fused per device dispatch")
     p.add_argument("--tokenizer", default=None)
@@ -183,6 +186,7 @@ async def amain(ns: argparse.Namespace) -> None:
             max_model_len=ns.max_model_len,
             tp=ns.tp,
             decode_window=ns.decode_window,
+            allow_random_weights=ns.allow_random_weights,
             host_kv_blocks=ns.host_kv_blocks,
             disk_kv_path=ns.disk_kv_path,
         ), event_sink=sink,

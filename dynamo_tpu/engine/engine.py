@@ -127,16 +127,24 @@ class ModelRunner:
             if has_weights(engine_cfg.model):
                 self.params = load_params(cfg, engine_cfg.model, mesh=mesh)
             else:
-                import os
+                from dynamo_tpu.models.config import MODEL_PRESETS
 
-                if os.path.isdir(engine_cfg.model):
-                    # A real model dir without safetensors (e.g. .bin-only
-                    # snapshot): serving random weights here would look like
-                    # a working server producing garbage.
+                if engine_cfg.model not in MODEL_PRESETS:
+                    # A real model PATH without safetensors (typo, or a
+                    # .bin-only snapshot): serving random weights would look
+                    # like a working server producing garbage. Fail fast
+                    # unless explicitly allowed (reference contrast: vLLM
+                    # refuses unloadable checkpoints the same way).
+                    if not engine_cfg.allow_random_weights:
+                        raise ValueError(
+                            f"{engine_cfg.model!r} has no *.safetensors "
+                            "weights to load; convert the checkpoint, fix "
+                            "the path, or pass --allow-random-weights to "
+                            "serve RANDOM weights (tests/benches only)")
                     log.warning(
                         "%s has no *.safetensors weights: engine will serve "
-                        "RANDOM weights (convert the checkpoint to "
-                        "safetensors to load it)", engine_cfg.model)
+                        "RANDOM weights (--allow-random-weights)",
+                        engine_cfg.model)
                 self.params = llama.init_params(cfg, key)
         if mesh is not None:
             # Explicitly place params per their logical-axis rules: on one

@@ -54,6 +54,9 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--max-tokens", type=int, default=256, help="default max output tokens")
     p.add_argument("--input-jsonl", default=None)
+    p.add_argument("--allow-random-weights", action="store_true",
+                   help="serve RANDOM weights when the model path has no "
+                        "loadable safetensors (tests/benches only)")
     p.add_argument("--decode-window", type=int, default=1,
                    help="decode steps fused per device dispatch (stop checks "
                         "lag by up to window-1 tokens; output is unchanged)")
@@ -77,6 +80,7 @@ def build_local_engine(ns: argparse.Namespace) -> tuple[AsyncJaxEngine, EngineCo
         num_blocks=ns.num_blocks,
         tp=ns.tp,
         decode_window=ns.decode_window,
+        allow_random_weights=ns.allow_random_weights,
         host_kv_blocks=ns.host_kv_blocks,
         disk_kv_path=ns.disk_kv_path,
     )

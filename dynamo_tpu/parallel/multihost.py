@@ -324,7 +324,8 @@ def follower_loop(core_factory: Callable[[dict], Any], sock: socket.socket) -> N
 # so a new field can't be added to one side and silently default on the
 # other.
 _HELLO_FIELDS = (
-    "model", "dtype", "attn_impl", "num_blocks", "block_size",
+    "model", "dtype", "attn_impl", "allow_random_weights",
+    "num_blocks", "block_size",
     "max_batch_size", "max_model_len", "prefill_chunk", "max_tokens_per_step",
     "decode_bucket", "decode_window", "seed", "enable_prefix_caching",
     "dp", "tp", "ep", "sp",

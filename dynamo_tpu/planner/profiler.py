@@ -185,6 +185,9 @@ def engine_config_for_sweep(model: str, isl_grid: list[int],
     max_conc = max(conc_grid)
     blocks_per_seq = -(-max_len // block_size) + 1
     return EngineConfig(
+        # Profiling sweeps measure latency/throughput, not output quality —
+        # random weights on a weights-less dir are fine here.
+        allow_random_weights=True,
         model=model, block_size=block_size,
         num_blocks=max_conc * blocks_per_seq + 1,
         max_batch_size=max_conc, max_model_len=max_len,

@@ -108,6 +108,10 @@ class EngineConfig:
     disk_kv_path: str | None = None
     disk_kv_bytes: int = 1 << 30
     seed: int = 0
+    # A checkpoint PATH without loadable weights fails engine construction
+    # unless this is set — a typo'd path must not silently serve garbage.
+    # (Named presets always random-init; they exist for tests/benches.)
+    allow_random_weights: bool = False
     # Attention implementation: "auto" (pallas on TPU, dense elsewhere),
     # "dense", "pallas", or "pallas_interpret" (CPU-testable kernel path).
     attn_impl: str = "auto"

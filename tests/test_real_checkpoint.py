@@ -1,0 +1,95 @@
+"""Real-checkpoint e2e: serve a genuine trained checkpoint (real
+safetensors + real BPE tokenizer.json, committed under tests/data/,
+regenerable via tools/make_tiny_checkpoint.py) through the launcher's HTTP
+pipeline and assert COHERENT greedy output — the model was trained to
+continue a number-word cycle, so "one two three four" must continue
+" five six ...". Proves the whole chain: safetensors container, HF llama
+tensor-name mapping (incl. transposes), rope convention, tokenizer round
+trip, serving stack.
+
+Also: a model PATH without loadable weights must fail engine construction
+(random weights are opt-in) — a typo'd path may not silently serve garbage.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from tests.utils_process import ManagedProcess
+
+CKPT = str(Path(__file__).parent / "data" / "tiny-real-llama")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def http_json(url: str, payload: dict, timeout: float = 60.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"content-type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.mark.slow
+def test_real_checkpoint_serves_coherent_greedy():
+    port = free_port()
+    proc = ManagedProcess(
+        ["-m", "dynamo_tpu.launch.run", "in=http", "out=jax",
+         "--model", CKPT, "--port", str(port), "--block-size", "4",
+         "--num-blocks", "128", "--max-model-len", "256",
+         "--max-batch-size", "4"], name="real-ckpt").start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        proc.wait_for_line("http service listening", 60)
+        resp = http_json(base + "/v1/completions", {
+            "model": CKPT, "prompt": "one two three four",
+            "max_tokens": 8, "temperature": 0,
+        })
+        text = resp["choices"][0]["text"]
+        assert " five six seven eight" in text, f"incoherent output: {text!r}"
+        assert resp["usage"]["completion_tokens"] == 8
+        # loader really loaded (not random-init): the log line says so
+        assert "loaded tiny-real-llama" in proc.logs()
+    finally:
+        proc.stop()
+
+
+def test_weightless_path_fails_fast(tmp_path):
+    """config.json but no safetensors → engine construction raises unless
+    random weights are explicitly allowed."""
+    from dynamo_tpu.engine.engine import EngineCore
+    from dynamo_tpu.utils.config import EngineConfig
+
+    d = tmp_path / "typo-model"
+    d.mkdir()
+    (d / "config.json").write_text(json.dumps({
+        "vocab_size": 64, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 1, "num_attention_heads": 2,
+        "num_key_value_heads": 2, "tie_word_embeddings": True,
+    }))
+    kw = dict(model=str(d), block_size=4, num_blocks=16, max_batch_size=2,
+              max_model_len=64)
+    with pytest.raises(ValueError, match="no \\*\\.safetensors"):
+        EngineCore(EngineConfig(**kw))
+    core = EngineCore(EngineConfig(**kw, allow_random_weights=True))
+    assert core.runner.params is not None
+
+
+def test_presets_still_random_init():
+    """Named presets (no checkpoint by design) must keep working."""
+    from dynamo_tpu.engine.engine import EngineCore
+    from dynamo_tpu.utils.config import EngineConfig
+
+    core = EngineCore(EngineConfig(model="tiny-llama", block_size=4,
+                                   num_blocks=16, max_batch_size=2,
+                                   max_model_len=64))
+    assert core.runner.params is not None

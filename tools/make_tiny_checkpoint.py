@@ -1,0 +1,193 @@
+"""Produce a REAL tiny llama checkpoint for e2e tests: genuine BPE
+tokenizer.json + HF-named safetensors of a model trained until it
+deterministically continues a number-word cycle.
+
+Fills the test-fixture role of the reference's sample models
+(reference: lib/llm/tests/data/sample-models/TinyLlama_v1.1 — tokenizer
+artifacts used by its preprocessor tests) with an artifact we can fully
+regenerate: ``python tools/make_tiny_checkpoint.py tests/data/tiny-real-llama``.
+
+Why trained and not random: the e2e test (tests/test_real_checkpoint.py)
+asserts COHERENT greedy output — "one two three four" must continue
+" five six ..." — which proves the whole chain (safetensors container,
+HF llama tensor-name mapping incl. transposes, rope convention, tokenizer
+round trip) is wired correctly; random weights would only prove shapes.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+WORDS = ["one", "two", "three", "four", "five", "six", "seven", "eight",
+         "nine", "ten", "eleven", "twelve"]
+
+HIDDEN, LAYERS, HEADS, KV_HEADS, HEAD_DIM, INTER = 64, 2, 4, 2, 16, 128
+SEQ, STEPS, LR, SEED = 48, 1200, 3e-3, 0
+
+
+def build_tokenizer(out: Path) -> "object":
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    corpus = [" ".join(WORDS) + " "] * 64
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=True)
+    tok.decoder = decoders.ByteLevel()  # else Ġ markers leak into decodes
+    trainer = trainers.BpeTrainer(
+        vocab_size=256 + len(WORDS) * 4,
+        special_tokens=["<unk>", "<s>", "</s>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tok.train_from_iterator(corpus, trainer)
+    tok.save(str(out / "tokenizer.json"))
+    (out / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "bos_token": "<s>", "eos_token": "</s>", "unk_token": "<unk>",
+        "model_max_length": 2048,
+    }))
+    return tok
+
+
+def train(tok, vocab: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dynamo_tpu.models.llama import rms_norm, rope, swiglu
+
+    text = (" ".join(WORDS) + " ") * 40
+    ids = np.asarray(tok.encode(text).ids, np.int32)
+    print(f"corpus: {len(ids)} tokens, vocab {vocab}")
+
+    key = jax.random.key(SEED)
+    ks = iter(jax.random.split(key, 16))
+
+    def dense(k, shape, fan_in):
+        return jax.random.normal(k, shape, jnp.float32) * (fan_in ** -0.5)
+
+    params = {
+        "embed": dense(next(ks), (vocab, HIDDEN), HIDDEN),
+        "final_norm": jnp.ones((HIDDEN,)),
+        "layers": {
+            "wq": dense(next(ks), (LAYERS, HIDDEN, HEADS * HEAD_DIM), HIDDEN),
+            "wk": dense(next(ks), (LAYERS, HIDDEN, KV_HEADS * HEAD_DIM), HIDDEN),
+            "wv": dense(next(ks), (LAYERS, HIDDEN, KV_HEADS * HEAD_DIM), HIDDEN),
+            "wo": dense(next(ks), (LAYERS, HEADS * HEAD_DIM, HIDDEN), HEADS * HEAD_DIM),
+            "attn_norm": jnp.ones((LAYERS, HIDDEN)),
+            "mlp_norm": jnp.ones((LAYERS, HIDDEN)),
+            "w_gate": dense(next(ks), (LAYERS, HIDDEN, INTER), HIDDEN),
+            "w_up": dense(next(ks), (LAYERS, HIDDEN, INTER), HIDDEN),
+            "w_down": dense(next(ks), (LAYERS, INTER, HIDDEN), INTER),
+        },
+    }
+
+    def forward(p, tokens):  # [B, T] -> logits [B, T, V]; dense causal attn,
+        b, t = tokens.shape  # same building blocks as the serving forward.
+        pos = jnp.arange(t)[None, :].repeat(b, 0)
+        h = p["embed"][tokens]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        for i in range(LAYERS):
+            lp = jax.tree.map(lambda a: a[i], p["layers"])
+            x = rms_norm(h, lp["attn_norm"], 1e-5)
+            q = rope((x @ lp["wq"]).reshape(b, t, HEADS, HEAD_DIM), pos, 10000.0)
+            k = rope((x @ lp["wk"]).reshape(b, t, KV_HEADS, HEAD_DIM), pos, 10000.0)
+            v = (x @ lp["wv"]).reshape(b, t, KV_HEADS, HEAD_DIM)
+            rep = HEADS // KV_HEADS
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (HEAD_DIM ** -0.5)
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, -1), v)
+            h = h + attn.reshape(b, t, -1) @ lp["wo"]
+            x = rms_norm(h, lp["mlp_norm"], 1e-5)
+            h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+        h = rms_norm(h, p["final_norm"], 1e-5)
+        return h @ p["embed"].T
+
+    opt = optax.adam(LR)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, opt_state, batch):
+        def loss_fn(p):
+            logits = forward(p, batch[:, :-1])
+            tgt = batch[:, 1:]
+            lp = jax.nn.log_softmax(logits, -1)
+            return -jnp.take_along_axis(lp, tgt[..., None], -1).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(p, updates), opt_state, loss
+
+    rng = np.random.default_rng(SEED)
+    for i in range(STEPS):
+        starts = rng.integers(0, len(ids) - SEQ - 1, size=8)
+        batch = jnp.asarray(np.stack([ids[s : s + SEQ + 1] for s in starts]))
+        params, opt_state, loss = step(params, opt_state, batch)
+        if i % 200 == 0 or i == STEPS - 1:
+            print(f"step {i}: loss {float(loss):.4f}")
+    assert float(loss) < 0.15, f"training did not converge: loss {float(loss)}"
+    return jax.tree.map(np.asarray, params)
+
+
+def save_hf(params: dict, vocab: int, out: Path) -> None:
+    import ml_dtypes
+
+    from dynamo_tpu.models.loader import save_safetensors
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    tensors = {
+        "model.embed_tokens.weight": params["embed"].astype(bf16),
+        "model.norm.weight": params["final_norm"].astype(bf16),
+    }
+    specs = {  # our name -> (HF suffix, transpose back to [out, in])
+        "wq": ("self_attn.q_proj.weight", True),
+        "wk": ("self_attn.k_proj.weight", True),
+        "wv": ("self_attn.v_proj.weight", True),
+        "wo": ("self_attn.o_proj.weight", True),
+        "attn_norm": ("input_layernorm.weight", False),
+        "mlp_norm": ("post_attention_layernorm.weight", False),
+        "w_gate": ("mlp.gate_proj.weight", True),
+        "w_up": ("mlp.up_proj.weight", True),
+        "w_down": ("mlp.down_proj.weight", True),
+    }
+    for our, (suffix, transpose) in specs.items():
+        for i in range(LAYERS):
+            t = params["layers"][our][i]
+            tensors[f"model.layers.{i}.{suffix}"] = (
+                t.T if transpose else t).astype(bf16)
+    save_safetensors(out / "model.safetensors", tensors)
+    (out / "config.json").write_text(json.dumps({
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "vocab_size": vocab,
+        "hidden_size": HIDDEN,
+        "intermediate_size": INTER,
+        "num_hidden_layers": LAYERS,
+        "num_attention_heads": HEADS,
+        "num_key_value_heads": KV_HEADS,
+        "head_dim": HEAD_DIM,
+        "rope_theta": 10000.0,
+        "rms_norm_eps": 1e-5,
+        "max_position_embeddings": 2048,
+        "tie_word_embeddings": True,
+        "torch_dtype": "bfloat16",
+    }, indent=1))
+
+
+def main() -> None:
+    out = Path(sys.argv[1] if len(sys.argv) > 1 else "tests/data/tiny-real-llama")
+    out.mkdir(parents=True, exist_ok=True)
+    tok = build_tokenizer(out)
+    vocab = tok.get_vocab_size()
+    params = train(tok, vocab)
+    save_hf(params, vocab, out)
+    size = sum(f.stat().st_size for f in out.iterdir())
+    print(f"checkpoint written to {out} ({size / 1024:.0f} KiB)")
+
+
+if __name__ == "__main__":
+    main()
