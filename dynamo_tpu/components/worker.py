@@ -47,6 +47,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--speedup-ratio", type=float, default=10.0, help="mocker only")
     p.add_argument("--no-kv-events", action="store_true")
+    p.add_argument("--health-interval", type=float, default=5.0,
+                   help="idle seconds before a health canary replays through "
+                        "the handler (reference: health_check.rs); 0 disables")
+    p.add_argument("--wedgeable", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--host-kv-blocks", type=int, default=0, help="G2 host KV tier capacity")
     p.add_argument("--disk-kv-path", default=None, help="G3 disk KV tier directory")
     # Disaggregated serving (reference: vllm decode-first pattern).
@@ -270,8 +274,46 @@ async def amain(ns: argparse.Namespace) -> None:
                     return
                 yield out.to_dict()
 
+    if ns.wedgeable and ns.engine == "mocker":
+        # Test hook: a control payload wedges/unwedges the mock engine's
+        # step loop so e2e tests can exercise canary-driven NotReady.
+        inner_handler = handler
+
+        async def handler(payload: dict, ctx: RequestContext):  # noqa: F811
+            if isinstance(payload, dict) and "__wedge__" in payload:
+                engine.wedged = bool(payload["__wedge__"])
+                yield {"token_ids": [], "finish_reason": "stop"}
+                return
+            async for item in inner_handler(payload, ctx):
+                yield item
+
+    # Health canaries (reference: lib/runtime/src/health_check.rs:20-36):
+    # replay a tiny generate through the SAME handler when idle; a wedged
+    # engine flips ready=False in the published metrics and the KV router
+    # stops sending traffic until a canary succeeds again.
+    monitor = None
+    if ns.health_interval > 0:
+        from dynamo_tpu.runtime.health import (
+            EndpointHealthMonitor,
+            HealthCheckConfig,
+            default_canary_payload,
+        )
+
+        monitor = EndpointHealthMonitor(handler, HealthCheckConfig(
+            payload=default_canary_payload(),
+            idle_interval_s=ns.health_interval,
+            timeout_s=max(ns.health_interval, 5.0),
+        ))
+        handler = monitor.handler
+        base_stats = stats_fn
+
+        def stats_fn():  # noqa: F811
+            return {**base_stats(), "ready": monitor.ready}
+
     ep = rt.namespace(ns.namespace).component(ns.component).endpoint(ns.endpoint)
     await ep.serve(handler)
+    if monitor is not None:
+        monitor.start()
 
     metrics_pub = WorkerMetricsPublisher(
         rt.client, ns.namespace, ns.component, rt.instance_id, stats_fn)
@@ -295,6 +337,8 @@ async def amain(ns: argparse.Namespace) -> None:
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     log.info("worker draining")
+    if monitor is not None:
+        await monitor.stop()
     if op_channel is not None:
         op_channel.close()  # followers see EOF and drain
     await metrics_pub.stop()

@@ -58,6 +58,8 @@ class _MockSeq:
 
 
 class MockEngine:
+    wedged: bool = False  # test hook (see _loop)
+
     def __init__(self, args: MockEngineArgs | None = None,
                  event_sink: Callable[[KvCacheEvent], None] | None = None):
         self.args = args or MockEngineArgs()
@@ -110,9 +112,14 @@ class MockEngine:
     async def _loop(self) -> None:
         a = self.args
         while True:
+            while self.wedged:
+                # Test hook: a "stuck engine step loop" — requests queue but
+                # never progress, exactly the failure health canaries catch.
+                await asyncio.sleep(0.05)
             if not self.waiting and not self.running:
                 self._wake.clear()
                 await self._wake.wait()
+                continue  # re-check wedged before serving the wake-up work
             # reap cancelled
             for seq in [s for s in self.running if s.done]:
                 self._finish(seq, None)

@@ -87,6 +87,14 @@ class KvRouter:
         decision with the ActiveSequences predictor."""
         if not worker_ids:
             raise NoInstancesError("no workers")
+        # Health gating (reference: health_check.rs consumed by the router):
+        # workers whose canaries fail report ready=False and stop receiving
+        # traffic. Never filter down to zero — stale metrics must degrade to
+        # normal routing, not an outage.
+        ready = [w for w in worker_ids
+                 if self.worker_metrics.get(w, {}).get("ready", True) is not False]
+        if ready:
+            worker_ids = ready
         hashes = compute_block_hashes_for_tokens(token_ids, self.config.block_size)
         total_blocks = max(len(hashes), 1)
         overlaps = (self.approx if self.config.use_approx_indexer else self.indexer).find_matches(hashes)

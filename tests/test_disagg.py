@@ -152,12 +152,7 @@ def test_disagg_e2e_over_network():
     import socket
     import time
 
-    from tests.utils_process import ManagedProcess
-
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
+    from tests.utils_process import ManagedProcess, free_port
 
     prompt_text = "measure twice cut once " * 2   # 46 bytes → 11 blocks of 4
     expected = baseline_tokens(list(prompt_text.encode()), max_tokens=8)

@@ -15,13 +15,8 @@ import urllib.request
 
 import pytest
 
-from tests.utils_process import ManagedProcess
+from tests.utils_process import ManagedProcess, free_port
 
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def http_json(url: str, payload: dict | None = None, timeout: float = 30.0):

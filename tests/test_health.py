@@ -1,0 +1,187 @@
+"""Health-check canaries (reference: lib/runtime/src/health_check.rs:20-36):
+idle-endpoint payload replay flipping Ready/NotReady, consumed by the KV
+router so a wedged worker stops receiving traffic without dying.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+import socket
+import time
+
+import pytest
+
+from dynamo_tpu.runtime.health import EndpointHealthMonitor, HealthCheckConfig
+from tests.utils_process import ManagedProcess, free_port
+
+
+# ---------------------------------------------------------------------------
+# Monitor unit tests
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_canary_flips_not_ready_and_recovers():
+    wedged = False
+
+    async def handler(payload, ctx):
+        if wedged:
+            await asyncio.sleep(60)
+        yield {"token_ids": [7]}
+
+    mon = EndpointHealthMonitor(handler, HealthCheckConfig(
+        payload={"token_ids": [1]}, idle_interval_s=0.1, timeout_s=0.2))
+    mon.start()
+    try:
+        await asyncio.sleep(0.3)
+        assert mon.ready  # healthy canaries keep it Ready
+        wedged = True
+        deadline = time.monotonic() + 5
+        while mon.ready and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert not mon.ready, "canary timeout did not flip NotReady"
+        wedged = False
+        deadline = time.monotonic() + 5
+        while not mon.ready and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert mon.ready, "recovered endpoint did not flip back Ready"
+    finally:
+        await mon.stop()
+
+
+@pytest.mark.asyncio
+async def test_real_traffic_suppresses_canaries():
+    calls = []
+
+    async def handler(payload, ctx):
+        calls.append(payload)
+        yield {"token_ids": [1]}
+
+    mon = EndpointHealthMonitor(handler, HealthCheckConfig(
+        payload={"canary": True}, idle_interval_s=0.3, timeout_s=1.0))
+    mon.start()
+    try:
+        # keep the endpoint busy: canaries must not fire
+        for _ in range(8):
+            async for _ in mon.handler({"real": True}, None):
+                pass
+            await asyncio.sleep(0.05)
+        assert not any("canary" in c for c in calls)
+        # go idle: a canary replays
+        await asyncio.sleep(0.6)
+        assert any("canary" in c for c in calls)
+    finally:
+        await mon.stop()
+
+
+def test_router_health_gating():
+    from dynamo_tpu.router.kv_router import KvRouter
+
+    r = KvRouter()
+    r.update_metrics({"worker_id": 1, "ready": False, "kv_total_blocks": 64})
+    r.update_metrics({"worker_id": 2, "ready": True, "kv_total_blocks": 64})
+    for i in range(6):
+        wid, _ = r.find_best_match(f"r{i}", list(range(32)), [1, 2])
+        assert wid == 2, "routed to a NotReady worker"
+        r.complete(f"r{i}")
+    # All NotReady → degrade to normal routing, never an outage.
+    r.update_metrics({"worker_id": 2, "ready": False, "kv_total_blocks": 64})
+    wid, _ = r.find_best_match("rz", list(range(32)), [1, 2])
+    assert wid in (1, 2)
+
+
+# ---------------------------------------------------------------------------
+# E2E: wedged mocker stops receiving traffic without dying
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_wedged_worker_loses_traffic_e2e():
+    coord_port = free_port()
+    coordinator = ManagedProcess(
+        ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
+         "--port", str(coord_port)], name="coordinator").start()
+    url = f"tcp://127.0.0.1:{coord_port}"
+    time.sleep(1.0)
+    workers = [
+        ManagedProcess(
+            ["-m", "dynamo_tpu.components.worker", "--engine", "mocker",
+             "--coordinator", url, "--component", "pool", "--block-size", "4",
+             "--speedup-ratio", "50", "--max-model-len", "512",
+             "--num-blocks", "128", "--wedgeable",
+             "--health-interval", "0.3"],
+            name=f"pool{i}").start()
+        for i in range(2)
+    ]
+    router = None
+    try:
+        for w in workers:
+            w.wait_for_line("WORKER_READY", 30)
+        router = ManagedProcess(
+            ["-m", "dynamo_tpu.components.router", "--coordinator", url,
+             "--target", "dyn://dynamo.pool.generate", "--block-size", "4"],
+            name="router", env={"DYN_LOG": "debug"}).start()
+        router.wait_for_line("ROUTER_READY", 30)
+
+        from dynamo_tpu.protocols.common import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+        from dynamo_tpu.runtime.client import EndpointClient, PushRouter
+        from dynamo_tpu.runtime.protocols import EndpointId
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+        from dynamo_tpu.utils.config import RuntimeConfig
+
+        rt = await DistributedRuntime.create(RuntimeConfig(coordinator_url=url))
+        try:
+            # Wedge worker 0 via the direct pool endpoint (control payload).
+            pool_client = await EndpointClient.create(
+                rt, EndpointId("dynamo", "pool", "generate"))
+            deadline = time.time() + 20
+            while len(pool_client.instance_ids()) < 2 and time.time() < deadline:
+                await asyncio.sleep(0.1)
+            ids = sorted(pool_client.instance_ids())
+            assert len(ids) == 2
+            async for _ in pool_client.generate_direct(
+                    {"__wedge__": True}, ids[0], "wedge-ctl"):
+                pass
+            wedged_hex = f"{ids[0]:x}"
+
+            # Wait for the canary to flip it NotReady (idle 0.3s + timeout).
+            await asyncio.sleep(8.0)
+
+            client = await EndpointClient.create(
+                rt, EndpointId("dynamo", "router", "generate"))
+            while not client.instance_ids() and time.time() < deadline:
+                await asyncio.sleep(0.1)
+            push = PushRouter(client)
+            for i in range(6):
+                r = PreprocessedRequest(
+                    token_ids=[7000 + 13 * i + j for j in range(32)],
+                    stop_conditions=StopConditions(max_tokens=3, ignore_eos=True),
+                    sampling_options=SamplingOptions(temperature=0.0))
+                r.request_id = f"gate{i}"
+                async for _ in push.generate(r.to_dict(), r.request_id):
+                    pass
+            routed = []
+            for line in router.logs().splitlines():
+                m = re.search(r"routed (gate\d+) -> worker ([0-9a-f]+)", line)
+                if m:
+                    routed.append(m.group(2))
+            assert len(routed) == 6
+            assert wedged_hex not in routed, (
+                f"NotReady worker {wedged_hex} still got traffic: {routed}")
+            # The wedged worker is alive (not dead): its process runs and its
+            # instance is still registered.
+            assert workers[0].proc.poll() is None
+            assert ids[0] in pool_client.known_instance_ids()
+        finally:
+            await rt.shutdown()
+    finally:
+        if router:
+            router.stop()
+        for w in workers:
+            w.stop()
+        coordinator.stop()

@@ -20,15 +20,10 @@ from pathlib import Path
 
 import pytest
 
-from tests.utils_process import ManagedProcess
+from tests.utils_process import ManagedProcess, free_port
 
 CKPT = str(Path(__file__).parent / "data" / "tiny-real-llama")
 
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def http_json(url: str, payload: dict, timeout: float = 60.0):

@@ -20,13 +20,8 @@ from dynamo_tpu.protocols.common import (
     SamplingOptions,
     StopConditions,
 )
-from tests.utils_process import ManagedProcess
+from tests.utils_process import ManagedProcess, free_port
 
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 @pytest.fixture(scope="module")
