@@ -101,6 +101,7 @@ async def one_request(session: aiohttp.ClientSession, url: str, model: str,
         "temperature": 0.0,
         "ignore_eos": True,
         "stream": True,
+        "stream_options": {"include_usage": True},
     }
     t0 = time.perf_counter()
     prev = t0
@@ -123,6 +124,13 @@ async def one_request(session: aiohttp.ClientSession, url: str, model: str,
                 if "error" in chunk:
                     res.error = str(chunk["error"])[:200]
                     return res
+                if chunk.get("usage"):
+                    # Authoritative count from the final usage chunk:
+                    # content chunks undercount tokens under fused decode
+                    # windows (multi-token deltas) and parser jails.
+                    res.output_tokens = int(chunk["usage"].get(
+                        "completion_tokens", res.output_tokens))
+                    continue
                 delta = (chunk.get("choices") or [{}])[0].get("delta", {})
                 if delta.get("content"):
                     now = time.perf_counter()
@@ -131,6 +139,8 @@ async def one_request(session: aiohttp.ClientSession, url: str, model: str,
                     else:
                         res.itl_s.append(now - prev)
                     prev = now
+                    # Chunk count: ITL treats one content chunk as one step;
+                    # the usage chunk overrides the token TOTAL at the end.
                     res.output_tokens += 1
         res.latency_s = time.perf_counter() - t0
         res.ok = res.output_tokens > 0

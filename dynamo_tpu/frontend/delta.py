@@ -69,6 +69,14 @@ class ChatDeltaGenerator:
             )],
         )
 
+    def usage_chunk(self) -> ChatCompletionChunk:
+        """Final stream chunk carrying token usage (OpenAI include_usage
+        shape: empty choices + usage) — load generators read exact token
+        counts from it instead of counting content chunks, which undercount
+        under fused decode windows and parser jails."""
+        return ChatCompletionChunk(
+            id=self.id, model=self.model, choices=[], usage=self.usage())
+
     def usage(self) -> Usage:
         return Usage(
             prompt_tokens=self.prompt_tokens,

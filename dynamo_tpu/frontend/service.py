@@ -315,6 +315,9 @@ class HttpService:
                         await resp.write(encode_sse_json(tail_chunk))
                 if fin.tool_calls:
                     await resp.write(encode_sse_json(gen.tool_calls_chunk(fin.tool_calls)))
+            if chat and ((req.stream_options or {}).get("include_usage")):
+                # OpenAI include_usage shape: final chunk, empty choices.
+                await resp.write(encode_sse_json(gen.usage_chunk()))
             await resp.write(DONE_EVENT)
             self._requests.inc(route="chat" if chat else "completions", status="200")
         except (ConnectionResetError, asyncio.CancelledError):

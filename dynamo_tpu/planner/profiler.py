@@ -98,6 +98,14 @@ class SlaProfiler:
     def measure_itl(self, concurrency: int, context: int, steps: int) -> float:
         """Steady-state seconds per all-decode step at a (concurrency,
         context) operating point."""
+        maxb = self.core.engine_cfg.max_batch_size
+        if concurrency > maxb:
+            # Admission is slot-gated: extra requests would just queue, the
+            # warmup would run until most of the batch FINISHED, and the
+            # timed window would measure a smaller tail cohort.
+            log.warning("capping ITL concurrency %d to max_batch_size %d",
+                        concurrency, maxb)
+            concurrency = maxb
         # Token budget: the wait-for-steady-state warmup below runs mixed
         # prefill+decode steps in which early-admitted requests already
         # decode, so give each request enough headroom that `steps` decode
@@ -135,6 +143,11 @@ class SlaProfiler:
         dt = time.perf_counter() - t0
         measured = self.core.metrics.num_decode_tokens - base
         self._drain()
+        if measured == 0:
+            raise RuntimeError(
+                f"ITL window measured zero decode tokens at concurrency="
+                f"{concurrency}, context={context} — warmup consumed the "
+                "whole workload; raise steps or lower context")
         return dt / max(measured // max(concurrency, 1), 1)
 
     def profile_decode(
@@ -143,10 +156,14 @@ class SlaProfiler:
         itl = np.zeros((len(conc_grid), len(ctx_grid)))
         thpt = np.zeros_like(itl)
         for i, c in enumerate(conc_grid):
+            # measure_itl caps at max_batch_size; throughput must use the
+            # EFFECTIVE concurrency or points above the cap report inflated
+            # capacity to the planner.
+            c_eff = min(c, self.core.engine_cfg.max_batch_size)
             for j, ctx in enumerate(ctx_grid):
                 self.measure_itl(c, ctx, 2)   # warmup buckets
                 itl[i, j] = self.measure_itl(c, ctx, steps)
-                thpt[i, j] = c / itl[i, j] / self.chips
+                thpt[i, j] = c_eff / itl[i, j] / self.chips
                 log.info("decode conc=%d ctx=%d itl=%.4fs thpt/chip=%.1f",
                          c, ctx, itl[i, j], thpt[i, j])
         return itl, thpt

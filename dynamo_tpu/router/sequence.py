@@ -139,6 +139,7 @@ class SyncedActiveSequences(ActiveSequences):
         self._subject = subject
         self._origin = uuid.uuid4().hex
         self._outbox: asyncio.Queue[dict] = asyncio.Queue()
+        self._inhand: list[dict] = []
         self._tasks: list[asyncio.Task] = []
 
     async def start(self) -> None:
@@ -149,10 +150,15 @@ class SyncedActiveSequences(ActiveSequences):
     async def close(self) -> None:
         for t in self._tasks:
             t.cancel()
+        # Wait for the loops to actually unwind: the send loop re-queues its
+        # in-hand batch on cancellation, and that must land BEFORE the final
+        # flush below reads the outbox.
+        await asyncio.gather(*self._tasks, return_exceptions=True)
         # Flush whatever the send loop had not yet published (e.g. 'free'
         # ops from streams that finished during shutdown) so peers don't
         # carry stale predictions until the TTL sweep.
-        rest = []
+        rest = list(self._inhand)
+        self._inhand = []
         while not self._outbox.empty():
             rest.append(self._outbox.get_nowait())
         if rest:
@@ -192,17 +198,26 @@ class SyncedActiveSequences(ActiveSequences):
             while not self._outbox.empty() and len(batch) < 256:
                 batch.append(self._outbox.get_nowait())
             payload = msgpack.packb(batch)
-            for attempt in range(3):
-                try:
-                    await self._coord.publish(self._subject, payload)
-                    break
-                except Exception:
-                    if attempt == 2:
-                        # Dropped for good — peers' predictions for these
-                        # requests converge via the ActiveSequences TTL sweep.
-                        log.exception("active-seq sync publish dropped after retries")
-                    else:
-                        await asyncio.sleep(0.2 * (attempt + 1))
+            # Publish with the batch parked in _inhand: if close() cancels
+            # us mid-publish, its final flush reads _inhand BEFORE the
+            # outbox, preserving per-request op order (a free emitted during
+            # our publish must not jump ahead of the add we hold).
+            self._inhand = batch
+            await self._publish_with_retry(payload)
+            self._inhand = []
+
+    async def _publish_with_retry(self, payload: bytes) -> None:
+        for attempt in range(3):
+            try:
+                await self._coord.publish(self._subject, payload)
+                return
+            except Exception:
+                if attempt == 2:
+                    # Dropped for good — peers' predictions for these
+                    # requests converge via the ActiveSequences TTL sweep.
+                    log.exception("active-seq sync publish dropped after retries")
+                else:
+                    await asyncio.sleep(0.2 * (attempt + 1))
 
     async def _recv_loop(self, sub) -> None:
         async for _subject, payload in sub:

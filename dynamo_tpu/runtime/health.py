@@ -36,6 +36,11 @@ class HealthCheckConfig:
     payload: dict = field(default_factory=dict)
     idle_interval_s: float = 5.0    # replay after this much idle time
     timeout_s: float = 10.0         # canary must finish within this
+    # With requests IN FLIGHT, no-progress must exceed this (not the idle
+    # interval) before a canary fires: a legitimately long first-token wait
+    # (cold compile, long-context prefill) is not a wedge, and a canary
+    # queued behind it would time out and flip a healthy worker NotReady.
+    busy_grace_s: float = 30.0
     request_id_prefix: str = "health-canary"
 
 
@@ -94,7 +99,9 @@ class EndpointHealthMonitor:
             # mid-stream — the common production failure) must NOT suppress
             # them, or the wedge goes undetected until a client times out.
             idle = time.monotonic() - self._last_activity
-            if idle < self.config.idle_interval_s:
+            threshold = (self.config.busy_grace_s if self._inflight > 0
+                         else self.config.idle_interval_s)
+            if idle < threshold:
                 continue
             await self._run_canary()
 

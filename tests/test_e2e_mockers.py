@@ -9,7 +9,6 @@ pipeline and assert routing + fault-tolerance behavior.
 from __future__ import annotations
 
 import json
-import socket
 import time
 import urllib.request
 

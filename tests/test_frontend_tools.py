@@ -100,6 +100,8 @@ async def test_stream_tool_calls_jailed():
                     ev = json.loads(line[6:])
                     if "error" in ev:
                         raise AssertionError(ev)
+                    if not ev.get("choices"):
+                        continue  # usage chunk (include_usage shape)
                     d = ev["choices"][0]["delta"]
                     content += d.get("content") or ""
                     tool_calls.extend(d.get("tool_calls") or [])
@@ -126,6 +128,8 @@ async def test_stream_reasoning_content():
                     if not line.startswith("data: ") or line == "data: [DONE]":
                         continue
                     ev = json.loads(line[6:])
+                    if not ev.get("choices"):
+                        continue  # usage chunk (include_usage shape)
                     d = ev["choices"][0]["delta"]
                     content += d.get("content") or ""
                     reasoning += d.get("reasoning_content") or ""

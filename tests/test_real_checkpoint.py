@@ -14,7 +14,6 @@ Also: a model PATH without loadable weights must fail engine construction
 from __future__ import annotations
 
 import json
-import socket
 import urllib.request
 from pathlib import Path
 

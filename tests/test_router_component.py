@@ -10,7 +10,6 @@ events, so the router's radix index mirrors their caches).
 from __future__ import annotations
 
 import re
-import socket
 import time
 
 import pytest
