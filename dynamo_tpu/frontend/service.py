@@ -75,6 +75,11 @@ class HttpService:
         self.app.router.add_get("/metrics", self.metrics_handler)
         self.app.router.add_post("/clear_kv_blocks", self.clear_kv_blocks)
         self.app.router.add_get("/engine_stats", self.engine_stats)
+        # KServe v2 protocol rides the same app/port (reference serves its
+        # KServe gRPC flavor as a separate ingress; see frontend/kserve.py).
+        from dynamo_tpu.frontend.kserve import register_kserve
+
+        register_kserve(self.app, self.models, service=self)
         self._runner: web.AppRunner | None = None
         self.port: int = 0
 
@@ -315,9 +320,21 @@ class HttpService:
                         await resp.write(encode_sse_json(tail_chunk))
                 if fin.tool_calls:
                     await resp.write(encode_sse_json(gen.tool_calls_chunk(fin.tool_calls)))
-            if chat and ((req.stream_options or {}).get("include_usage")):
+            if (req.stream_options or {}).get("include_usage"):
                 # OpenAI include_usage shape: final chunk, empty choices.
-                await resp.write(encode_sse_json(gen.usage_chunk()))
+                # ntokens counts engine token_ids directly, so the count is
+                # exact for both routes (chat additionally mirrors it in
+                # gen.completion_tokens).
+                if chat:
+                    await resp.write(encode_sse_json(gen.usage_chunk()))
+                else:
+                    from dynamo_tpu.protocols.openai import CompletionResponse, Usage
+
+                    await resp.write(encode_sse_json(CompletionResponse(
+                        id=f"cmpl-{pre.request_id}", model=req.model, choices=[],
+                        usage=Usage(prompt_tokens=len(pre.token_ids),
+                                    completion_tokens=ntokens,
+                                    total_tokens=len(pre.token_ids) + ntokens))))
             await resp.write(DONE_EVENT)
             self._requests.inc(route="chat" if chat else "completions", status="200")
         except (ConnectionResetError, asyncio.CancelledError):

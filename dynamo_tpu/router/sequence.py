@@ -139,33 +139,30 @@ class SyncedActiveSequences(ActiveSequences):
         self._subject = subject
         self._origin = uuid.uuid4().hex
         self._outbox: asyncio.Queue[dict] = asyncio.Queue()
-        self._inhand: list[dict] = []
         self._tasks: list[asyncio.Task] = []
+        self._send_task: asyncio.Task | None = None
 
     async def start(self) -> None:
         sub = await self._coord.subscribe(self._subject)
         self._tasks.append(asyncio.create_task(self._recv_loop(sub)))
-        self._tasks.append(asyncio.create_task(self._send_loop()))
+        self._send_task = asyncio.create_task(self._send_loop())
+        self._tasks.append(self._send_task)
 
     async def close(self) -> None:
+        # Drain via sentinel instead of cancelling: the send loop publishes
+        # everything queued before the sentinel exactly once, then exits —
+        # no cancellation race can drop a batch or re-deliver one whose
+        # publish already succeeded (peers' 'decode' ops are additive, so a
+        # replay would double-count predicted blocks).
+        if self._send_task is not None:
+            self._emit({"op": "__stop__"})
+            try:
+                await asyncio.wait_for(asyncio.shield(self._send_task), timeout=5.0)
+            except (asyncio.TimeoutError, Exception):
+                log.warning("active-seq sync drain timed out; peers converge via TTL")
         for t in self._tasks:
             t.cancel()
-        # Wait for the loops to actually unwind: the send loop re-queues its
-        # in-hand batch on cancellation, and that must land BEFORE the final
-        # flush below reads the outbox.
         await asyncio.gather(*self._tasks, return_exceptions=True)
-        # Flush whatever the send loop had not yet published (e.g. 'free'
-        # ops from streams that finished during shutdown) so peers don't
-        # carry stale predictions until the TTL sweep.
-        rest = list(self._inhand)
-        self._inhand = []
-        while not self._outbox.empty():
-            rest.append(self._outbox.get_nowait())
-        if rest:
-            try:
-                await self._coord.publish(self._subject, msgpack.packb(rest))
-            except Exception:
-                log.warning("final active-seq sync flush failed; peers converge via TTL")
 
     # -- local mutators: apply + broadcast ------------------------------
     def add_request(self, request_id: str, worker_id: WorkerId,
@@ -197,14 +194,12 @@ class SyncedActiveSequences(ActiveSequences):
             batch = [msg]
             while not self._outbox.empty() and len(batch) < 256:
                 batch.append(self._outbox.get_nowait())
-            payload = msgpack.packb(batch)
-            # Publish with the batch parked in _inhand: if close() cancels
-            # us mid-publish, its final flush reads _inhand BEFORE the
-            # outbox, preserving per-request op order (a free emitted during
-            # our publish must not jump ahead of the add we hold).
-            self._inhand = batch
-            await self._publish_with_retry(payload)
-            self._inhand = []
+            stop = any(m.get("op") == "__stop__" for m in batch)
+            batch = [m for m in batch if m.get("op") != "__stop__"]
+            if batch:
+                await self._publish_with_retry(msgpack.packb(batch))
+            if stop:
+                return
 
     async def _publish_with_retry(self, payload: bytes) -> None:
         for attempt in range(3):
