@@ -39,6 +39,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--max-batch-size", type=int, default=32)
     p.add_argument("--max-model-len", type=int, default=8192)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline stages (layer blocks sharded over 'pipe')")
     p.add_argument("--allow-random-weights", action="store_true",
                    help="serve RANDOM weights when the model path has no "
                         "loadable safetensors (tests/benches only)")
@@ -189,6 +191,7 @@ async def amain(ns: argparse.Namespace) -> None:
             max_batch_size=ns.max_batch_size,
             max_model_len=ns.max_model_len,
             tp=ns.tp,
+            pp=ns.pp,
             decode_window=ns.decode_window,
             allow_random_weights=ns.allow_random_weights,
             host_kv_blocks=ns.host_kv_blocks,

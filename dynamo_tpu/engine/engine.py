@@ -459,11 +459,16 @@ class EngineCore:
                                         engine_cfg.max_model_len),
             )
         self.engine_cfg = engine_cfg
-        if mesh is None and engine_cfg.mesh_shape() != {
-            "data": 1, "model": 1, "expert": 1, "seq": 1
-        }:
-            mesh = make_mesh(MeshConfig(dp=engine_cfg.dp, sp=engine_cfg.sp,
-                                        tp=engine_cfg.tp, ep=engine_cfg.ep))
+        if engine_cfg.pp > 1 and (engine_cfg.tp > 1 or engine_cfg.ep > 1
+                                  or engine_cfg.sp > 1):
+            raise ValueError(
+                "pp>1 currently composes only with dp; tp/ep/sp must be 1 "
+                "(the PP stage block runs dense attention/MoE — see "
+                "models/llama.forward_pp)")
+        if mesh is None and any(v != 1 for v in engine_cfg.mesh_shape().values()):
+            mesh = make_mesh(MeshConfig(dp=engine_cfg.dp, pp=engine_cfg.pp,
+                                        sp=engine_cfg.sp, tp=engine_cfg.tp,
+                                        ep=engine_cfg.ep))
         self.model_cfg = resolve_model_config(engine_cfg.model)
         self.runner = ModelRunner(self.model_cfg, engine_cfg, mesh=mesh, params=params,
                                   rng_seed=engine_cfg.seed)

@@ -68,9 +68,13 @@ def _parse_infer_inputs(body: dict) -> tuple[str, bool]:
     Mirrors the reference's validation (grpc/service/openai.rs:206-260):
     ``text_input`` must be BYTES with shape [1] (or [1,1]); the optional
     ``streaming``/``stream`` tensor must be BOOL shape [1]."""
+    if not isinstance(body, dict):
+        raise ValueError("request body must be a JSON object")
     text: str | None = None
     streaming = False
     for t in body.get("inputs") or []:
+        if not isinstance(t, dict):
+            raise ValueError("each input tensor must be a JSON object")
         name = t.get("name")
         shape = list(t.get("shape") or [])
         data = t.get("data") or []
@@ -154,10 +158,12 @@ class KServeFrontend:
         parameters (mapped to 400, like the tensor validation)."""
         entry = self.models.get(name)
         assert entry is not None
+        if not isinstance(params, dict):
+            raise ValueError("'parameters' must be a JSON object")
         try:
             req = _sampling_request(name, text, params)
             return entry, entry.preprocessor.preprocess_completion(req, uuid.uuid4().hex)
-        except (ValueError, TypeError) as exc:
+        except (ValueError, TypeError, AttributeError) as exc:
             raise ValueError(f"invalid parameters: {exc}") from exc
 
     async def _run(self, entry, pre, model: str) -> tuple[str, str]:
@@ -203,10 +209,12 @@ class KServeFrontend:
         try:
             body = await request.json()
         except json.JSONDecodeError:
+            self._count("400")
             return _err(400, "invalid JSON body")
         try:
             text, streaming = _parse_infer_inputs(body)
         except ValueError as exc:
+            self._count("400")
             return _err(400, str(exc))
         if streaming:
             self._count("400")
@@ -243,8 +251,9 @@ class KServeFrontend:
         try:
             body = await request.json()
         except json.JSONDecodeError:
+            self._count("400")
             return _err(400, "invalid JSON body")
-        if TEXT_INPUT not in body:
+        if not isinstance(body, dict) or TEXT_INPUT not in body:
             self._count("400")
             return _err(400, f"missing '{TEXT_INPUT}'")
         try:
@@ -273,8 +282,9 @@ class KServeFrontend:
         try:
             body = await request.json()
         except json.JSONDecodeError:
+            self._count("400")
             return _err(400, "invalid JSON body")
-        if TEXT_INPUT not in body:
+        if not isinstance(body, dict) or TEXT_INPUT not in body:
             self._count("400")
             return _err(400, f"missing '{TEXT_INPUT}'")
         try:
@@ -291,6 +301,15 @@ class KServeFrontend:
         def event(obj: dict) -> bytes:
             return f"data: {json.dumps(obj)}\n\n".encode()
 
+        import time as _time
+
+        svc = self._svc
+        if svc is not None:
+            svc._inflight.inc(model=name)
+            svc._input_tokens.inc(len(pre.token_ids), model=name)
+        t0 = _time.monotonic()
+        first = True
+        n_out = 0
         try:
             async for eo in entry.generate(pre):
                 if request.transport is None or request.transport.is_closing():
@@ -298,6 +317,10 @@ class KServeFrontend:
                 if eo.error:
                     await resp.write(event({"error": eo.error}))
                     return resp
+                if first and eo.token_ids and svc is not None:
+                    svc._ttft.observe(_time.monotonic() - t0, model=name)
+                    first = False
+                n_out += len(eo.token_ids)
                 out = backend.step(eo)
                 if out.text or out.finish_reason is not None:
                     await resp.write(event({
@@ -310,6 +333,11 @@ class KServeFrontend:
                     break
         except ConnectionResetError:
             pass
+        finally:
+            if svc is not None:
+                svc._inflight.inc(-1, model=name)
+                svc._output_tokens.inc(n_out, model=name)
+                svc._model_requests.inc(model=name)
         self._count("200")
         return resp
 

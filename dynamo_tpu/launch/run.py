@@ -52,6 +52,8 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--num-blocks", type=int, default=0)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline stages (layer blocks sharded over 'pipe')")
     p.add_argument("--max-tokens", type=int, default=256, help="default max output tokens")
     p.add_argument("--input-jsonl", default=None)
     p.add_argument("--allow-random-weights", action="store_true",
@@ -79,6 +81,7 @@ def build_local_engine(ns: argparse.Namespace) -> tuple[AsyncJaxEngine, EngineCo
         block_size=ns.block_size,
         num_blocks=ns.num_blocks,
         tp=ns.tp,
+        pp=ns.pp,
         decode_window=ns.decode_window,
         allow_random_weights=ns.allow_random_weights,
         host_kv_blocks=ns.host_kv_blocks,

@@ -3,7 +3,7 @@
 The reference passes TP/PP/EP sizes through to vLLM/SGLang (SURVEY.md §2.7);
 here parallelism is first-party: a ``jax.sharding.Mesh`` with axes
 
-    ("data", "seq", "model", "expert")
+    ("data", "pipe", "seq", "model", "expert")
 
 - **model**: tensor parallel — attention heads and MLP intermediate sharded;
   collectives (psum in the down-projections) ride ICI.
@@ -28,19 +28,20 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("data", "seq", "model", "expert")
+AXES = ("data", "pipe", "seq", "model", "expert")
 
 
 @dataclass(frozen=True)
 class MeshConfig:
     dp: int = 1
+    pp: int = 1
     sp: int = 1
     tp: int = 1
     ep: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.sp * self.tp * self.ep
+        return self.dp * self.pp * self.sp * self.tp * self.ep
 
 
 def make_mesh(cfg: MeshConfig | None = None, devices: list | None = None) -> Mesh:
@@ -50,7 +51,8 @@ def make_mesh(cfg: MeshConfig | None = None, devices: list | None = None) -> Mes
         cfg = MeshConfig(tp=len(devices))
     if cfg.size > len(devices):
         raise ValueError(f"mesh needs {cfg.size} devices, have {len(devices)}")
-    dev = np.asarray(devices[: cfg.size]).reshape(cfg.dp, cfg.sp, cfg.tp, cfg.ep)
+    dev = np.asarray(devices[: cfg.size]).reshape(
+        cfg.dp, cfg.pp, cfg.sp, cfg.tp, cfg.ep)
     return Mesh(dev, AXES)
 
 
@@ -64,8 +66,10 @@ PARAM_RULES: dict[str, str | None] = {
     "head_dim": None,
     "mlp": "model",            # MLP intermediate sharded (TP)
     "expert": "expert",        # MoE experts sharded (EP)
+    # Stacked layer dim sharded over pipeline stages (PP); a size-1 "pipe"
+    # axis makes this a no-op on non-PP meshes.
+    "layers": "pipe",
     "moe_mlp": "model",        # per-expert intermediate (TEP)
-    "layers": None,
 }
 
 
@@ -75,8 +79,10 @@ def param_sharding_rules(mesh: Mesh, logical_axes: tuple[str | None, ...]) -> Na
 
 
 def kv_cache_spec() -> P:
-    """KV cache [layers, blocks, block_size, kv_heads, head_dim]: heads TP-sharded."""
-    return P(None, None, None, "model", None)
+    """KV cache [layers, blocks, block_size, kv_heads, head_dim]:
+    layers PP-sharded (each pipeline stage holds its own layers' cache),
+    heads TP-sharded."""
+    return P("pipe", None, None, "model", None)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

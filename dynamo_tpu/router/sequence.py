@@ -158,8 +158,10 @@ class SyncedActiveSequences(ActiveSequences):
             self._emit({"op": "__stop__"})
             try:
                 await asyncio.wait_for(asyncio.shield(self._send_task), timeout=5.0)
-            except (asyncio.TimeoutError, Exception):
+            except asyncio.TimeoutError:
                 log.warning("active-seq sync drain timed out; peers converge via TTL")
+            except Exception:
+                log.exception("active-seq send loop died; peers converge via TTL")
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)

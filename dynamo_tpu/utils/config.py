@@ -93,8 +93,9 @@ class EngineConfig:
     max_tokens_per_step: int = 8192       # prefill token budget per step
     prefill_chunk: int = 512              # chunked-prefill bucket
     decode_bucket: tuple[int, ...] = (8, 16, 32, 64)
-    # Mesh axes sizes; 1 = unsharded. (data, model, expert, seq)
+    # Mesh axes sizes; 1 = unsharded. (data, pipe, seq, model, expert)
     dp: int = 1
+    pp: int = 1
     tp: int = 1
     ep: int = 1
     sp: int = 1
@@ -124,4 +125,5 @@ class EngineConfig:
     decode_window: int = 1
 
     def mesh_shape(self) -> dict[str, int]:
-        return {"data": self.dp, "model": self.tp, "expert": self.ep, "seq": self.sp}
+        return {"data": self.dp, "pipe": self.pp, "model": self.tp,
+                "expert": self.ep, "seq": self.sp}

@@ -365,3 +365,24 @@ def test_windowed_max_model_len_cap():
     got_a, got_b = _stream_pair(kw, {**kw, "decode_window": 8}, reqs)
     assert got_b["b0"] == got_a["a0"]
     assert len(got_b["b0"]) == 20 - 12
+
+
+def test_pp_engine_matches_unsharded():
+    """pp=2 (layer blocks sharded over 'pipe', select-and-broadcast rounds)
+    must emit exactly the unsharded engine's greedy streams — SURVEY §2.7 PP."""
+    def reqs(tag):
+        return [make_req(prompt=[3 * i + j for j in range(5 + i)],
+                         max_tokens=5 + i, rid=f"{tag}{i}") for i in range(3)]
+
+    def run(pp):
+        core = EngineCore(tiny_config(pp=pp, dtype="float32"))
+        if pp > 1:
+            assert core.runner.mesh is not None
+            assert core.runner.mesh.shape["pipe"] == pp
+        got, fin = run_to_completion(core, reqs(f"p{pp}-"))
+        assert len(fin) == 3
+        return got
+
+    a, b = run(1), run(2)
+    for i in range(3):
+        assert b[f"p2-{i}"] == a[f"p1-{i}"], f"stream {i} diverged under pp"
