@@ -426,6 +426,64 @@ class ModelRunner:
         n = len(rows)
         return np.asarray(toks)[:n], np.asarray(lps)[:n]
 
+    # -- embeddings ----------------------------------------------------
+    def _build_embed_fn(self, b: int, t: int):
+        """Prefill-only forward returning the final-norm hidden state at the
+        last prompt token (the /v1/embeddings pooling; reference route:
+        lib/llm/src/http/service/openai.rs:1132). Uses a TRANSIENT cache
+        built inside the jit — embedding calls never touch (or contend with)
+        the serving KV pool."""
+        cfg = self.cfg
+        ec = self.engine_cfg
+        nblk = -(-t // ec.block_size) + 1
+
+        def embed(params, tokens, q_len):
+            shape = (cfg.num_layers, nblk + 1, ec.block_size,
+                     cfg.num_kv_heads, cfg.head_dim)
+            ck = jnp.zeros(shape, jnp.dtype(cfg.dtype))
+            cv = jnp.zeros(shape, jnp.dtype(cfg.dtype))
+            bt = jnp.tile(jnp.arange(1, nblk + 1, dtype=jnp.int32)[None, :],
+                          (tokens.shape[0], 1))
+            q_start = jnp.zeros((tokens.shape[0],), jnp.int32)
+            hidden, _, _ = llama.forward(
+                params, cfg, tokens, q_start, q_len, bt, ck, cv,
+                attn_impl="dense", mesh=self.mesh)
+            return hidden.astype(jnp.float32)
+
+        kw = {}
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            kw["out_shardings"] = NamedSharding(self.mesh, P())
+        return jax.jit(embed, **kw)
+
+    def embed(self, token_lists: list[list[int]]) -> np.ndarray:
+        """Embed a batch of token sequences → [N, H] float32 (last-token
+        pooled, final-norm space)."""
+        out = np.zeros((len(token_lists), self.cfg.hidden_size), np.float32)
+        t_max = max(len(ts) for ts in token_lists)
+        if t_max > self.engine_cfg.max_model_len:
+            raise ValueError(
+                f"embedding input of {t_max} tokens exceeds max_model_len="
+                f"{self.engine_cfg.max_model_len}")
+        t = _pow2_bucket(t_max, 16, self.engine_cfg.max_model_len)
+        # Bounded bucket ladder: client batch sizes must not mint unbounded
+        # compile-cache entries (each compile blocks the engine-core thread).
+        b = _bucket(len(token_lists), (1, 2, 4, 8, 16, 32, 64))
+        key = ("embed", b, t, 0, 0)
+        if key not in self._step_fns:
+            log.info("compiling embed fn B=%d T=%d", b, t)
+            self._step_fns[key] = self._build_embed_fn(b, t)
+        fn = self._step_fns[key]
+        tokens = np.zeros((b, t), np.int32)
+        q_len = np.zeros((b,), np.int32)
+        for i, ts in enumerate(token_lists):
+            tokens[i, : len(ts)] = ts
+            q_len[i] = len(ts)
+        hidden = np.asarray(fn(self.params, self._place(tokens), self._place(q_len)))
+        out[:] = hidden[: len(token_lists)]
+        return out
+
 
 class EngineCore:
     """Synchronous engine: scheduler + runner + output assembly."""
@@ -738,6 +796,10 @@ class EngineCore:
     def unpin_blocks(self, block_ids: list[int]) -> None:
         self.pool.release(block_ids)
 
+    def embed(self, token_lists: list[list[int]]) -> "np.ndarray":
+        """Last-token-pooled embeddings (engine-core thread only)."""
+        return self.runner.embed(token_lists)
+
     def fail_all(self, error: str) -> list[str]:
         """Abort every in-flight request (engine-fatal path). Returns the
         request ids that were failed so callers can notify their streams."""
@@ -948,6 +1010,11 @@ class AsyncJaxEngine:
             if out is None or out.finish_reason is None:  # client bailed early
                 self._inbox.put(("abort", req.request_id))
                 self._wake.set()
+
+    async def embed(self, token_lists: list[list[int]]) -> "np.ndarray":
+        """Embeddings via the engine-core thread (serialized with steps —
+        device state has one owner)."""
+        return await self.run_in_core(lambda core: core.embed(token_lists))
 
     def stats(self) -> dict:
         out = self.core.metrics.snapshot(self.core.sched, self.core.pool)

@@ -35,6 +35,8 @@ class ModelEntry:
     # Parser names (dynamo_tpu.parsers registries); None = feature off.
     tool_parser: str | None = None
     reasoning_parser: str | None = None
+    # async callable: list[list[int]] -> [N, H] array (None = unsupported)
+    embed: "Callable | None" = None
 
 
 class ModelManager:
@@ -51,6 +53,7 @@ class ModelManager:
         clear_kv: Callable[[], Awaitable[None]] | None = None,
         tool_parser: str | None = None,
         reasoning_parser: str | None = None,
+        embed: Callable | None = None,
     ) -> ModelEntry:
         # Fail fast on bad parser names — a typo'd --tool-call-parser must
         # surface at registration, not mid-SSE-stream on the first request.
@@ -73,6 +76,7 @@ class ModelManager:
             clear_kv=clear_kv,
             tool_parser=tool_parser,
             reasoning_parser=reasoning_parser,
+            embed=embed,
         )
         self._models[name] = entry
         return entry

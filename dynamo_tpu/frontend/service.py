@@ -69,6 +69,8 @@ class HttpService:
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self.chat_completions)
         self.app.router.add_post("/v1/completions", self.completions)
+        self.app.router.add_post("/v1/embeddings", self.embeddings)
+        self.app.router.add_post("/v1/responses", self.responses)
         self.app.router.add_get("/v1/models", self.list_models)
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/live", self.live)
@@ -134,6 +136,177 @@ class HttpService:
         return web.json_response(results)
 
     # ------------------------------------------------------------------
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """POST /v1/embeddings — last-token-pooled hidden states from the
+        engine (reference route: http/service/openai.rs:1132)."""
+        from dynamo_tpu.protocols.openai import (
+            EmbeddingData,
+            EmbeddingRequest,
+            EmbeddingResponse,
+            Usage,
+        )
+
+        try:
+            req = EmbeddingRequest(**(await request.json()))
+        except Exception as exc:
+            self._requests.inc(route="embeddings", status="400")
+            return _error(400, f"invalid request: {exc}")
+        entry = self.models.get(req.model)
+        if entry is None:
+            self._requests.inc(route="embeddings", status="404")
+            return _error(404, f"model '{req.model}' not found")
+        if entry.embed is None:
+            self._requests.inc(route="embeddings", status="501")
+            return _error(501, f"model '{req.model}' does not serve embeddings")
+        if req.dimensions is not None:
+            self._requests.inc(route="embeddings", status="400")
+            return _error(400, "'dimensions' is not supported (embeddings are "
+                               "full hidden-state size)")
+        items = req.input if isinstance(req.input, list) else [req.input]
+        if items and isinstance(items[0], int):
+            items = [items]  # a single token list
+        token_lists: list[list[int]] = []
+        for it in items:
+            if isinstance(it, str):
+                token_lists.append(entry.tokenizer.encode(it, add_bos=True))
+            else:
+                token_lists.append([int(x) for x in it])
+        if not token_lists or any(not ts for ts in token_lists):
+            self._requests.inc(route="embeddings", status="400")
+            return _error(400, "empty input")
+        if len(token_lists) > 64:
+            self._requests.inc(route="embeddings", status="400")
+            return _error(400, "at most 64 inputs per request")
+        too_long = max(len(ts) for ts in token_lists)
+        if too_long > entry.defaults.max_model_len:
+            self._requests.inc(route="embeddings", status="400")
+            return _error(400, f"input of {too_long} tokens exceeds the "
+                               f"model context ({entry.defaults.max_model_len})")
+        try:
+            vecs = await entry.embed(token_lists)
+        except ValueError as exc:
+            self._requests.inc(route="embeddings", status="400")
+            return _error(400, str(exc))
+        except Exception as exc:  # noqa: BLE001 - surfaced to the client
+            log.exception("embeddings failed")
+            self._requests.inc(route="embeddings", status="500")
+            return _error(500, str(exc))
+        n_in = sum(len(ts) for ts in token_lists)
+
+        def enc(v):
+            if req.encoding_format == "base64":
+                import base64
+
+                import numpy as _np
+
+                return base64.b64encode(
+                    _np.asarray(v, _np.float32).tobytes()).decode()
+            return [float(x) for x in v]
+
+        resp = EmbeddingResponse(
+            model=req.model,
+            data=[EmbeddingData(index=i, embedding=enc(v))
+                  for i, v in enumerate(vecs)],
+            usage=Usage(prompt_tokens=n_in, total_tokens=n_in),
+        )
+        self._requests.inc(route="embeddings", status="200")
+        self._input_tokens.inc(n_in, model=req.model)
+        return web.Response(text=resp.model_dump_json(),
+                            content_type="application/json")
+
+    async def responses(self, request: web.Request) -> web.Response:
+        """POST /v1/responses — minimal OpenAI Responses API over the chat
+        pipeline (reference route: http/service/openai.rs:1165)."""
+        from dynamo_tpu.protocols.openai import (
+            ChatCompletionRequest,
+            ChatMessage,
+            ResponseMessage,
+            ResponseOutputText,
+            ResponsesRequest,
+            ResponsesResponse,
+            ResponsesUsage,
+        )
+
+        try:
+            req = ResponsesRequest(**(await request.json()))
+        except Exception as exc:
+            self._requests.inc(route="responses", status="400")
+            return _error(400, f"invalid request: {exc}")
+        if req.stream:
+            self._requests.inc(route="responses", status="400")
+            return _error(400, "streaming /v1/responses is not supported yet")
+        entry = self.models.get(req.model)
+        if entry is None:
+            self._requests.inc(route="responses", status="404")
+            return _error(404, f"model '{req.model}' not found")
+        request_id = request.headers.get("x-request-id") or uuid.uuid4().hex
+        try:
+            messages: list[ChatMessage] = []
+            if req.instructions:
+                messages.append(ChatMessage(role="system", content=req.instructions))
+            if isinstance(req.input, str):
+                messages.append(ChatMessage(role="user", content=req.input))
+            else:
+                for m in req.input:
+                    messages.append(ChatMessage(
+                        role=str(m.get("role", "user")),
+                        content=m.get("content")))
+            chat_req = ChatCompletionRequest(
+                model=req.model, messages=messages,
+                max_tokens=req.max_output_tokens,
+                temperature=req.temperature, top_p=req.top_p)
+            pre = entry.preprocessor.preprocess_chat(chat_req, request_id)
+        except Exception as exc:
+            self._requests.inc(route="responses", status="400")
+            return _error(400, f"invalid input: {exc}")
+        # Run the SAME aggregation path as chat (jail included, so reasoning/
+        # tool text never leaks into output_text), then re-envelope.
+        backend = DetokenizerBackend(entry.tokenizer, stops=pre.stop_conditions.stop)
+        outs: list[BackendOutput] = []
+        n_out = 0
+        t0 = time.monotonic()
+        first = True
+        prev = t0
+        self._inflight.inc(model=req.model)
+        try:
+            async for eo in entry.generate(pre):
+                now = time.monotonic()
+                if eo.token_ids:
+                    if first:
+                        self._ttft.observe(now - t0, model=req.model)
+                        first = False
+                    else:
+                        self._itl.observe(now - prev, model=req.model)
+                    prev = now
+                if eo.error:
+                    self._requests.inc(route="responses", status="500")
+                    return _error(500, eo.error)
+                n_out += len(eo.token_ids)
+                outs.append(backend.step(eo))
+                if backend.hit_stop:
+                    break
+        finally:
+            self._inflight.inc(-1, model=req.model)
+            self._req_dur.observe(time.monotonic() - t0, model=req.model)
+        agg = aggregate_chat(req.model, outs, len(pre.token_ids),
+                             jail=self._make_jail(entry, chat_req))
+        text = agg.choices[0].message.content or "" if agg.choices else ""
+        n_in = len(pre.token_ids)
+        resp = ResponsesResponse(
+            model=req.model,
+            output=[ResponseMessage(
+                id=f"msg-{request_id}",
+                content=[ResponseOutputText(text=text)])],
+            usage=ResponsesUsage(input_tokens=n_in, output_tokens=n_out,
+                                 total_tokens=n_in + n_out),
+        )
+        self._requests.inc(route="responses", status="200")
+        self._model_requests.inc(model=req.model)
+        self._output_tokens.inc(n_out, model=req.model)
+        self._input_tokens.inc(n_in, model=req.model)
+        return web.Response(text=resp.model_dump_json(),
+                            content_type="application/json")
+
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         return await self._serve(request, chat=True)
 

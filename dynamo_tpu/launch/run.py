@@ -102,6 +102,7 @@ async def run_http(ns: argparse.Namespace) -> None:
         stats=engine.stats,
         tool_parser=ns.tool_call_parser,
         reasoning_parser=ns.reasoning_parser,
+        embed=engine.embed,
     )
     svc = HttpService(models)
     await svc.start(ns.host, ns.port)

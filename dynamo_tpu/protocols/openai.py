@@ -177,6 +177,49 @@ class CompletionChoice(BaseModel):
     logprobs: dict[str, Any] | None = None
 
 
+class ResponsesRequest(BaseModel):
+    """POST /v1/responses, minimal surface (reference route:
+    http/service/openai.rs:1165)."""
+
+    model: str = ""
+    input: str | list[dict] = ""
+    instructions: str | None = None
+    max_output_tokens: int | None = None
+    temperature: float | None = None
+    top_p: float | None = None
+    stream: bool = False
+
+
+class ResponseOutputText(BaseModel):
+    type: Literal["output_text"] = "output_text"
+    text: str = ""
+    annotations: list = Field(default_factory=list)
+
+
+class ResponseMessage(BaseModel):
+    type: Literal["message"] = "message"
+    id: str = ""
+    role: Literal["assistant"] = "assistant"
+    status: str = "completed"
+    content: list[ResponseOutputText] = Field(default_factory=list)
+
+
+class ResponsesUsage(BaseModel):
+    input_tokens: int = 0
+    output_tokens: int = 0
+    total_tokens: int = 0
+
+
+class ResponsesResponse(BaseModel):
+    id: str = Field(default_factory=lambda: _gen_id("resp"))
+    object: Literal["response"] = "response"
+    created_at: int = Field(default_factory=now_s)
+    status: str = "completed"
+    model: str = ""
+    output: list[ResponseMessage] = Field(default_factory=list)
+    usage: ResponsesUsage | None = None
+
+
 class CompletionResponse(BaseModel):
     id: str = Field(default_factory=lambda: _gen_id("cmpl"))
     object: Literal["text_completion"] = "text_completion"
@@ -201,7 +244,8 @@ class ModelList(BaseModel):
 class EmbeddingData(BaseModel):
     object: Literal["embedding"] = "embedding"
     index: int
-    embedding: list[float]
+    # list for encoding_format="float", base64 string of f32 LE bytes else
+    embedding: list[float] | str
 
 
 class EmbeddingResponse(BaseModel):

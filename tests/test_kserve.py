@@ -186,3 +186,107 @@ async def test_kserve_e2e_against_mocker_cluster():
             frontend.stop()
         worker.stop()
         coordinator.stop()
+
+
+# ---------------------------------------------------------------------------
+# /v1/embeddings + /v1/responses (reference: openai.rs:1132, :1165)
+# ---------------------------------------------------------------------------
+
+async def test_embeddings_and_responses():
+    import numpy as np
+
+    models = ModelManager()
+
+    async def fake_embed(token_lists):
+        return np.asarray([[float(len(ts)), 1.0, 2.0] for ts in token_lists])
+
+    models.register("m", ByteTokenizer(), canned_generate("ok done"),
+                    defaults=ModelDefaults(), embed=fake_embed)
+    svc = HttpService(models)
+    port = await svc.start(port=0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(f"{base}/v1/embeddings", json={
+                "model": "m", "input": ["abc", "defgh"]})
+            assert r.status == 200, await r.text()
+            data = await r.json()
+            assert data["object"] == "list"
+            assert len(data["data"]) == 2
+            assert data["data"][1]["index"] == 1
+            assert len(data["data"][0]["embedding"]) == 3
+            assert data["usage"]["prompt_tokens"] > 0
+
+            # base64 encoding round-trips to the same floats
+            r = await s.post(f"{base}/v1/embeddings", json={
+                "model": "m", "input": "abc", "encoding_format": "base64"})
+            assert r.status == 200, await r.text()
+            b64 = (await r.json())["data"][0]["embedding"]
+            import base64 as _b64
+            decoded = np.frombuffer(_b64.b64decode(b64), np.float32)
+            np.testing.assert_allclose(decoded, [4.0, 1.0, 2.0])  # bos + 3 bytes
+
+            # dimensions unsupported -> 400; over-long input -> 400
+            r = await s.post(f"{base}/v1/embeddings", json={
+                "model": "m", "input": "x", "dimensions": 8})
+            assert r.status == 400
+            r = await s.post(f"{base}/v1/embeddings", json={
+                "model": "m", "input": "y" * 100000})
+            assert r.status == 400
+
+            r = await s.post(f"{base}/v1/responses", json={
+                "model": "m", "input": "say ok",
+                "instructions": "be brief", "max_output_tokens": 32})
+            assert r.status == 200, await r.text()
+            data = await r.json()
+            assert data["object"] == "response"
+            assert data["status"] == "completed"
+            assert data["output"][0]["content"][0]["text"] == "ok done"
+            assert data["usage"]["output_tokens"] > 0
+
+            # malformed responses input -> 400, not a raw 500
+            r = await s.post(f"{base}/v1/responses", json={
+                "model": "m", "input": [{"role": "user", "content": 42}]})
+            assert r.status == 400
+
+            # model without embed support → 501
+            models.register("noemb", ByteTokenizer(), canned_generate("x"),
+                            defaults=ModelDefaults())
+            r = await s.post(f"{base}/v1/embeddings", json={
+                "model": "noemb", "input": "x"})
+            assert r.status == 501
+    finally:
+        await svc.stop()
+
+
+async def test_engine_embeddings_end_to_end():
+    """Real engine: /v1/embeddings returns deterministic last-token-pooled
+    hidden states of the right dimensionality."""
+    import numpy as np
+
+    from dynamo_tpu.engine.engine import EngineCore, AsyncJaxEngine
+    from dynamo_tpu.utils.config import EngineConfig
+
+    engine = AsyncJaxEngine(EngineCore(EngineConfig(
+        model="tiny-llama", block_size=4, num_blocks=32, max_batch_size=2,
+        max_model_len=64)))
+    models = ModelManager()
+    models.register("tiny", ByteTokenizer(), engine.generate,
+                    defaults=ModelDefaults(), embed=engine.embed)
+    svc = HttpService(models)
+    port = await svc.start(port=0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            r1 = await (await s.post(f"{base}/v1/embeddings", json={
+                "model": "tiny", "input": "hello world"})).json()
+            r2 = await (await s.post(f"{base}/v1/embeddings", json={
+                "model": "tiny", "input": "hello world"})).json()
+        v1 = np.asarray(r1["data"][0]["embedding"])
+        v2 = np.asarray(r2["data"][0]["embedding"])
+        assert v1.shape == (64,)  # tiny-llama hidden_size
+        np.testing.assert_allclose(v1, v2)
+        assert np.isfinite(v1).all() and np.abs(v1).sum() > 0
+    finally:
+        await svc.stop()
+        await engine.shutdown()
