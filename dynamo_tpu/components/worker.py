@@ -317,6 +317,12 @@ async def amain(ns: argparse.Namespace) -> None:
     await ep.serve(handler)
     if monitor is not None:
         monitor.start()
+    if rt.status_server is not None:
+        rt.status_server.add_provider("engine", stats_fn)
+        if monitor is not None:
+            # k8s readiness mirrors the canary state (reference: the system
+            # status server consumes SystemHealth the same way).
+            rt.status_server.set_ready_fn(lambda: monitor.ready)
 
     metrics_pub = WorkerMetricsPublisher(
         rt.client, ns.namespace, ns.component, rt.instance_id, stats_fn)

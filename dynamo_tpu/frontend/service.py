@@ -82,11 +82,17 @@ class HttpService:
         from dynamo_tpu.frontend.kserve import register_kserve
 
         register_kserve(self.app, self.models, service=self)
+        # Audit bus (reference: lib/llm/src/audit/) — enabled via
+        # DYN_AUDIT_JSONL or a programmatic audit.init() before serving.
+        from dynamo_tpu.utils import audit as _audit
+
+        self._audit = _audit
         self._runner: web.AppRunner | None = None
         self.port: int = 0
 
     # ------------------------------------------------------------------
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._audit.maybe_init_from_env()
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -398,6 +404,12 @@ class HttpService:
         if chat:
             resp = aggregate_chat(req.model, outs, len(pre.token_ids),
                                   jail=self._make_jail(entry, req))
+            if self._audit.bus() is not None:
+                self._audit.publish(self._audit.AuditRecord(
+                    request_id=pre.request_id, model=req.model,
+                    requested_streaming=False,
+                    request=req.model_dump(exclude_none=True),
+                    response=resp.model_dump(exclude_none=True)))
         else:
             resp = aggregate_completion(req.model, outs, len(pre.token_ids))
         self._requests.inc(route=route, status="200")
@@ -419,6 +431,8 @@ class HttpService:
         first = True
         prev = t_start
         ntokens = 0
+        audit_text: list[str] = []
+        audit_error: str | None = None
         try:
             if chat:
                 await resp.write(encode_sse_json(gen.role_chunk()))
@@ -433,6 +447,7 @@ class HttpService:
                     prev = now
                     ntokens += len(eo.token_ids)
                 if eo.error:
+                    audit_error = eo.error
                     await resp.write(encode_sse_json({"error": {"message": eo.error, "code": 500}}))
                     break
                 out = backend.step(eo)
@@ -465,6 +480,8 @@ class HttpService:
                                                 cum_log_probs=out.cum_log_probs)
                     chunk = gen.chunk(out)
                     if chunk is not None:
+                        if out.text:
+                            audit_text.append(out.text)
                         await resp.write(encode_sse_json(chunk))
                 else:
                     if out.text or out.finish_reason:
@@ -513,7 +530,20 @@ class HttpService:
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away — generator cleanup aborts the engine request
             log.info("client disconnected request_id=%s", pre.request_id)
+            audit_error = audit_error or "client disconnected"
             self._requests.inc(route="chat" if chat else "completions", status="499")
         finally:
             self._output_tokens.inc(ntokens, model=req.model)
+            if chat and self._audit.bus() is not None:
+                # From finally so disconnects and engine errors are audited
+                # too — a compliance log that misses exactly the anomalous
+                # streams would be worthless. Streamed text is accumulated
+                # (the reference captures the full response the same way).
+                self._audit.publish(self._audit.AuditRecord(
+                    request_id=pre.request_id, model=req.model,
+                    requested_streaming=True,
+                    request=req.model_dump(exclude_none=True),
+                    response={"content": "".join(audit_text),
+                              "completion_tokens": gen.completion_tokens},
+                    error=audit_error))
         return resp

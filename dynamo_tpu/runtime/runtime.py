@@ -78,6 +78,9 @@ class DistributedRuntime:
         self._inflight = self.metrics.gauge("runtime_inflight_requests", "in-flight handler streams")
         self._tasks: set[asyncio.Task] = set()
         self._draining = False
+        # Per-process system status server (reference:
+        # system_status_server.rs), env-gated DYN_SYSTEM_ENABLED/PORT.
+        self.status_server = None
 
     # ------------------------------------------------------------------
     @classmethod
@@ -92,11 +95,21 @@ class DistributedRuntime:
         rt._server = await asyncio.start_server(rt._on_conn, "0.0.0.0", 0)
         rt.data_port = rt._server.sockets[0].getsockname()[1]
         rt._advertise_host = os.environ.get("DYN_ADVERTISE_HOST", "127.0.0.1")
+        if rt.config.system_enabled:
+            from dynamo_tpu.runtime.status import SystemStatusServer
+
+            rt.status_server = SystemStatusServer(rt.metrics, rt.config.system_port)
+            await rt.status_server.start()
         return rt
 
     async def shutdown(self) -> None:
         """Graceful: deregister instances, drain in-flight, drop lease."""
         self._draining = True
+        if self.status_server is not None:
+            # NotReady (503) during the drain window — but keep SERVING
+            # probes until the drain completes, else a kubelet reads
+            # connection-refused as dead and SIGKILLs mid-drain.
+            self.status_server.ready = False
         if self.client:
             for served in self._served.values():
                 await self.client.delete(
@@ -106,6 +119,8 @@ class DistributedRuntime:
             await asyncio.sleep(0.05)
         for t in self._tasks:
             t.cancel()
+        if self.status_server is not None:
+            await self.status_server.stop()
         if self.primary_lease and self.client:
             await self.primary_lease.revoke(self.client)
         if self._server:

@@ -1,0 +1,127 @@
+"""Stream recorder: capture coordinator subjects (KV events, load metrics)
+or request streams to JSONL for replay and analysis.
+
+Fills the role of the reference's recorders
+(reference: lib/llm/src/recorder.rs — request/event recorder;
+lib/llm/src/kv_router/recorder.rs:135 — the KV-event recorder used to
+capture real routing workloads for offline router evaluation).
+
+CLI: ``python -m dynamo_tpu.utils.recorder --coordinator tcp://... \
+      --subject 'kv_events.dynamo.backend' --out events.jsonl``
+
+Replay: :func:`load_router_events` turns a recorded KV-event file back
+into RouterEvent objects, so recorded workloads can drive a RadixIndexer
+offline (router evaluation / regression analysis).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+import time
+from typing import Iterator
+
+import msgpack
+
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+log = get_logger("recorder")
+
+
+class StreamRecorder:
+    """Subscribes to one coordinator pub/sub subject; writes one JSON line
+    per message: {"t": ..., "subject": ..., "payload": ...}."""
+
+    def __init__(self, coord, subject: str, path: str):
+        self.coord = coord
+        self.subject = subject
+        self.path = path
+        self.count = 0
+        self._task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        sub = await self.coord.subscribe(self.subject)
+        self._task = asyncio.ensure_future(self._loop(sub))
+
+    async def _loop(self, sub) -> None:
+        loop = asyncio.get_running_loop()
+        with open(self.path, "a") as f:
+            async for subject, payload in sub:
+                try:
+                    obj = msgpack.unpackb(payload, raw=False)
+                except Exception:
+                    obj = {"_raw_hex": payload.hex()}
+                line = json.dumps({
+                    "t": time.time(), "subject": subject, "payload": obj,
+                }, default=str) + "\n"
+                # Off-loop: recording must not stall the process's event loop.
+                await loop.run_in_executor(None, lambda: (f.write(line), f.flush()))
+                self.count += 1
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+
+def iter_records(path: str) -> Iterator[dict]:
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                yield json.loads(line)
+
+
+def load_router_events(path: str) -> list:
+    """Recorded kv_events file → RouterEvent list (replayable into a
+    RadixIndexer for offline router evaluation)."""
+    from dynamo_tpu.router.events import RouterEvent
+
+    out = []
+    for rec in iter_records(path):
+        payload = rec.get("payload")
+        if isinstance(payload, list):
+            for d in payload:
+                try:
+                    out.append(RouterEvent.from_dict(d))
+                except Exception:
+                    log.warning("skipping malformed event record")
+    return out
+
+
+async def amain(ns: argparse.Namespace) -> None:
+    from dynamo_tpu.transports.client import CoordinatorClient
+
+    coord = await CoordinatorClient.connect(ns.coordinator)
+    recorders = [StreamRecorder(coord, s, ns.out) for s in ns.subject]
+    for r in recorders:
+        await r.start()
+    log.info("recording %s -> %s", ns.subject, ns.out)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    for r in recorders:
+        await r.stop()
+    await coord.close()
+    log.info("recorded %d messages", sum(r.count for r in recorders))
+
+
+def main() -> None:
+    configure_logging()
+    p = argparse.ArgumentParser("dynamo-recorder")
+    p.add_argument("--coordinator", required=True)
+    p.add_argument("--subject", action="append", required=True,
+                   help="pub/sub subject (repeatable), e.g. kv_events.dynamo.backend")
+    p.add_argument("--out", required=True, help="JSONL output path")
+    asyncio.run(amain(p.parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -211,3 +211,29 @@ async def test_no_instances_error():
         await client.close()
         await rt.shutdown()
         await server.stop()
+
+
+async def test_system_status_server():
+    """Env-gated per-process status server (reference:
+    system_status_server.rs): /health with provider sections, /live,
+    /metrics with exported numeric stats."""
+    import aiohttp
+
+    server = CoordinatorServer()
+    await server.start()
+    rt = await DistributedRuntime.create(RuntimeConfig(
+        coordinator_url=server.url, system_enabled=True, system_port=0))
+    try:
+        rt.status_server.add_provider("engine", lambda: {"kv_usage": 0.25,
+                                                         "num_running": 3})
+        base = f"http://127.0.0.1:{rt.status_server.port}"
+        async with aiohttp.ClientSession() as s:
+            h = await (await s.get(f"{base}/health")).json()
+            assert h["status"] == "ready"
+            assert h["engine"]["num_running"] == 3
+            assert (await s.get(f"{base}/live")).status == 200
+            m = await (await s.get(f"{base}/metrics")).text()
+            assert "dynamo_engine_kv_usage 0.25" in m
+    finally:
+        await rt.shutdown()
+        await server.stop()
