@@ -160,6 +160,11 @@ async def amain(ns: argparse.Namespace) -> None:
 
         await loop.run_in_executor(None, op_channel.accept_followers)
 
+    if rt.status_server is not None:
+        # NotReady until the endpoint actually serves — model loading can
+        # take minutes and a readiness probe must not pass before it.
+        rt.status_server.ready = False
+
     publisher = None
     if not ns.no_kv_events:
         publisher = KvEventPublisher(
@@ -318,6 +323,7 @@ async def amain(ns: argparse.Namespace) -> None:
     if monitor is not None:
         monitor.start()
     if rt.status_server is not None:
+        rt.status_server.ready = True
         rt.status_server.add_provider("engine", stats_fn)
         if monitor is not None:
             # k8s readiness mirrors the canary state (reference: the system

@@ -124,7 +124,11 @@ class ModelRunner:
         else:
             from dynamo_tpu.models.loader import has_weights, load_params
 
-            if has_weights(engine_cfg.model):
+            if engine_cfg.model.endswith(".gguf"):
+                from dynamo_tpu.models.gguf import load_params_gguf
+
+                _, self.params = load_params_gguf(engine_cfg.model, mesh=mesh)
+            elif has_weights(engine_cfg.model):
                 self.params = load_params(cfg, engine_cfg.model, mesh=mesh)
             else:
                 from dynamo_tpu.models.config import MODEL_PRESETS

@@ -395,6 +395,14 @@ class HttpService:
             prev = now
             if eo.error:
                 self._requests.inc(route=route, status="500")
+                if chat and self._audit.bus() is not None:
+                    # Anomalous requests are exactly what a compliance log
+                    # must not miss (the streaming path audits from finally).
+                    self._audit.publish(self._audit.AuditRecord(
+                        request_id=pre.request_id, model=req.model,
+                        requested_streaming=False,
+                        request=req.model_dump(exclude_none=True),
+                        error=eo.error))
                 return _error(500, eo.error)
             out = backend.step(eo)
             outs.append(out)
@@ -432,6 +440,7 @@ class HttpService:
         prev = t_start
         ntokens = 0
         audit_text: list[str] = []
+        audit_tool_calls: list = []
         audit_error: str | None = None
         try:
             if chat:
@@ -464,10 +473,14 @@ class HttpService:
                                 await resp.write(encode_sse_json(gen.reasoning_chunk(fin.reasoning)))
                             if fin.tool_calls:
                                 if tail:
+                                    audit_text.append(tail)
                                     await resp.write(encode_sse_json(gen.chunk(
                                         BackendOutput(text=tail, token_ids=out.token_ids))))
                                 else:
                                     gen.completion_tokens += len(out.token_ids)
+                                audit_tool_calls.extend(
+                                    c.to_openai(index=i)
+                                    for i, c in enumerate(fin.tool_calls))
                                 await resp.write(encode_sse_json(gen.tool_calls_chunk(fin.tool_calls)))
                                 if backend.hit_stop:
                                     break
@@ -505,10 +518,13 @@ class HttpService:
                 if fin.reasoning:
                     await resp.write(encode_sse_json(gen.reasoning_chunk(fin.reasoning)))
                 if fin.content:
+                    audit_text.append(fin.content)
                     tail_chunk = gen.chunk(BackendOutput(text=fin.content))
                     if tail_chunk is not None:
                         await resp.write(encode_sse_json(tail_chunk))
                 if fin.tool_calls:
+                    audit_tool_calls.extend(
+                        c.to_openai(index=i) for i, c in enumerate(fin.tool_calls))
                     await resp.write(encode_sse_json(gen.tool_calls_chunk(fin.tool_calls)))
             if (req.stream_options or {}).get("include_usage"):
                 # OpenAI include_usage shape: final chunk, empty choices.
@@ -544,6 +560,7 @@ class HttpService:
                     requested_streaming=True,
                     request=req.model_dump(exclude_none=True),
                     response={"content": "".join(audit_text),
+                              "tool_calls": audit_tool_calls or None,
                               "completion_tokens": gen.completion_tokens},
                     error=audit_error))
         return resp

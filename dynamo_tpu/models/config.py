@@ -183,6 +183,10 @@ def resolve_model_config(name_or_path: str) -> ModelConfig:
     if name_or_path in MODEL_PRESETS:
         return MODEL_PRESETS[name_or_path]
     p = Path(name_or_path)
+    if p.is_file() and p.suffix == ".gguf":
+        from dynamo_tpu.models.gguf import GGUFReader
+
+        return GGUFReader(p).config()
     if p.is_dir() and (p / "config.json").exists():
         return ModelConfig.from_hf_config(name_or_path)
     raise ValueError(f"unknown model: {name_or_path!r} (presets: {sorted(MODEL_PRESETS)})")

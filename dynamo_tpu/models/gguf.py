@@ -1,0 +1,315 @@
+"""GGUF checkpoint support: parse the container, map llama tensors.
+
+Fills the role of the reference's GGUF front door
+(reference: lib/llm/src/gguf.rs:1-924 — container probe, metadata read,
+llama-family tensor mapping for its in-process engines).
+
+Container layout (GGUF v2/v3): magic ``GGUF`` + version, tensor count,
+metadata KV section (typed values incl. nested arrays), tensor info table
+(name, dims, ggml type, offset), alignment padding, then raw tensor data.
+GGML stores dims innermost-first, so a torch/HF ``[out, in]`` matrix
+appears as ``ne=[in, out]`` with identical row-major bytes — reading with
+``reshape(dims[::-1])`` recovers the ``[out, in]`` view, after which the
+same transpose convention as the safetensors loader applies.
+
+Scope: F32/F16/BF16 tensors (quantized GGML blocks are rejected with a
+clear error — dequantization is a later step); llama-family metadata →
+:class:`~dynamo_tpu.models.config.ModelConfig`. ``save_gguf`` writes the
+same subset, used by tests and by tools that re-export checkpoints.
+"""
+
+from __future__ import annotations
+
+import mmap
+import struct
+from pathlib import Path
+from typing import Any, BinaryIO
+
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("gguf")
+
+MAGIC = b"GGUF"
+
+# metadata value types
+_U8, _I8, _U16, _I16, _U32, _I32, _F32, _BOOL, _STR, _ARR, _U64, _I64, _F64 = range(13)
+_SCALAR_FMT = {_U8: "<B", _I8: "<b", _U16: "<H", _I16: "<h", _U32: "<I",
+               _I32: "<i", _F32: "<f", _BOOL: "<?", _U64: "<Q", _I64: "<q",
+               _F64: "<d"}
+
+# ggml tensor types we can read losslessly
+GGML_F32, GGML_F16 = 0, 1
+GGML_BF16 = 30
+try:
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16 = None
+_TENSOR_DTYPES: dict[int, np.dtype] = {
+    GGML_F32: np.dtype(np.float32),
+    GGML_F16: np.dtype(np.float16),
+}
+if _BF16 is not None:
+    _TENSOR_DTYPES[GGML_BF16] = _BF16
+
+ALIGNMENT_KEY = "general.alignment"
+DEFAULT_ALIGNMENT = 32
+
+
+class GGUFReader:
+    """mmap-backed reader: metadata dict + zero-copy tensor views."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        with open(self.path, "rb") as f:
+            self._mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        self._pos = 0
+        if self._read(4) != MAGIC:
+            raise ValueError(f"{self.path}: not a GGUF file (bad magic)")
+        self.version = self._scalar("<I")
+        if self.version not in (2, 3):
+            raise ValueError(f"unsupported GGUF version {self.version}")
+        n_tensors = self._scalar("<Q")
+        n_kv = self._scalar("<Q")
+        self.metadata: dict[str, Any] = {}
+        for _ in range(n_kv):
+            key = self._string()
+            self.metadata[key] = self._value(self._scalar("<I"))
+        self._tensors: dict[str, tuple[tuple[int, ...], int, int]] = {}
+        for _ in range(n_tensors):
+            name = self._string()
+            n_dims = self._scalar("<I")
+            dims = tuple(self._scalar("<Q") for _ in range(n_dims))
+            ggml_type = self._scalar("<I")
+            offset = self._scalar("<Q")
+            self._tensors[name] = (dims, ggml_type, offset)
+        align = int(self.metadata.get(ALIGNMENT_KEY, DEFAULT_ALIGNMENT))
+        self._data_base = -(-self._pos // align) * align
+
+    # -- low-level parsing --------------------------------------------------
+    def _read(self, n: int) -> bytes:
+        out = self._mm[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
+    def _scalar(self, fmt: str):
+        (v,) = struct.unpack(fmt, self._read(struct.calcsize(fmt)))
+        return v
+
+    def _string(self) -> str:
+        n = self._scalar("<Q")
+        return self._read(n).decode("utf-8", errors="replace")
+
+    def _value(self, vtype: int):
+        if vtype == _STR:
+            return self._string()
+        if vtype == _ARR:
+            etype = self._scalar("<I")
+            n = self._scalar("<Q")
+            return [self._value(etype) for _ in range(n)]
+        fmt = _SCALAR_FMT.get(vtype)
+        if fmt is None:
+            raise ValueError(f"unknown GGUF metadata value type {vtype}")
+        return self._scalar(fmt)
+
+    # -- public surface -----------------------------------------------------
+    def names(self) -> list[str]:
+        return list(self._tensors)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._tensors
+
+    def tensor(self, name: str) -> np.ndarray:
+        """Zero-copy view in numpy convention (outermost dim first)."""
+        dims, ggml_type, offset = self._tensors[name]
+        dtype = _TENSOR_DTYPES.get(ggml_type)
+        if dtype is None:
+            raise ValueError(
+                f"tensor {name!r} uses ggml type {ggml_type} (quantized?); "
+                "only F32/F16/BF16 GGUF tensors are supported — requantize "
+                "or convert the checkpoint")
+        count = int(np.prod(dims)) if dims else 1
+        arr = np.frombuffer(self._mm, dtype=dtype, count=count,
+                            offset=self._data_base + offset)
+        # GGML dims are innermost-first; reverse for the numpy view.
+        return arr.reshape(tuple(reversed(dims)))
+
+    def architecture(self) -> str:
+        return str(self.metadata.get("general.architecture", ""))
+
+    def config(self) -> ModelConfig:
+        """llama-family metadata → engine ModelConfig."""
+        arch = self.architecture()
+        if arch != "llama":
+            raise ValueError(f"unsupported GGUF architecture {arch!r}")
+        md = self.metadata
+
+        def req(key: str):
+            if f"{arch}.{key}" not in md:
+                raise ValueError(f"GGUF missing {arch}.{key}")
+            return md[f"{arch}.{key}"]
+
+        n_heads = int(req("attention.head_count"))
+        emb = int(req("embedding_length"))
+        vocab = int(md.get(f"{arch}.vocab_size")
+                    or len(md.get("tokenizer.ggml.tokens", []) or [])
+                    or self._tensors["token_embd.weight"][0][1])
+        return ModelConfig(
+            name=self.path.stem,
+            vocab_size=vocab,
+            hidden_size=emb,
+            intermediate_size=int(req("feed_forward_length")),
+            num_layers=int(req("block_count")),
+            num_heads=n_heads,
+            num_kv_heads=int(md.get(f"{arch}.attention.head_count_kv", n_heads)),
+            head_dim=int(md.get(f"{arch}.attention.key_length", emb // n_heads)),
+            rope_theta=float(md.get(f"{arch}.rope.freq_base", 10000.0)),
+            rms_norm_eps=float(md.get(
+                f"{arch}.attention.layer_norm_rms_epsilon", 1e-5)),
+            max_position_embeddings=int(md.get(f"{arch}.context_length", 8192)),
+            tie_word_embeddings="output.weight" not in self._tensors,
+        )
+
+
+def permute_qk(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """HF half-rotate layout → GGUF interleaved-rope layout for attn_q/attn_k
+    rows (llama.cpp convert_hf_to_gguf permute): per head, rows reorder from
+    [evens, odds] halves to interleaved pairs. ``w`` is [out, in]."""
+    out, inn = w.shape
+    return (w.reshape(n_heads, 2, out // n_heads // 2, inn)
+             .swapaxes(1, 2).reshape(out, inn))
+
+
+def unpermute_qk(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """Inverse of :func:`permute_qk`: GGUF checkpoints store Q/K in the
+    interleaved-rope layout; the engine applies HF half-rotate rope
+    (models/llama.rope), so loads must restore the HF row order — without
+    this, every real llama.cpp-produced GGUF generates garbage."""
+    out, inn = w.shape
+    return (w.reshape(n_heads, out // n_heads // 2, 2, inn)
+             .swapaxes(1, 2).reshape(out, inn))
+
+
+# llama.cpp tensor names → (our layer param, transpose-to-[in,out])
+_LAYER_SPECS = {
+    "wq": ("attn_q.weight", True),
+    "wk": ("attn_k.weight", True),
+    "wv": ("attn_v.weight", True),
+    "wo": ("attn_output.weight", True),
+    "attn_norm": ("attn_norm.weight", False),
+    "mlp_norm": ("ffn_norm.weight", False),
+    "w_gate": ("ffn_gate.weight", True),
+    "w_up": ("ffn_up.weight", True),
+    "w_down": ("ffn_down.weight", True),
+}
+
+
+def load_params_gguf(path: str | Path, mesh=None) -> tuple[ModelConfig, dict]:
+    """Read a llama-family GGUF into (config, engine params pytree)."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import param_logical_axes
+    from dynamo_tpu.parallel.mesh import global_put, param_sharding_rules
+
+    reader = GGUFReader(path)
+    cfg = reader.config()
+    dtype = np.dtype(np.float32) if _BF16 is None else _BF16
+    axes = param_logical_axes(cfg)
+
+    def place(arr: np.ndarray, leaf_axes):
+        arr = np.ascontiguousarray(arr, dtype=dtype)
+        if mesh is not None:
+            return global_put(arr, param_sharding_rules(mesh, leaf_axes))
+        return jnp.asarray(arr)
+
+    params: dict = {
+        "embed": place(reader.tensor("token_embd.weight"), axes["embed"]),
+        "final_norm": place(reader.tensor("output_norm.weight"), axes["final_norm"]),
+        "layers": {},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = place(reader.tensor("output.weight").T, axes["lm_head"])
+    L = cfg.num_layers
+    unperm = {"wq": cfg.num_heads, "wk": cfg.num_kv_heads}
+    for our, (suffix, transpose) in _LAYER_SPECS.items():
+        def grab(i: int) -> np.ndarray:
+            t = reader.tensor(f"blk.{i}.{suffix}")
+            if our in unperm:
+                t = unpermute_qk(np.asarray(t, np.float32), unperm[our])
+            return t.T if transpose else t
+
+        first = grab(0)
+        out = np.empty((L, *first.shape), dtype=dtype)
+        out[0] = first
+        for i in range(1, L):
+            out[i] = grab(i)
+        params["layers"][our] = place(out, axes["layers"][our])
+    log.info("loaded GGUF %s: %s (%d layers, vocab %d)",
+             path, cfg.name, cfg.num_layers, cfg.vocab_size)
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# Writer (tests + re-export tooling)
+# ---------------------------------------------------------------------------
+
+def _w_string(f: BinaryIO, s: str) -> None:
+    b = s.encode()
+    f.write(struct.pack("<Q", len(b)) + b)
+
+
+def _w_value(f: BinaryIO, v: Any) -> None:
+    if isinstance(v, bool):
+        f.write(struct.pack("<I", _BOOL) + struct.pack("<?", v))
+    elif isinstance(v, int):
+        f.write(struct.pack("<I", _U64) + struct.pack("<Q", v))
+    elif isinstance(v, float):
+        f.write(struct.pack("<I", _F32) + struct.pack("<f", v))
+    elif isinstance(v, str):
+        f.write(struct.pack("<I", _STR))
+        _w_string(f, v)
+    elif isinstance(v, list):
+        f.write(struct.pack("<I", _ARR))
+        f.write(struct.pack("<I", _STR) + struct.pack("<Q", len(v)))
+        for item in v:
+            _w_string(f, str(item))
+    else:
+        raise TypeError(f"unsupported metadata value {type(v)}")
+
+
+def save_gguf(path: str | Path, metadata: dict[str, Any],
+              tensors: dict[str, np.ndarray]) -> None:
+    """Write a GGUF v3 file (F32/F16/BF16 tensors, numpy-convention shapes)."""
+    rev_types = {np.dtype(np.float32): GGML_F32, np.dtype(np.float16): GGML_F16}
+    if _BF16 is not None:
+        rev_types[_BF16] = GGML_BF16
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<I", 3))
+        f.write(struct.pack("<Q", len(tensors)))
+        f.write(struct.pack("<Q", len(metadata)))
+        for k, v in metadata.items():
+            _w_string(f, k)
+            _w_value(f, v)
+        offset = 0
+        blobs: list[bytes] = []
+        for name, arr in tensors.items():
+            arr = np.ascontiguousarray(arr)
+            _w_string(f, name)
+            dims = tuple(reversed(arr.shape))  # ggml: innermost first
+            f.write(struct.pack("<I", len(dims)))
+            for d in dims:
+                f.write(struct.pack("<Q", d))
+            f.write(struct.pack("<I", rev_types[np.dtype(arr.dtype)]))
+            f.write(struct.pack("<Q", offset))
+            blob = arr.tobytes()
+            pad = (-len(blob)) % DEFAULT_ALIGNMENT
+            blobs.append(blob + b"\0" * pad)
+            offset += len(blob) + pad
+        f.write(b"\0" * ((-f.tell()) % DEFAULT_ALIGNMENT))
+        for blob in blobs:
+            f.write(blob)

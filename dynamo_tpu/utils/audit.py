@@ -107,12 +107,22 @@ class JsonlAuditSink:
         import asyncio as _asyncio
 
         loop = _asyncio.get_running_loop()
-        with open(self.path, "a") as f:
-            async for rec in self._sub:
-                line = rec.to_json() + "\n"
-                # Disk writes off-loop: a slow/full filesystem must not
-                # stall the serving event loop this sink shares.
-                await loop.run_in_executor(None, lambda: (f.write(line), f.flush()))
+        try:
+            with open(self.path, "a") as f:
+                async for rec in self._sub:
+                    line = rec.to_json() + "\n"
+                    # Disk writes off-loop: a slow/full filesystem must not
+                    # stall the serving event loop this sink shares.
+                    await loop.run_in_executor(
+                        None, lambda: (f.write(line), f.flush()))
+        except _asyncio.CancelledError:
+            raise
+        except Exception:
+            # A dead compliance sink must be LOUD — records keep dropping
+            # into this subscriber's queue while the operator believes
+            # auditing is on.
+            log.exception("audit JSONL sink died (%s); records are NOT "
+                          "being persisted", self.path)
 
     async def stop(self) -> None:
         self._sub.cancel()

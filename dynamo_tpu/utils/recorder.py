@@ -30,14 +30,35 @@ from dynamo_tpu.utils.logging import configure_logging, get_logger
 log = get_logger("recorder")
 
 
+class _SharedWriter:
+    """One file handle + asyncio lock per output path: recorders for
+    multiple subjects appending to the same file cannot interleave lines."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "a")
+        self._lock = asyncio.Lock()
+
+    async def write_line(self, line: str) -> None:
+        loop = asyncio.get_running_loop()
+        async with self._lock:
+            await loop.run_in_executor(
+                None, lambda: (self._f.write(line), self._f.flush()))
+
+    def close(self) -> None:
+        self._f.close()
+
+
 class StreamRecorder:
     """Subscribes to one coordinator pub/sub subject; writes one JSON line
     per message: {"t": ..., "subject": ..., "payload": ...}."""
 
-    def __init__(self, coord, subject: str, path: str):
+    def __init__(self, coord, subject: str, path: str,
+                 writer: "_SharedWriter | None" = None):
         self.coord = coord
         self.subject = subject
         self.path = path
+        self._writer = writer or _SharedWriter(path)
+        self._owns_writer = writer is None
         self.count = 0
         self._task: asyncio.Task | None = None
 
@@ -46,19 +67,18 @@ class StreamRecorder:
         self._task = asyncio.ensure_future(self._loop(sub))
 
     async def _loop(self, sub) -> None:
-        loop = asyncio.get_running_loop()
-        with open(self.path, "a") as f:
-            async for subject, payload in sub:
-                try:
-                    obj = msgpack.unpackb(payload, raw=False)
-                except Exception:
-                    obj = {"_raw_hex": payload.hex()}
-                line = json.dumps({
-                    "t": time.time(), "subject": subject, "payload": obj,
-                }, default=str) + "\n"
-                # Off-loop: recording must not stall the process's event loop.
-                await loop.run_in_executor(None, lambda: (f.write(line), f.flush()))
-                self.count += 1
+        async for subject, payload in sub:
+            try:
+                obj = msgpack.unpackb(payload, raw=False)
+            except Exception:
+                obj = {"_raw_hex": payload.hex()}
+            line = json.dumps({
+                "t": time.time(), "subject": subject, "payload": obj,
+            }, default=str) + "\n"
+            # Off-loop + per-file locked: recording must neither stall the
+            # event loop nor interleave lines across subjects.
+            await self._writer.write_line(line)
+            self.count += 1
 
     async def stop(self) -> None:
         if self._task:
@@ -67,6 +87,8 @@ class StreamRecorder:
                 await self._task
             except (asyncio.CancelledError, Exception):
                 pass
+        if self._owns_writer:
+            self._writer.close()
 
 
 def iter_records(path: str) -> Iterator[dict]:
@@ -98,7 +120,9 @@ async def amain(ns: argparse.Namespace) -> None:
     from dynamo_tpu.transports.client import CoordinatorClient
 
     coord = await CoordinatorClient.connect(ns.coordinator)
-    recorders = [StreamRecorder(coord, s, ns.out) for s in ns.subject]
+    writer = _SharedWriter(ns.out)
+    recorders = [StreamRecorder(coord, s, ns.out, writer=writer)
+                 for s in ns.subject]
     for r in recorders:
         await r.start()
     log.info("recording %s -> %s", ns.subject, ns.out)
@@ -109,6 +133,7 @@ async def amain(ns: argparse.Namespace) -> None:
     await stop.wait()
     for r in recorders:
         await r.stop()
+    writer.close()
     await coord.close()
     log.info("recorded %d messages", sum(r.count for r in recorders))
 
