@@ -346,11 +346,22 @@ class HttpService:
             self._requests.inc(route=route, status="400")
             return _error(400, f"preprocessing failed: {exc}")
 
+        if req.n > 1:
+            # Validate here, before the per-model counters tick — a rejected
+            # request must not inflate load metrics.
+            if req.stream:
+                self._requests.inc(route=route, status="400")
+                return _error(400, "n>1 with stream=true is not supported")
+            if req.n > 16:
+                self._requests.inc(route=route, status="400")
+                return _error(400, "n must be <= 16")
         self._inflight.inc(model=req.model)
         self._input_tokens.inc(len(pre.token_ids), model=req.model)
         self._model_requests.inc(model=req.model)
         t_start = time.monotonic()
         try:
+            if req.n > 1:
+                return await self._aggregate_n(req, entry, pre, chat, t_start, route)
             if req.stream:
                 return await self._stream_response(request, req, entry, pre, chat, t_start)
             return await self._aggregate_response(req, entry, pre, chat, t_start, route)
@@ -378,6 +389,85 @@ class HttpService:
         from dynamo_tpu.parsers import StreamJail
 
         return StreamJail(tool_cfg=tool_cfg, reasoning=reasoning)
+
+    async def _aggregate_n(self, req, entry: ModelEntry, pre, chat: bool,
+                           t_start: float, route: str) -> web.Response:
+        """n>1: run n INDEPENDENT generations concurrently (they batch
+        together inside the engine's continuous scheduler) and merge their
+        choices. Distinct request ids give each its own sampling slot;
+        an explicit seed offsets per choice so results are reproducible yet
+        diverse (reference gap: the thin OpenAI surface had no n>1)."""
+        import copy
+
+        async def one(i: int):
+            sub = copy.deepcopy(pre)
+            sub.request_id = f"{pre.request_id}-n{i}"
+            if sub.sampling_options.seed is not None:
+                sub.sampling_options.seed += i
+            backend = DetokenizerBackend(entry.tokenizer,
+                                         stops=sub.stop_conditions.stop)
+            outs: list[BackendOutput] = []
+            first = True
+            prev = time.monotonic()
+            async for eo in entry.generate(sub):
+                now = time.monotonic()
+                if eo.token_ids:
+                    if first:
+                        self._ttft.observe(now - t_start, model=req.model)
+                        first = False
+                    else:
+                        self._itl.observe(now - prev, model=req.model)
+                    prev = now
+                if eo.error:
+                    raise RuntimeError(eo.error)
+                outs.append(backend.step(eo))
+                if backend.hit_stop:
+                    break
+            return outs
+
+        tasks = [asyncio.ensure_future(one(i)) for i in range(req.n)]
+        error: str | None = None
+        try:
+            all_outs = await asyncio.gather(*tasks)
+        except Exception as exc:  # noqa: BLE001 - engine error
+            # Cancel the siblings: detached generations would keep consuming
+            # scheduler slots and KV blocks after the client already got 500.
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            error = str(exc)
+        if error is not None:
+            if chat and self._audit.bus() is not None:
+                self._audit.publish(self._audit.AuditRecord(
+                    request_id=pre.request_id, model=req.model,
+                    request=req.model_dump(exclude_none=True), error=error))
+            self._requests.inc(route=route, status="500")
+            return _error(500, error)
+        n_prompt = len(pre.token_ids)
+        agg = ((lambda outs: aggregate_chat(req.model, outs, n_prompt,
+                                            jail=self._make_jail(entry, req)))
+               if chat else
+               (lambda outs: aggregate_completion(req.model, outs, n_prompt)))
+        parts = [agg(outs) for outs in all_outs]
+        resp = parts[0]
+        for i, part in enumerate(parts):
+            part.choices[0].index = i
+        resp.choices = [p.choices[0] for p in parts]
+        from dynamo_tpu.protocols.openai import Usage
+
+        total_out = sum(sum(len(o.token_ids) for o in outs) for outs in all_outs)
+        resp.usage = Usage(
+            prompt_tokens=n_prompt, completion_tokens=total_out,
+            total_tokens=n_prompt + total_out)
+        if chat and self._audit.bus() is not None:
+            self._audit.publish(self._audit.AuditRecord(
+                request_id=pre.request_id, model=req.model,
+                request=req.model_dump(exclude_none=True),
+                response=resp.model_dump(exclude_none=True)))
+        self._output_tokens.inc(total_out, model=req.model)
+        self._requests.inc(route=route, status="200")
+        return web.Response(text=resp.model_dump_json(exclude_none=True),
+                            content_type="application/json")
 
     async def _aggregate_response(self, req, entry: ModelEntry, pre, chat: bool,
                                   t_start: float, route: str) -> web.Response:

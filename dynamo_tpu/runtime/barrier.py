@@ -1,0 +1,68 @@
+"""Leader-worker barrier: multi-process rendezvous over the coordinator.
+
+Fills the role of the reference's etcd leader-worker barrier
+(reference: lib/runtime/src/utils/leader_worker_barrier.rs:14-50 — the
+leader posts data under a barrier id and waits for N workers to check in;
+workers post themselves and wait for the leader's ``complete`` key).
+
+Used wherever N processes must meet before proceeding (multi-host engine
+bring-up, KVBM leader/worker handshakes). Keys live under
+``barrier/{id}/...`` and are lease-bound when a lease id is given, so a
+crashed participant's state evaporates with its lease.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any
+
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("barrier")
+
+ROOT = "barrier"
+
+
+class BarrierTimeout(TimeoutError):
+    pass
+
+
+async def leader_barrier(client, barrier_id: str, num_workers: int,
+                         data: Any = None, timeout: float = 120.0,
+                         lease_id: int = 0) -> list[str]:
+    """Leader side: publish ``data``, wait for ``num_workers`` check-ins,
+    then post the completion marker. Returns the worker names seen."""
+    await client.put(f"{ROOT}/{barrier_id}/data",
+                     json.dumps(data).encode(), lease_id)
+    prefix = f"{ROOT}/{barrier_id}/workers/"
+    deadline = time.monotonic() + timeout
+    while True:
+        got = await client.get_prefix(prefix)
+        if len(got) >= num_workers:
+            await client.put(f"{ROOT}/{barrier_id}/complete", b"1", lease_id)
+            return [k[len(prefix):] for k in got]
+        if time.monotonic() > deadline:
+            raise BarrierTimeout(
+                f"barrier {barrier_id!r}: {len(got)}/{num_workers} workers "
+                f"within {timeout}s ({sorted(k[len(prefix):] for k in got)})")
+        await asyncio.sleep(0.1)
+
+
+async def worker_barrier(client, barrier_id: str, worker_name: str,
+                         timeout: float = 120.0, lease_id: int = 0) -> Any:
+    """Worker side: check in, wait for the leader's completion marker, and
+    return the leader's published data."""
+    await client.put(f"{ROOT}/{barrier_id}/workers/{worker_name}",
+                     b"1", lease_id)
+    deadline = time.monotonic() + timeout
+    while True:
+        if await client.get(f"{ROOT}/{barrier_id}/complete"):
+            blob = await client.get(f"{ROOT}/{barrier_id}/data")
+            return json.loads(blob.decode()) if blob else None
+        if time.monotonic() > deadline:
+            raise BarrierTimeout(
+                f"barrier {barrier_id!r}: leader did not complete within "
+                f"{timeout}s")
+        await asyncio.sleep(0.1)

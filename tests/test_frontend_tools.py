@@ -151,3 +151,29 @@ async def test_aggregate_reasoning_content():
         assert msg["content"] == "The capital is Paris."
     finally:
         await svc.stop()
+
+
+async def test_n_greater_than_one():
+    """n>1 returns n indexed choices (reference gap: OpenAI surface had no
+    n>1); greedy choices are identical, streaming n>1 is rejected."""
+    svc, base = await _serve("same text")
+    try:
+        body = {"model": "m", "messages": [{"role": "user", "content": "q"}],
+                "n": 3}
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+                data = await r.json()
+        assert [c["index"] for c in data["choices"]] == [0, 1, 2]
+        assert all(c["message"]["content"] == "same text" for c in data["choices"])
+        assert data["usage"]["completion_tokens"] == 3 * len(
+            "same text".encode())
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions",
+                              json=dict(body, stream=True)) as r:
+                assert r.status == 400
+            async with s.post(f"{base}/v1/chat/completions",
+                              json=dict(body, n=99)) as r:
+                assert r.status == 400
+    finally:
+        await svc.stop()

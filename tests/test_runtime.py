@@ -237,3 +237,39 @@ async def test_system_status_server():
     finally:
         await rt.shutdown()
         await server.stop()
+
+
+async def test_leader_worker_barrier():
+    """Multi-process rendezvous (reference: leader_worker_barrier.rs:14-50):
+    leader posts data, waits for N workers; workers get the data back."""
+    import asyncio
+
+    from dynamo_tpu.runtime.barrier import (
+        BarrierTimeout,
+        leader_barrier,
+        worker_barrier,
+    )
+    from dynamo_tpu.transports.client import CoordinatorClient
+
+    server = CoordinatorServer()
+    await server.start()
+    leader = await CoordinatorClient.connect(server.url)
+    w1 = await CoordinatorClient.connect(server.url)
+    w2 = await CoordinatorClient.connect(server.url)
+    try:
+        results = await asyncio.gather(
+            leader_barrier(leader, "boot", 2, data={"addr": "h:1"}, timeout=10),
+            worker_barrier(w1, "boot", "w1", timeout=10),
+            worker_barrier(w2, "boot", "w2", timeout=10),
+        )
+        assert sorted(results[0]) == ["w1", "w2"]
+        assert results[1] == {"addr": "h:1"} and results[2] == {"addr": "h:1"}
+
+        # missing workers time out loudly
+        with pytest.raises(BarrierTimeout):
+            await leader_barrier(leader, "short", 3, timeout=0.5)
+    finally:
+        await leader.close()
+        await w1.close()
+        await w2.close()
+        await server.stop()
