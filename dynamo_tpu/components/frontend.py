@@ -40,6 +40,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--kv-overlap-weight", type=float, default=1.0)
     p.add_argument("--router-temperature", type=float, default=0.0)
     p.add_argument("--migration-limit", type=int, default=3)
+    p.add_argument("--grpc-port", type=int, default=None,
+                   help="also serve the KServe v2 gRPC protocol on this port "
+                        "(0 = ephemeral; omitted = gRPC disabled)")
     return p.parse_args(argv)
 
 
@@ -174,6 +177,14 @@ async def amain(ns: argparse.Namespace) -> None:
     await watcher.start()
     svc = HttpService(models)
     port = await svc.start(ns.host, ns.port)
+    grpc_srv = None
+    if ns.grpc_port is not None:
+        from dynamo_tpu.frontend.kserve_grpc import KServeGrpcServer
+
+        grpc_srv = KServeGrpcServer(models, service=svc)
+        gport = await grpc_srv.start(ns.host, ns.grpc_port)
+        log.info("kserve grpc ready on :%d", gport)
+        print(f"FRONTEND_GRPC_READY port={gport}", flush=True)
     log.info("frontend ready on :%d (router=%s)", port, ns.router_mode)
     print(f"FRONTEND_READY port={port}", flush=True)
 
@@ -182,6 +193,8 @@ async def amain(ns: argparse.Namespace) -> None:
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if grpc_srv is not None:
+        await grpc_srv.stop()
     await svc.stop()
     await rt.shutdown()
 

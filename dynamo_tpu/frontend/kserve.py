@@ -4,11 +4,13 @@ Fills the role of the reference's KServe gRPC service
 (reference: lib/llm/src/grpc/service/kserve.rs — ModelInfer with the
 Triton LLM tensor convention: BYTES ``text_input`` [1] in,
 ``text_output`` out, BOOL ``streaming`` flag, kserve.rs:446-546;
-input validation mirrored from grpc/service/openai.rs:206-260). The
-environment ships no grpcio, so this implements the SAME v2 protocol in
-its standardized HTTP/REST binding (plus Triton's LLM extension
-endpoints ``/generate`` and ``/generate_stream`` for streaming, which
-the REST flavor of ModelInfer does not cover):
+input validation mirrored from grpc/service/openai.rs:206-260). This is
+the v2 protocol's standardized HTTP/REST binding (plus Triton's LLM
+extension endpoints ``/generate`` and ``/generate_stream`` for
+streaming, which the REST flavor of ModelInfer does not cover); the
+native gRPC binding of the same protocol lives in
+``frontend/kserve_grpc.py`` and shares this module's tensor conventions
+and parameter mapping:
 
     GET  /v2/health/live | /v2/health/ready
     GET  /v2/models/{name}          (metadata: tensor signature)
@@ -99,6 +101,54 @@ def _parse_infer_inputs(body: dict) -> tuple[str, bool]:
     return text, streaming
 
 
+async def collect_text(entry, pre, model: str, svc=None,
+                       on_delta=None) -> tuple[str, str]:
+    """Drive the full pipeline to completion; returns (text, finish_reason).
+
+    The one collection loop behind BOTH v2 bindings (REST unary infer /
+    generate and the gRPC ModelInfer / ModelStreamInfer paths), so
+    stop/finish semantics and the frontend metric accounting
+    (inflight, input/output tokens, TTFT) cannot drift between them.
+    ``on_delta(text, finish_reason | None)`` is awaited per detokenized
+    delta when given (the streaming flavor); the aggregated text is
+    returned either way."""
+    import time as _time
+
+    backend = DetokenizerBackend(entry.tokenizer, stops=pre.stop_conditions.stop)
+    pieces: list[str] = []
+    finish = "stop"
+    if svc is not None:
+        svc._inflight.inc(model=model)
+        svc._input_tokens.inc(len(pre.token_ids), model=model)
+    t0 = _time.monotonic()
+    first = True
+    n_out = 0
+    try:
+        async for eo in entry.generate(pre):
+            if eo.error:
+                raise RuntimeError(eo.error)
+            if first and eo.token_ids and svc is not None:
+                svc._ttft.observe(_time.monotonic() - t0, model=model)
+                first = False
+            n_out += len(eo.token_ids)
+            out = backend.step(eo)
+            if out.text:
+                pieces.append(out.text)
+            if out.finish_reason is not None:
+                finish = str(out.finish_reason)
+            if on_delta is not None and (out.text or out.finish_reason is not None):
+                await on_delta(out.text, str(out.finish_reason)
+                               if out.finish_reason is not None else None)
+            if backend.hit_stop:
+                break
+    finally:
+        if svc is not None:
+            svc._inflight.inc(-1, model=model)
+            svc._output_tokens.inc(n_out, model=model)
+            svc._model_requests.inc(model=model)
+    return "".join(pieces), finish
+
+
 class KServeFrontend:
     """v2-protocol routes over a ModelManager. ``service`` (the owning
     HttpService) supplies the frontend metric instruments so /v2 traffic
@@ -167,40 +217,7 @@ class KServeFrontend:
             raise ValueError(f"invalid parameters: {exc}") from exc
 
     async def _run(self, entry, pre, model: str) -> tuple[str, str]:
-        """Drive the full pipeline to completion; returns (text, finish_reason)."""
-        import time as _time
-
-        backend = DetokenizerBackend(entry.tokenizer, stops=pre.stop_conditions.stop)
-        pieces: list[str] = []
-        finish = "stop"
-        svc = self._svc
-        if svc is not None:
-            svc._inflight.inc(model=model)
-            svc._input_tokens.inc(len(pre.token_ids), model=model)
-        t0 = _time.monotonic()
-        first = True
-        n_out = 0
-        try:
-            async for eo in entry.generate(pre):
-                if eo.error:
-                    raise RuntimeError(eo.error)
-                if first and eo.token_ids and svc is not None:
-                    svc._ttft.observe(_time.monotonic() - t0, model=model)
-                    first = False
-                n_out += len(eo.token_ids)
-                out = backend.step(eo)
-                if out.text:
-                    pieces.append(out.text)
-                if out.finish_reason is not None:
-                    finish = str(out.finish_reason)
-                if backend.hit_stop:
-                    break
-        finally:
-            if svc is not None:
-                svc._inflight.inc(-1, model=model)
-                svc._output_tokens.inc(n_out, model=model)
-                svc._model_requests.inc(model=model)
-        return "".join(pieces), finish
+        return await collect_text(entry, pre, model, self._svc)
 
     async def infer(self, request: web.Request) -> web.Response:
         name = request.match_info["name"]

@@ -1,0 +1,348 @@
+"""KServe v2 inference protocol — native gRPC binding.
+
+Fills the role of the reference's tonic KServe service
+(reference: lib/llm/src/grpc/service/kserve.rs — `GRPCInferenceService`
+with ModelInfer + Triton ModelStreamInfer; tensor validation mirrored
+from lib/llm/src/grpc/service/openai.rs:206-260). The REST flavor of the
+same protocol lives in `frontend/kserve.py`; both share the
+text_input/text_output tensor convention, the parameter→sampling
+mapping, and the preprocessor→engine→detokenizer pipeline, so a model
+served on the HTTP port is identically reachable over gRPC.
+
+No `grpc_python_plugin` ships in the image, so instead of generated
+servicer classes the service registers its seven methods through
+`grpc.method_handlers_generic_handler` over the protoc-generated message
+classes (`kserve_pb2.py`) — the wire format is byte-identical to a stub
+build, and standard KServe/Triton gRPC clients interoperate.
+
+Design notes (TPU-first): ModelStreamInfer is the latency-friendly path —
+each streamed request opens an independent generation and deltas are
+written as soon as the engine's pipelined step loop finalizes them, so
+gRPC framing overlaps device compute the same way the SSE path does.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+
+import grpc
+
+from dynamo_tpu.frontend import kserve_pb2 as pb
+from dynamo_tpu.frontend.kserve import (
+    TEXT_INPUT,
+    TEXT_OUTPUT,
+    _sampling_request,
+    collect_text,
+)
+from dynamo_tpu.frontend.model_manager import ModelManager
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("kserve_grpc")
+
+SERVICE = "inference.GRPCInferenceService"
+
+
+def _param_value(p: pb.InferParameter):
+    which = p.WhichOneof("parameter_choice")
+    return getattr(p, which) if which else None
+
+
+def _params_dict(mapping) -> dict:
+    return {k: _param_value(v) for k, v in mapping.items()}
+
+
+def _text_output(model: str, req_id: str, text: str, finish: str | None,
+                 version: str = "1") -> pb.ModelInferResponse:
+    resp = pb.ModelInferResponse(model_name=model, model_version=version, id=req_id)
+    out = resp.outputs.add()
+    out.name = TEXT_OUTPUT
+    out.datatype = "BYTES"
+    out.shape.extend([1])
+    out.contents.bytes_contents.append(text.encode())
+    if finish is not None:
+        fr = resp.outputs.add()
+        fr.name = "finish_reason"
+        fr.datatype = "BYTES"
+        fr.shape.extend([1])
+        fr.contents.bytes_contents.append(finish.encode())
+    return resp
+
+
+def _parse_infer(req: pb.ModelInferRequest) -> tuple[str, bool]:
+    """Validate tensors; returns (text, streaming flag).
+
+    Mirrors the REST binding's `_parse_infer_inputs` and the reference's
+    tensor checks: `text_input` must be BYTES shape [1] (or [1,1]);
+    `streaming`/`stream` must be BOOL shape [1]. Raw tensor contents may
+    arrive either inline (`contents`) or via `raw_input_contents[i]`."""
+    text: str | None = None
+    streaming = False
+    for i, t in enumerate(req.inputs):
+        shape = list(t.shape)
+        if t.name == TEXT_INPUT:
+            if t.datatype != "BYTES":
+                raise ValueError(
+                    f"expected '{TEXT_INPUT}' to be BYTES, got {t.datatype!r}")
+            if shape not in ([1], [1, 1]):
+                raise ValueError(
+                    f"expected '{TEXT_INPUT}' to have shape [1], got {shape}")
+            if t.contents.bytes_contents:
+                text = t.contents.bytes_contents[0].decode("utf-8", "replace")
+            elif i < len(req.raw_input_contents):
+                raw = req.raw_input_contents[i]
+                # raw BYTES tensors carry a 4-byte LE length prefix per element
+                if len(raw) >= 4:
+                    n = int.from_bytes(raw[:4], "little")
+                    text = raw[4:4 + n].decode("utf-8", "replace")
+                else:
+                    raise ValueError(f"malformed raw '{TEXT_INPUT}' tensor")
+            else:
+                raise ValueError(f"'{TEXT_INPUT}' has no contents")
+        elif t.name in ("streaming", "stream"):
+            if t.datatype != "BOOL":
+                raise ValueError(f"expected '{t.name}' to be BOOL")
+            if t.contents.bool_contents:
+                streaming = bool(t.contents.bool_contents[0])
+            elif i < len(req.raw_input_contents):
+                # raw BOOL tensors are 1 byte per element (tritonclient's
+                # set_data_from_numpy uses the raw path by default)
+                raw = req.raw_input_contents[i]
+                streaming = bool(raw and raw[0])
+            else:
+                raise ValueError(f"'{t.name}' has no contents")
+        else:
+            raise ValueError(f"unexpected input tensor {t.name!r}")
+    if text is None:
+        raise ValueError(f"missing required input tensor '{TEXT_INPUT}'")
+    return text, streaming
+
+
+class KServeGrpcService:
+    """The seven GRPCInferenceService methods over a shared ModelManager."""
+
+    def __init__(self, models: ModelManager, service=None):
+        self.models = models
+        self._svc = service  # owning HttpService, for shared frontend metrics
+
+    # -- health / metadata -------------------------------------------------
+    async def server_live(self, request, context) -> pb.ServerLiveResponse:
+        return pb.ServerLiveResponse(live=True)
+
+    async def server_ready(self, request, context) -> pb.ServerReadyResponse:
+        return pb.ServerReadyResponse(ready=len(self.models) > 0)
+
+    async def server_metadata(self, request, context) -> pb.ServerMetadataResponse:
+        return pb.ServerMetadataResponse(
+            name="dynamo_tpu", version="0", extensions=["model_stream_infer"])
+
+    async def model_ready(self, request, context) -> pb.ModelReadyResponse:
+        return pb.ModelReadyResponse(ready=self.models.get(request.name) is not None)
+
+    async def model_metadata(self, request, context) -> pb.ModelMetadataResponse:
+        if self.models.get(request.name) is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"model '{request.name}' not found")
+        resp = pb.ModelMetadataResponse(
+            name=request.name, versions=["1"], platform="dynamo_tpu")
+        for name, dt in ((TEXT_INPUT, "BYTES"), ("streaming", "BOOL")):
+            t = resp.inputs.add()
+            t.name, t.datatype = name, dt
+            t.shape.extend([1])
+        for name in (TEXT_OUTPUT, "finish_reason"):
+            t = resp.outputs.add()
+            t.name, t.datatype = name, "BYTES"
+            t.shape.extend([1])
+        return resp
+
+    # -- inference ---------------------------------------------------------
+    def _prepare(self, req: pb.ModelInferRequest, rid: str):
+        """(entry, preprocessed, streaming) or raises ValueError/KeyError.
+        ``rid`` is the caller-chosen request id — the SAME id tags the
+        engine-side request and the response, so client-visible ids
+        correlate with server logs/audit."""
+        entry = self.models.get(req.model_name)
+        if entry is None:
+            raise KeyError(req.model_name)
+        text, streaming = _parse_infer(req)
+        params = _params_dict(req.parameters)
+        creq = _sampling_request(req.model_name, text, params)
+        pre = entry.preprocessor.preprocess_completion(creq, rid)
+        return entry, pre, streaming
+
+    async def model_infer(self, request, context) -> pb.ModelInferResponse:
+        rid = request.id or uuid.uuid4().hex
+        try:
+            entry, pre, streaming = self._prepare(request, rid)
+        except KeyError:
+            self._count("404")
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"model '{request.model_name}' not found")
+        except (ValueError, TypeError) as exc:
+            self._count("400")
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        if streaming:
+            self._count("400")
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "streaming=true requires ModelStreamInfer")
+        try:
+            text, finish = await collect_text(entry, pre, request.model_name,
+                                              self._svc)
+        except Exception as exc:  # noqa: BLE001 - surfaced as gRPC status
+            log.exception("grpc ModelInfer failed")
+            self._count("500")
+            await context.abort(grpc.StatusCode.INTERNAL, str(exc))
+        self._count("200")
+        return _text_output(request.model_name, rid, text, finish)
+
+    async def model_stream_infer(self, request_iterator, context):
+        """Triton extension: each inbound request starts a generation;
+        its responses stream back tagged with the request's id. Generations
+        run concurrently (the engine batches them); responses for one
+        request are ordered, requests interleave. The ``streaming`` tensor
+        picks per-request delivery (reference kserve.rs:446-546 honors the
+        same flag): true streams one response per text delta, false/absent
+        delivers a single aggregated response when the generation finishes.
+        Error items carry the request id in ``infer_response.id`` so an
+        interleaved client can correlate failures. The queue is bounded:
+        a slow client exerts backpressure through the gRPC flow-control
+        window into the generators instead of buffering unboundedly."""
+        queue: asyncio.Queue[pb.ModelStreamInferResponse | None] = asyncio.Queue(
+            maxsize=256)
+        tasks: set[asyncio.Task] = set()
+
+        def error_item(req, rid: str, msg: str, status: str) -> pb.ModelStreamInferResponse:
+            self._count(status)
+            return pb.ModelStreamInferResponse(
+                error_message=msg,
+                infer_response=pb.ModelInferResponse(
+                    model_name=req.model_name, id=rid))
+
+        async def run_one(req: pb.ModelInferRequest) -> None:
+            rid = req.id or uuid.uuid4().hex
+            try:
+                entry, pre, streaming = self._prepare(req, rid)
+            except KeyError:
+                await queue.put(error_item(
+                    req, rid, f"model '{req.model_name}' not found", "404"))
+                return
+            except (ValueError, TypeError) as exc:
+                await queue.put(error_item(req, rid, str(exc), "400"))
+                return
+
+            async def deliver(text: str, finish: str | None) -> None:
+                if streaming:
+                    await queue.put(pb.ModelStreamInferResponse(
+                        infer_response=_text_output(
+                            req.model_name, rid, text, finish)))
+
+            try:
+                text, finish = await collect_text(
+                    entry, pre, req.model_name, self._svc, on_delta=deliver)
+                if not streaming:
+                    await queue.put(pb.ModelStreamInferResponse(
+                        infer_response=_text_output(
+                            req.model_name, rid, text, finish)))
+                self._count("200")
+            except Exception as exc:  # noqa: BLE001
+                log.exception("grpc ModelStreamInfer generation failed")
+                await queue.put(error_item(req, rid, str(exc), "500"))
+
+        async def ingest() -> None:
+            try:
+                async for req in request_iterator:
+                    t = asyncio.create_task(run_one(req))
+                    tasks.add(t)
+                    t.add_done_callback(tasks.discard)
+                # inbound side closed: wait for generations, then signal done
+                while tasks:
+                    await asyncio.wait(set(tasks))
+            finally:
+                # Always post the sentinel — an exception from the request
+                # iterator (inbound stream reset) must not strand the
+                # response loop on queue.get() forever.
+                await queue.put(None)
+
+        ingest_task = asyncio.create_task(ingest())
+        try:
+            while True:
+                item = await queue.get()
+                if item is None:
+                    break
+                yield item
+        finally:
+            ingest_task.cancel()
+            for t in tasks:
+                t.cancel()
+
+    def _count(self, status: str) -> None:
+        if self._svc is not None:
+            self._svc._requests.inc(route="kserve_grpc", status=status)
+
+    # -- registration ------------------------------------------------------
+    def handler(self) -> grpc.GenericRpcHandler:
+        def uu(fn, req_cls):
+            return grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString())
+
+        return grpc.method_handlers_generic_handler(SERVICE, {
+            "ServerLive": uu(self.server_live, pb.ServerLiveRequest),
+            "ServerReady": uu(self.server_ready, pb.ServerReadyRequest),
+            "ServerMetadata": uu(self.server_metadata, pb.ServerMetadataRequest),
+            "ModelReady": uu(self.model_ready, pb.ModelReadyRequest),
+            "ModelMetadata": uu(self.model_metadata, pb.ModelMetadataRequest),
+            "ModelInfer": uu(self.model_infer, pb.ModelInferRequest),
+            "ModelStreamInfer": grpc.stream_stream_rpc_method_handler(
+                self.model_stream_infer,
+                request_deserializer=pb.ModelInferRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString()),
+        })
+
+
+class KServeGrpcServer:
+    """Owns the `grpc.aio` server lifecycle; binds on a dedicated port."""
+
+    def __init__(self, models: ModelManager, service=None):
+        self._service = KServeGrpcService(models, service=service)
+        self._server: grpc.aio.Server | None = None
+        self.port: int | None = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((self._service.handler(),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        await self._server.start()
+        log.info("kserve grpc listening on %s:%d", host, self.port)
+        return self.port
+
+    async def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+            self._server = None
+
+
+def make_client_stub(channel: grpc.aio.Channel):
+    """Multi-callable bundle for tests/clients (no generated stubs needed)."""
+    def uu(method, req_cls, resp_cls):
+        return channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString)
+
+    class Stub:
+        ServerLive = uu("ServerLive", pb.ServerLiveRequest, pb.ServerLiveResponse)
+        ServerReady = uu("ServerReady", pb.ServerReadyRequest, pb.ServerReadyResponse)
+        ServerMetadata = uu("ServerMetadata", pb.ServerMetadataRequest,
+                            pb.ServerMetadataResponse)
+        ModelReady = uu("ModelReady", pb.ModelReadyRequest, pb.ModelReadyResponse)
+        ModelMetadata = uu("ModelMetadata", pb.ModelMetadataRequest,
+                           pb.ModelMetadataResponse)
+        ModelInfer = uu("ModelInfer", pb.ModelInferRequest, pb.ModelInferResponse)
+        ModelStreamInfer = channel.stream_stream(
+            f"/{SERVICE}/ModelStreamInfer",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.ModelStreamInferResponse.FromString)
+
+    return Stub()

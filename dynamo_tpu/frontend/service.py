@@ -346,9 +346,12 @@ class HttpService:
             self._requests.inc(route=route, status="400")
             return _error(400, f"preprocessing failed: {exc}")
 
-        if req.n > 1:
+        if req.n != 1:
             # Validate here, before the per-model counters tick — a rejected
             # request must not inflate load metrics.
+            if req.n < 1:
+                self._requests.inc(route=route, status="400")
+                return _error(400, "n must be >= 1")
             if req.stream:
                 self._requests.inc(route=route, status="400")
                 return _error(400, "n>1 with stream=true is not supported")
@@ -390,6 +393,32 @@ class HttpService:
 
         return StreamJail(tool_cfg=tool_cfg, reasoning=reasoning)
 
+    async def _collect_outputs(self, entry: ModelEntry, pre, model: str,
+                               t_start: float) -> list[BackendOutput]:
+        """Drive one generation to completion: observe TTFT/ITL, detokenize,
+        stop at the jail's hidden stop. The single shared unary collection
+        loop (used by both the n=1 and n>1 aggregators so metric/stop
+        semantics can't diverge). Raises RuntimeError on an engine error."""
+        backend = DetokenizerBackend(entry.tokenizer, stops=pre.stop_conditions.stop)
+        outs: list[BackendOutput] = []
+        first = True
+        prev = t_start
+        async for eo in entry.generate(pre):
+            now = time.monotonic()
+            if eo.token_ids:
+                if first:
+                    self._ttft.observe(now - t_start, model=model)
+                    first = False
+                else:
+                    self._itl.observe(now - prev, model=model)
+                prev = now
+            if eo.error:
+                raise RuntimeError(eo.error)
+            outs.append(backend.step(eo))
+            if backend.hit_stop:
+                break
+        return outs
+
     async def _aggregate_n(self, req, entry: ModelEntry, pre, chat: bool,
                            t_start: float, route: str) -> web.Response:
         """n>1: run n INDEPENDENT generations concurrently (they batch
@@ -404,26 +433,7 @@ class HttpService:
             sub.request_id = f"{pre.request_id}-n{i}"
             if sub.sampling_options.seed is not None:
                 sub.sampling_options.seed += i
-            backend = DetokenizerBackend(entry.tokenizer,
-                                         stops=sub.stop_conditions.stop)
-            outs: list[BackendOutput] = []
-            first = True
-            prev = time.monotonic()
-            async for eo in entry.generate(sub):
-                now = time.monotonic()
-                if eo.token_ids:
-                    if first:
-                        self._ttft.observe(now - t_start, model=req.model)
-                        first = False
-                    else:
-                        self._itl.observe(now - prev, model=req.model)
-                    prev = now
-                if eo.error:
-                    raise RuntimeError(eo.error)
-                outs.append(backend.step(eo))
-                if backend.hit_stop:
-                    break
-            return outs
+            return await self._collect_outputs(entry, sub, req.model, t_start)
 
         tasks = [asyncio.ensure_future(one(i)) for i in range(req.n)]
         error: str | None = None
@@ -471,33 +481,19 @@ class HttpService:
 
     async def _aggregate_response(self, req, entry: ModelEntry, pre, chat: bool,
                                   t_start: float, route: str) -> web.Response:
-        backend = DetokenizerBackend(entry.tokenizer, stops=pre.stop_conditions.stop)
-        outs: list[BackendOutput] = []
-        first = True
-        prev = t_start
-        async for eo in entry.generate(pre):
-            now = time.monotonic()
-            if first and eo.token_ids:
-                self._ttft.observe(now - t_start, model=req.model)
-                first = False
-            elif eo.token_ids:
-                self._itl.observe(now - prev, model=req.model)
-            prev = now
-            if eo.error:
-                self._requests.inc(route=route, status="500")
-                if chat and self._audit.bus() is not None:
-                    # Anomalous requests are exactly what a compliance log
-                    # must not miss (the streaming path audits from finally).
-                    self._audit.publish(self._audit.AuditRecord(
-                        request_id=pre.request_id, model=req.model,
-                        requested_streaming=False,
-                        request=req.model_dump(exclude_none=True),
-                        error=eo.error))
-                return _error(500, eo.error)
-            out = backend.step(eo)
-            outs.append(out)
-            if backend.hit_stop:
-                break
+        try:
+            outs = await self._collect_outputs(entry, pre, req.model, t_start)
+        except RuntimeError as exc:  # engine error surfaced mid-stream
+            self._requests.inc(route=route, status="500")
+            if chat and self._audit.bus() is not None:
+                # Anomalous requests are exactly what a compliance log
+                # must not miss (the streaming path audits from finally).
+                self._audit.publish(self._audit.AuditRecord(
+                    request_id=pre.request_id, model=req.model,
+                    requested_streaming=False,
+                    request=req.model_dump(exclude_none=True),
+                    error=str(exc)))
+            return _error(500, str(exc))
         self._output_tokens.inc(sum(len(o.token_ids) for o in outs), model=req.model)
         if chat:
             resp = aggregate_chat(req.model, outs, len(pre.token_ids),

@@ -1,0 +1,280 @@
+"""KServe v2 gRPC frontend (reference: lib/llm/src/grpc/service/kserve.rs —
+the tonic GRPCInferenceService): health/metadata, unary ModelInfer, tensor
+validation as INVALID_ARGUMENT, Triton ModelStreamInfer with interleaved
+generations, and an e2e against a mocker worker cluster through the same
+routed pipeline the HTTP routes use.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import grpc
+import pytest
+
+from dynamo_tpu.frontend import kserve_pb2 as pb
+from dynamo_tpu.frontend.kserve_grpc import KServeGrpcServer, make_client_stub
+from dynamo_tpu.frontend.model_manager import ModelManager
+from dynamo_tpu.preprocessor.preprocessor import ModelDefaults
+from dynamo_tpu.tokenizer import ByteTokenizer
+from tests.test_kserve import canned_generate
+from tests.utils_process import ManagedProcess, free_port
+
+
+def infer_request(model: str = "m", text: str = "hello", *, req_id: str = "",
+                  streaming: bool | None = None, **params) -> pb.ModelInferRequest:
+    req = pb.ModelInferRequest(model_name=model, id=req_id)
+    t = req.inputs.add()
+    t.name, t.datatype = "text_input", "BYTES"
+    t.shape.extend([1])
+    t.contents.bytes_contents.append(text.encode())
+    if streaming is not None:
+        s = req.inputs.add()
+        s.name, s.datatype = "streaming", "BOOL"
+        s.shape.extend([1])
+        s.contents.bool_contents.append(streaming)
+    for k, v in params.items():
+        if isinstance(v, bool):
+            req.parameters[k].bool_param = v
+        elif isinstance(v, int):
+            req.parameters[k].int64_param = v
+        elif isinstance(v, float):
+            req.parameters[k].double_param = v
+        else:
+            req.parameters[k].string_param = str(v)
+    return req
+
+
+def outputs_by_name(resp: pb.ModelInferResponse) -> dict[str, bytes]:
+    return {o.name: o.contents.bytes_contents[0] for o in resp.outputs}
+
+
+async def _serve(text: str = "the answer is 42"):
+    models = ModelManager()
+    models.register("m", ByteTokenizer(), canned_generate(text),
+                    defaults=ModelDefaults())
+    srv = KServeGrpcServer(models)
+    port = await srv.start(port=0)
+    chan = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+    return srv, chan, make_client_stub(chan)
+
+
+async def test_grpc_health_and_metadata():
+    srv, chan, stub = await _serve()
+    try:
+        assert (await stub.ServerLive(pb.ServerLiveRequest())).live
+        assert (await stub.ServerReady(pb.ServerReadyRequest())).ready
+        meta = await stub.ServerMetadata(pb.ServerMetadataRequest())
+        assert meta.name == "dynamo_tpu"
+        assert (await stub.ModelReady(pb.ModelReadyRequest(name="m"))).ready
+        assert not (await stub.ModelReady(pb.ModelReadyRequest(name="nope"))).ready
+        mm = await stub.ModelMetadata(pb.ModelMetadataRequest(name="m"))
+        assert mm.platform == "dynamo_tpu"
+        assert mm.inputs[0].name == "text_input"
+        assert mm.inputs[0].datatype == "BYTES"
+        assert mm.outputs[0].name == "text_output"
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            await stub.ModelMetadata(pb.ModelMetadataRequest(name="nope"))
+        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        await chan.close()
+        await srv.stop()
+
+
+async def test_grpc_unary_infer():
+    srv, chan, stub = await _serve()
+    try:
+        resp = await stub.ModelInfer(infer_request(max_tokens=64, temperature=0.0))
+        outs = outputs_by_name(resp)
+        assert outs["text_output"] == b"the answer is 42"
+        assert outs["finish_reason"] == b"stop"
+        assert resp.model_name == "m"
+        # request id round-trips
+        resp = await stub.ModelInfer(infer_request(req_id="rid-7", max_tokens=8))
+        assert resp.id == "rid-7"
+    finally:
+        await chan.close()
+        await srv.stop()
+
+
+async def test_grpc_validation_errors():
+    srv, chan, stub = await _serve()
+    try:
+        # unknown model -> NOT_FOUND
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            await stub.ModelInfer(infer_request(model="ghost"))
+        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+        # wrong datatype -> INVALID_ARGUMENT
+        req = pb.ModelInferRequest(model_name="m")
+        t = req.inputs.add()
+        t.name, t.datatype = "text_input", "FP32"
+        t.shape.extend([1])
+        t.contents.fp32_contents.append(1.0)
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            await stub.ModelInfer(req)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "BYTES" in ei.value.details()
+
+        # wrong shape
+        req = infer_request()
+        del req.inputs[0].shape[:]
+        req.inputs[0].shape.extend([2])
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            await stub.ModelInfer(req)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+        # missing tensor
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            await stub.ModelInfer(pb.ModelInferRequest(model_name="m"))
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+        # streaming over unary -> INVALID_ARGUMENT
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            await stub.ModelInfer(infer_request(streaming=True))
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "ModelStreamInfer" in ei.value.details()
+    finally:
+        await chan.close()
+        await srv.stop()
+
+
+async def test_grpc_raw_input_contents():
+    """BYTES tensors may ride raw_input_contents with a u32-LE length prefix
+    (the standard raw binding) instead of inline contents."""
+    srv, chan, stub = await _serve()
+    try:
+        req = pb.ModelInferRequest(model_name="m")
+        t = req.inputs.add()
+        t.name, t.datatype = "text_input", "BYTES"
+        t.shape.extend([1])
+        payload = b"hi there"
+        req.raw_input_contents.append(len(payload).to_bytes(4, "little") + payload)
+        resp = await stub.ModelInfer(req)
+        assert outputs_by_name(resp)["text_output"] == b"the answer is 42"
+    finally:
+        await chan.close()
+        await srv.stop()
+
+
+async def test_grpc_stream_infer_interleaved():
+    """Two streaming generations opened on one stream: every delta is tagged
+    with its request id, deltas per request are ordered, and both finish."""
+    srv, chan, stub = await _serve("stream me please")
+    try:
+        call = stub.ModelStreamInfer()
+        await call.write(infer_request(req_id="a", streaming=True, max_tokens=64))
+        await call.write(infer_request(req_id="b", streaming=True, max_tokens=64))
+        await call.done_writing()
+        got: dict[str, list[str]] = {"a": [], "b": []}
+        finishes: dict[str, str] = {}
+        async for item in call:
+            assert not item.error_message, item.error_message
+            resp = item.infer_response
+            outs = {o.name: o.contents.bytes_contents[0] for o in resp.outputs}
+            got[resp.id].append(outs["text_output"].decode())
+            if "finish_reason" in outs:
+                finishes[resp.id] = outs["finish_reason"].decode()
+        assert "".join(got["a"]) == "stream me please"
+        assert "".join(got["b"]) == "stream me please"
+        assert len(got["a"]) > 1, "stream did not arrive in deltas"
+        assert finishes == {"a": "stop", "b": "stop"}
+    finally:
+        await chan.close()
+        await srv.stop()
+
+
+async def test_grpc_stream_infer_unary_aggregation():
+    """streaming=false (or absent) on ModelStreamInfer delivers ONE
+    aggregated response per request, mirroring the reference's handling of
+    the flag (kserve.rs:446-546)."""
+    srv, chan, stub = await _serve("all at once")
+    try:
+        call = stub.ModelStreamInfer()
+        await call.write(infer_request(req_id="u1", max_tokens=64))
+        await call.write(infer_request(req_id="u2", streaming=False, max_tokens=64))
+        await call.done_writing()
+        per_req: dict[str, list[dict[str, bytes]]] = {"u1": [], "u2": []}
+        async for item in call:
+            assert not item.error_message, item.error_message
+            outs = {o.name: o.contents.bytes_contents[0]
+                    for o in item.infer_response.outputs}
+            per_req[item.infer_response.id].append(outs)
+        for rid, items in per_req.items():
+            assert len(items) == 1, f"{rid}: expected one aggregated response"
+            assert items[0]["text_output"] == b"all at once"
+            assert items[0]["finish_reason"] == b"stop"
+    finally:
+        await chan.close()
+        await srv.stop()
+
+
+async def test_grpc_stream_infer_bad_request_is_nonfatal():
+    """An invalid request on the stream yields an error item carrying the
+    request id, and the stream keeps serving subsequent requests."""
+    srv, chan, stub = await _serve("ok")
+    try:
+        call = stub.ModelStreamInfer()
+        await call.write(infer_request(model="ghost", req_id="bad"))
+        await call.write(infer_request(req_id="good", max_tokens=16))
+        await call.done_writing()
+        errors, texts = [], []
+        async for item in call:
+            if item.error_message:
+                errors.append((item.infer_response.id, item.error_message))
+            else:
+                outs = {o.name: o.contents.bytes_contents[0]
+                        for o in item.infer_response.outputs}
+                texts.append(outs["text_output"].decode())
+        assert errors and errors[0][0] == "bad", errors
+        assert "ghost" in errors[0][1]
+        assert "".join(texts) == "ok"
+    finally:
+        await chan.close()
+        await srv.stop()
+
+
+@pytest.mark.slow
+async def test_grpc_e2e_against_mocker_cluster():
+    """frontend --grpc-port serves the distributed routed pipeline over gRPC."""
+    coord_port = free_port()
+    coordinator = ManagedProcess(
+        ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
+         "--port", str(coord_port)], name="coordinator").start()
+    url = f"tcp://127.0.0.1:{coord_port}"
+    time.sleep(1.0)
+    frontend = None
+    worker = ManagedProcess(
+        ["-m", "dynamo_tpu.components.worker", "--engine", "mocker",
+         "--coordinator", url, "--block-size", "4", "--speedup-ratio", "50",
+         "--max-model-len", "512", "--num-blocks", "128"], name="worker").start()
+    try:
+        worker.wait_for_line("WORKER_READY", 30)
+        frontend = ManagedProcess(
+            ["-m", "dynamo_tpu.components.frontend", "--coordinator", url,
+             "--host", "127.0.0.1", "--port", str(free_port()),
+             "--grpc-port", str(free_port()), "--router-mode", "kv"],
+            name="frontend").start()
+        line = frontend.wait_for_line("FRONTEND_GRPC_READY", 30)
+        gport = int(line.rsplit("port=", 1)[1])
+        frontend.wait_for_line("FRONTEND_READY", 30)
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{gport}") as chan:
+            stub = make_client_stub(chan)
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if (await stub.ModelReady(
+                        pb.ModelReadyRequest(name="tiny-llama"))).ready:
+                    break
+                await asyncio.sleep(0.2)
+            resp = await stub.ModelInfer(infer_request(
+                model="tiny-llama", text="distributed kserve grpc",
+                max_tokens=8, ignore_eos=True))
+            outs = outputs_by_name(resp)
+        assert outs["finish_reason"] == b"length"
+        assert isinstance(outs["text_output"].decode(), str)
+    finally:
+        if frontend:
+            frontend.stop()
+        worker.stop()
+        coordinator.stop()
