@@ -55,6 +55,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--wedgeable", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--host-kv-blocks", type=int, default=0, help="G2 host KV tier capacity")
     p.add_argument("--disk-kv-path", default=None, help="G3 disk KV tier directory")
+    p.add_argument("--remote-kv-addr", default=None,
+                   help="G4 remote block store host:port ('auto' = discover "
+                        "via the coordinator)")
     # Disaggregated serving (reference: vllm decode-first pattern).
     p.add_argument("--disagg", choices=["none", "prefill", "decode"], default="none")
     p.add_argument("--prefill-endpoint", default="dyn://dynamo.prefill.generate",
@@ -92,6 +95,15 @@ def model_card(ns: argparse.Namespace, name: str) -> dict:
 
 
 async def amain(ns: argparse.Namespace) -> None:
+    if ns.engine != "mocker":
+        # Hub repo ids resolve to a local snapshot before anything else
+        # consumes the model string (card tokenizer + engine weights). The
+        # SERVED name stays the user-given id; only loading paths change.
+        from dynamo_tpu.models.hub import resolve_model_path
+
+        if ns.served_model_name is None:
+            ns.served_model_name = ns.model
+        ns.model = resolve_model_path(ns.model)
     cfg = RuntimeConfig.from_settings(coordinator_url=ns.coordinator)
     rt = await DistributedRuntime.create(cfg)
     assert rt.client is not None and rt.primary_lease is not None
@@ -103,8 +115,8 @@ async def amain(ns: argparse.Namespace) -> None:
     if ns.num_nodes > 1:
         if ns.engine != "jax":
             raise SystemExit("--num-nodes > 1 requires --engine jax")
-        if ns.disagg != "none" or ns.host_kv_blocks or ns.disk_kv_path:
-            raise SystemExit("multi-host engines do not yet support disagg/KVBM tiers")
+        if ns.disagg != "none":
+            raise SystemExit("multi-host engines do not yet support disagg")
         from dynamo_tpu.parallel import multihost as mh
 
         group = f"{ns.namespace}.{ns.component}"
@@ -186,6 +198,14 @@ async def amain(ns: argparse.Namespace) -> None:
     else:
         from dynamo_tpu.engine.engine import build_engine
 
+        remote_kv = ns.remote_kv_addr
+        if remote_kv == "auto":
+            from dynamo_tpu.kvbm.remote import discover_store
+
+            remote_kv = await discover_store(rt.client)
+            if remote_kv is None:
+                log.warning("--remote-kv-addr auto: no store advertised; "
+                            "continuing without a G4 tier")
         # Engine construction (param init, cache alloc) blocks for seconds —
         # run off-loop so the lease keep-alive keeps ticking.
         loop = asyncio.get_running_loop()
@@ -201,6 +221,7 @@ async def amain(ns: argparse.Namespace) -> None:
             allow_random_weights=ns.allow_random_weights,
             host_kv_blocks=ns.host_kv_blocks,
             disk_kv_path=ns.disk_kv_path,
+            remote_kv_addr=remote_kv,
         ), event_sink=sink,
             op_sink=op_channel.broadcast if op_channel is not None else None))
         stats_fn = engine.stats

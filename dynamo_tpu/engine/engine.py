@@ -19,6 +19,7 @@ import collections
 import queue as thread_queue
 import threading
 import time
+from pathlib import Path
 from dataclasses import dataclass, field
 from functools import partial
 from typing import TYPE_CHECKING, Any, AsyncIterator, Callable
@@ -552,21 +553,66 @@ class EngineCore:
         self._seqs: dict[str, Seq] = {}
         self.default_eos: list[int] = []
         self.kvbm: "OffloadManager | None" = None
-        if engine_cfg.host_kv_blocks > 0 or engine_cfg.disk_kv_path:
+        if (engine_cfg.host_kv_blocks > 0 or engine_cfg.disk_kv_path
+                or engine_cfg.remote_kv_addr):
             from dynamo_tpu.kvbm.offload import OffloadManager
             from dynamo_tpu.kvbm.pools import DiskBlockPool, HostBlockPool
 
-            disk = (DiskBlockPool(self.runner.spec, engine_cfg.disk_kv_path,
+            # Multi-host engines: every rank runs this same construction in
+            # SPMD lockstep (op-stream replay keeps decisions identical);
+            # tiers then hold rank-LOCAL cache shards and extract/inject
+            # touch only addressable memory (kvbm/distributed.py — the
+            # reference's KvbmLeader/KvbmWorker split without the control
+            # channel, distributed/leader.rs:126, worker.rs:143).
+            transfer = None
+            tier_spec, fp = self.runner.spec, engine_cfg.model
+            disk_path = engine_cfg.disk_kv_path
+            if jax.process_count() > 1:
+                from dynamo_tpu.kvbm.distributed import (
+                    ShardedBlockTransferEngine,
+                    local_block_spec,
+                )
+
+                if engine_cfg.remote_kv_addr:
+                    # A shared remote store can't guarantee rank-identical
+                    # hit/miss results (connection hiccups, cross-engine LRU),
+                    # and divergent onboard plans mean divergent XLA programs
+                    # → hung collectives. Refuse rather than desync.
+                    raise ValueError(
+                        "remote_kv_addr is not supported on multi-host "
+                        "engines (non-deterministic across ranks)")
+                transfer = ShardedBlockTransferEngine(self.runner.mesh)
+                tier_spec, shard_fp = local_block_spec(
+                    self.runner.spec, self.runner.cache_k)
+                fp = f"{engine_cfg.model}|{shard_fp}"
+                if disk_path:
+                    # Per-rank subdir: ranks colocated on one filesystem
+                    # must not fight over one MANIFEST/arena.
+                    disk_path = str(Path(disk_path) /
+                                    f"rank{jax.process_index()}")
+            # Cascade G2 host → G3 disk → G4 remote: each tier spills its
+            # LRU victims to the next, lookups walk the chain top-down.
+            remote = None
+            if engine_cfg.remote_kv_addr:
+                from dynamo_tpu.kvbm.remote import RemoteBlockPool
+
+                remote = RemoteBlockPool(tier_spec, engine_cfg.remote_kv_addr,
+                                         fingerprint=fp)
+            disk = (DiskBlockPool(tier_spec, disk_path,
                                   engine_cfg.disk_kv_bytes,
-                                  fingerprint=engine_cfg.model)
-                    if engine_cfg.disk_kv_path else None)
+                                  fingerprint=fp,
+                                  overflow=remote)
+                    if disk_path else None)
             tiers: list = []
             if engine_cfg.host_kv_blocks > 0:
-                tiers.append(HostBlockPool(self.runner.spec, engine_cfg.host_kv_blocks,
-                                           overflow=disk))
+                tiers.append(HostBlockPool(tier_spec, engine_cfg.host_kv_blocks,
+                                           overflow=disk or remote))
             if disk is not None:
                 tiers.append(disk)
-            self.kvbm = OffloadManager(self.runner, self.pool, tiers)
+            if remote is not None:
+                tiers.append(remote)
+            self.kvbm = OffloadManager(self.runner, self.pool, tiers,
+                                       transfer=transfer)
 
     # ------------------------------------------------------------------
     def add_request(self, req: PreprocessedRequest) -> LLMEngineOutput | None:

@@ -103,12 +103,14 @@ class OffloadManager:
     donates its inputs, mirroring the engine step functions).
     """
 
-    def __init__(self, runner, pool: PrefixPool, tiers: list):
+    def __init__(self, runner, pool: PrefixPool, tiers: list, transfer=None):
         assert tiers, "OffloadManager needs at least one tier"
         self.runner = runner
         self.pool = pool
         self.tiers = tiers
-        self.transfer = BlockTransferEngine()
+        # transfer override: multi-host engines pass the sharded engine
+        # (kvbm/distributed.py) so tiers hold rank-local shards.
+        self.transfer = transfer or BlockTransferEngine()
         self.stats = OffloadStats()
         self._pending: list[tuple[int, int]] = []  # (block_id, seq_hash)
         pool.evict_hook = self._on_evict

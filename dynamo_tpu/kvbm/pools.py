@@ -122,8 +122,10 @@ class DiskBlockPool:
         path: str | Path,
         capacity_bytes: int = 1 << 30,
         fingerprint: str = "",
+        overflow=None,
     ):
         self.spec = spec
+        self.overflow = overflow
         self.path = Path(path)
         self.path.mkdir(parents=True, exist_ok=True)
         self.capacity_bytes = capacity_bytes
@@ -164,6 +166,15 @@ class DiskBlockPool:
             return
         while (len(self._lru) + 1) * self._block_bytes > self.capacity_bytes and self._lru:
             victim, _ = self._lru.popitem(last=False)
+            if self.overflow is not None:
+                # read directly (not self.get — that would touch the LRU)
+                try:
+                    raw = np.fromfile(self._file(victim), dtype=np.uint8)
+                except OSError:
+                    raw = np.empty(0, np.uint8)
+                if raw.size == self._block_bytes:
+                    self.overflow.put(victim, raw.view(
+                        jnp.dtype(self.spec.dtype)).reshape(block_shape(self.spec)))
             self._file(victim).unlink(missing_ok=True)
             self.stats.evictions += 1
         np.ascontiguousarray(block).view(np.uint8).tofile(self._file(seq_hash))

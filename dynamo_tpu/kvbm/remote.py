@@ -1,0 +1,297 @@
+"""G4 remote KV block tier: a shared block store service + client tier.
+
+Fills the role of the reference's G4 remote cache level
+(reference: lib/llm/src/block_manager.rs:63-75 ``CacheLevel::G4`` and the
+NIXL-backed remote storage, block_manager/storage/nixl.rs) — the TPU/DCN
+way: blocks move host→host over a framed TCP data plane (the same
+``[u32 len][msgpack]`` framing every other plane speaks, transports/wire.py),
+not RDMA. A pod-wide store lets ANY engine onboard a prefix that any other
+engine computed — cross-engine prefix reuse beyond what per-host G2/G3
+tiers can offer, and KV that survives whole-host restarts.
+
+Server: :class:`RemoteBlockServer` — asyncio, byte-budgeted LRU arena,
+multi-client, one request/response per frame. Runs embedded or as the
+``dynamo_tpu.components.kv_store`` process, and registers itself in the
+coordinator under ``kvbm/remote/{instance}`` for discovery.
+
+Client: :class:`RemoteBlockPool` — the standard tier protocol
+(``put/get/__contains__/len/stats``), so it chains after host/disk in the
+offload cascade. The engine's tier calls are synchronous (they run on the
+engine-core thread between device dispatches), so the client speaks
+blocking sockets with short timeouts; a dead/unreachable store degrades to
+misses rather than stalling the serving loop.
+
+Keys are ``(namespace, seq_hash)``: the namespace (model fingerprint +
+block geometry, same recipe as the disk tier's MANIFEST) partitions the
+shared store so two models can never exchange blocks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+import threading
+import time
+from collections import OrderedDict
+
+import msgpack
+import numpy as np
+
+from dynamo_tpu.engine.cache import KVCacheSpec
+from dynamo_tpu.kvbm.pools import TierStats, block_shape
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("kvbm.remote")
+
+REMOTE_PREFIX = "kvbm/remote"
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+class RemoteBlockServer:
+    """Byte-budgeted LRU block store speaking framed msgpack.
+
+    Ops: ``{"op": "put", "ns": str, "h": int, "data": bytes}`` → ``{"ok": True}``
+         ``{"op": "get", "ns": str, "h": int}`` → ``{"ok": True, "data": bytes | None}``
+         ``{"op": "has", "ns": str, "h": int}`` → ``{"ok": True, "has": bool}``
+         ``{"op": "stats"}`` → ``{"ok": True, ...counters}``
+    """
+
+    def __init__(self, capacity_bytes: int = 4 << 30):
+        self.capacity_bytes = capacity_bytes
+        self._store: OrderedDict[tuple[str, int], bytes] = OrderedDict()
+        self._bytes = 0
+        self.stats = TierStats()
+        self._server: asyncio.AbstractServer | None = None
+        self._writers: set[asyncio.StreamWriter] = set()
+        self.port: int | None = None
+
+    # -- store ------------------------------------------------------------
+    def _put(self, ns: str, h: int, data: bytes) -> None:
+        key = (ns, h)
+        if key in self._store:
+            self._store.move_to_end(key)
+            return
+        while self._bytes + len(data) > self.capacity_bytes and self._store:
+            _, victim = self._store.popitem(last=False)
+            self._bytes -= len(victim)
+            self.stats.evictions += 1
+        self._store[key] = data
+        self._bytes += len(data)
+        self.stats.stores += 1
+
+    def _get(self, ns: str, h: int) -> bytes | None:
+        self.stats.lookups += 1
+        data = self._store.get((ns, h))
+        if data is not None:
+            self._store.move_to_end((ns, h))
+            self.stats.hits += 1
+        return data
+
+    # -- service ----------------------------------------------------------
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        self._writers.add(writer)
+        try:
+            while True:
+                try:
+                    header = await reader.readexactly(4)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    return
+                (length,) = struct.unpack(">I", header)
+                payload = await reader.readexactly(length)
+                msg = msgpack.unpackb(payload, raw=False)
+                op = msg.get("op")
+                if op == "put":
+                    self._put(msg["ns"], msg["h"], msg["data"])
+                    resp = {"ok": True}
+                elif op == "get":
+                    resp = {"ok": True, "data": self._get(msg["ns"], msg["h"])}
+                elif op == "has":
+                    resp = {"ok": True,
+                            "has": (msg["ns"], msg["h"]) in self._store}
+                elif op == "stats":
+                    resp = {"ok": True, "blocks": len(self._store),
+                            "bytes": self._bytes, **self.stats.to_dict()}
+                else:
+                    resp = {"ok": False, "error": f"unknown op {op!r}"}
+                out = msgpack.packb(resp, use_bin_type=True)
+                writer.write(struct.pack(">I", len(out)) + out)
+                await writer.drain()
+        except Exception:
+            log.exception("kv store client connection failed")
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("remote KV block store on %s:%d (%.1f GiB)",
+                 host, self.port, self.capacity_bytes / (1 << 30))
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # Drop live client connections too — wait_closed() (3.12+) waits
+            # for handlers, and engine clients hold persistent connections.
+            for w in list(self._writers):
+                w.close()
+            await self._server.wait_closed()
+            self._server = None
+
+
+# ---------------------------------------------------------------------------
+# Client tier
+# ---------------------------------------------------------------------------
+
+def tier_namespace(spec: KVCacheSpec, fingerprint: str = "") -> str:
+    """Same identity recipe as the disk tier's MANIFEST."""
+    return f"{fingerprint}|{block_shape(spec)}|{spec.dtype}"
+
+
+class RemoteBlockPool:
+    """Tier-protocol client for a :class:`RemoteBlockServer`.
+
+    Synchronous (engine-core thread); one persistent connection with
+    automatic reconnect-once per call. Failures degrade to misses/drops —
+    a remote store outage must never wedge the serving loop. ``len`` and
+    ``__contains__`` ask the server (the store is shared; local bookkeeping
+    would go stale the moment another engine writes)."""
+
+    name = "remote"
+
+    # After a failed call, skip the store entirely for this long — an
+    # outage must cost ONE connect timeout per window, not one per call
+    # (metrics polling alone calls into this tier several times a second).
+    BREAKER_SECONDS = 30.0
+
+    def __init__(self, spec: KVCacheSpec, addr: str, fingerprint: str = "",
+                 timeout: float = 1.0):
+        self.spec = spec
+        host, _, port = addr.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._ns = tier_namespace(spec, fingerprint)
+        self._timeout = timeout
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+        self._broken_until = 0.0
+        self._last_len = 0
+        self.stats = TierStats()
+        self._dtype = np.dtype(spec.dtype)
+
+    # -- wire -------------------------------------------------------------
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection(self._addr, timeout=self._timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _call(self, msg: dict) -> dict | None:
+        """One request/response; reconnects once on a broken connection;
+        returns None when the store is unreachable. A failure opens the
+        circuit breaker: calls return None instantly until it expires."""
+        with self._lock:
+            if time.monotonic() < self._broken_until:
+                return None
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    payload = msgpack.packb(msg, use_bin_type=True)
+                    self._sock.sendall(struct.pack(">I", len(payload)) + payload)
+                    header = self._recv_exact(4)
+                    (length,) = struct.unpack(">I", header)
+                    return msgpack.unpackb(self._recv_exact(length), raw=False)
+                except (OSError, ValueError, struct.error):
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    if attempt == 1:
+                        self._broken_until = time.monotonic() + self.BREAKER_SECONDS
+                        log.warning(
+                            "remote KV store %s:%d unreachable; skipping it "
+                            "for %.0fs", *self._addr, self.BREAKER_SECONDS)
+                        return None
+        return None
+
+    def _recv_exact(self, n: int) -> bytes:
+        assert self._sock is not None
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise OSError("connection closed")
+            buf += chunk
+        return buf
+
+    # -- tier protocol -----------------------------------------------------
+    def put(self, seq_hash: int, block: np.ndarray) -> None:
+        data = np.ascontiguousarray(block).tobytes()
+        resp = self._call({"op": "put", "ns": self._ns, "h": seq_hash,
+                           "data": data})
+        if resp is None:
+            log.debug("remote KV store unreachable; dropping block %x", seq_hash)
+            return
+        self.stats.stores += 1
+
+    def get(self, seq_hash: int) -> np.ndarray | None:
+        self.stats.lookups += 1
+        resp = self._call({"op": "get", "ns": self._ns, "h": seq_hash})
+        data = resp.get("data") if resp else None
+        if data is None:
+            return None
+        want = int(np.prod(block_shape(self.spec))) * self._dtype.itemsize
+        if len(data) != want:  # geometry mismatch can't happen within a ns; guard anyway
+            log.warning("remote block %x has %d bytes, want %d", seq_hash,
+                        len(data), want)
+            return None
+        self.stats.hits += 1
+        return np.frombuffer(data, self._dtype).reshape(block_shape(self.spec))
+
+    def __contains__(self, seq_hash: int) -> bool:
+        resp = self._call({"op": "has", "ns": self._ns, "h": seq_hash})
+        return bool(resp and resp.get("has"))
+
+    def __len__(self) -> int:
+        # Metrics-path call (OffloadManager.snapshot → stats polling):
+        # last-known value on failure, never a stall.
+        resp = self._call({"op": "stats"})
+        if resp:
+            self._last_len = int(resp.get("blocks", 0))
+        return self._last_len
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
+# ---------------------------------------------------------------------------
+# Discovery
+# ---------------------------------------------------------------------------
+
+async def register_store(client, instance_id: int, addr: str,
+                         lease_id: int = 0) -> None:
+    """Advertise a running store in the coordinator (lease-bound: a dead
+    store disappears and engines fall back to local tiers)."""
+    await client.put(f"{REMOTE_PREFIX}/{instance_id:016x}", addr.encode(),
+                     lease_id)
+
+
+async def discover_store(client) -> str | None:
+    """First advertised store address, or None."""
+    got = await client.get_prefix(REMOTE_PREFIX + "/")
+    for _, v in sorted(got.items()):
+        return v.decode()
+    return None

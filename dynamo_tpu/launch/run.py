@@ -64,6 +64,8 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                         "lag by up to window-1 tokens; output is unchanged)")
     p.add_argument("--host-kv-blocks", type=int, default=0, help="G2 host KV tier capacity")
     p.add_argument("--disk-kv-path", default=None, help="G3 disk KV tier directory")
+    p.add_argument("--remote-kv-addr", default=None,
+                   help="G4 remote block store host:port")
     p.add_argument("--tool-call-parser", default=None,
                    help="tool-call parser name (hermes, mistral, llama3_json, ...)")
     p.add_argument("--reasoning-parser", default=None,
@@ -74,8 +76,15 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
 
 
 def build_local_engine(ns: argparse.Namespace) -> tuple[AsyncJaxEngine, EngineConfig]:
+    # Hub repo ids resolve to a local snapshot; the SERVED model name
+    # (ns.model, used for registration) keeps the user-given id.
+    from dynamo_tpu.models.hub import resolve_model_path
+
+    resolved = resolve_model_path(ns.model)
+    if ns.tokenizer is None and resolved != ns.model:
+        ns.tokenizer = resolved
     cfg = EngineConfig(
-        model=ns.model,
+        model=resolved,
         max_batch_size=ns.max_batch_size,
         max_model_len=ns.max_model_len,
         block_size=ns.block_size,
@@ -86,6 +95,7 @@ def build_local_engine(ns: argparse.Namespace) -> tuple[AsyncJaxEngine, EngineCo
         allow_random_weights=ns.allow_random_weights,
         host_kv_blocks=ns.host_kv_blocks,
         disk_kv_path=ns.disk_kv_path,
+        remote_kv_addr=ns.remote_kv_addr,
     )
     from dynamo_tpu.engine.engine import build_engine
 

@@ -1,0 +1,110 @@
+"""Model acquisition: resolve a model *name* to a local checkpoint path.
+
+Fills the reference's hub-download role (reference: lib/llm/src/hub.rs —
+`from_hf` snapshot download into the HF cache; probe order in
+lib/llm/src/local_model.rs:45 LocalModelBuilder: local path → GGUF file →
+hub repo id). TPU-relevant framing: weights land in the shared HF cache
+directory once per host; the loader then mmaps safetensors from there and
+shards straight onto the device mesh, so the download never transits
+device memory.
+
+Resolution order for ``resolve_model_path(model)``:
+
+1. An existing local path (directory or ``.gguf`` file) → returned as-is.
+2. A built-in preset name (``MODEL_PRESETS``) → returned as-is (random
+   init or test fixtures; no weights on disk).
+3. Anything shaped like an HF repo id (``org/name``) → snapshot download
+   via ``huggingface_hub`` (honoring ``HF_HUB_OFFLINE`` / an offline
+   environment with a clear error), returning the local snapshot dir.
+
+Only weight/config/tokenizer artifacts are fetched — ``*.bin`` torch
+duplicates of safetensors checkpoints are excluded, halving the pull for
+dual-format repos.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("hub")
+
+# What a serving snapshot needs: weights, configs, tokenizer assets.
+ALLOW_PATTERNS = [
+    "*.safetensors",
+    "*.safetensors.index.json",
+    "*.json",
+    "*.model",          # sentencepiece
+    "tokenizer*",
+    "*.gguf",
+]
+
+
+def looks_like_repo_id(model: str) -> bool:
+    """``org/name`` shape, not an existing filesystem path."""
+    if os.path.exists(model):
+        return False
+    parts = model.split("/")
+    return (
+        len(parts) == 2
+        and all(p and not p.startswith((".", "~")) for p in parts)
+        and not model.endswith(".gguf")
+    )
+
+
+def resolve_model_path(model: str, revision: str | None = None) -> str:
+    """Resolve ``model`` to a local path, downloading from the HF hub when
+    it names a repo id. Raises ValueError with a actionable message when
+    the download cannot proceed (offline env, missing repo, gated)."""
+    from dynamo_tpu.models.config import MODEL_PRESETS
+
+    if model in MODEL_PRESETS or os.path.exists(model):
+        return model
+    if not looks_like_repo_id(model):
+        return model  # let the engine's weight probe report the bad path
+
+    try:
+        from huggingface_hub import snapshot_download
+        from huggingface_hub.errors import (
+            HfHubHTTPError,
+            LocalEntryNotFoundError,
+            RepositoryNotFoundError,
+        )
+    except ImportError as exc:  # pragma: no cover - hub lib is baked in
+        raise ValueError(
+            f"{model!r} looks like a HF hub repo id but huggingface_hub is "
+            "not installed; pass a local checkpoint path instead") from exc
+
+    offline = os.environ.get("HF_HUB_OFFLINE", "").lower() in ("1", "true", "yes")
+    try:
+        path = snapshot_download(
+            model, revision=revision, allow_patterns=ALLOW_PATTERNS,
+            local_files_only=offline,
+        )
+    except LocalEntryNotFoundError as exc:
+        raise ValueError(
+            f"model {model!r} is not in the local HF cache and the "
+            "environment is offline (HF_HUB_OFFLINE / no egress); "
+            "pre-download it or pass a local checkpoint path") from exc
+    except RepositoryNotFoundError as exc:
+        raise ValueError(
+            f"HF hub repo {model!r} does not exist (or is gated and no "
+            "token is configured)") from exc
+    except HfHubHTTPError as exc:
+        raise ValueError(f"HF hub download of {model!r} failed: {exc}") from exc
+    except OSError as exc:  # DNS failure etc. in a zero-egress environment
+        raise ValueError(
+            f"cannot reach the HF hub to download {model!r} "
+            f"(offline environment?): {exc}") from exc
+    log.info("resolved hub model %s → %s", model, path)
+
+    # GGUF-only repos resolve to the single .gguf file (the loader's
+    # entry format probe keys off the suffix, reference gguf.rs role).
+    snap = Path(path)
+    if not any(snap.glob("*.safetensors")):
+        ggufs = sorted(snap.glob("*.gguf"))
+        if len(ggufs) == 1:
+            return str(ggufs[0])
+    return path

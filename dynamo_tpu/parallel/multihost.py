@@ -329,6 +329,11 @@ _HELLO_FIELDS = (
     "max_batch_size", "max_model_len", "prefill_chunk", "max_tokens_per_step",
     "decode_bucket", "decode_window", "seed", "enable_prefix_caching",
     "dp", "tp", "ep", "sp",
+    # KVBM tiers shape scheduling (onboarded blocks change prefill shapes):
+    # every rank must run the same tier config in lockstep. remote_kv_addr
+    # is deliberately NOT here — a shared remote store cannot guarantee
+    # rank-identical hit/miss results, so EngineCore refuses it multi-host.
+    "host_kv_blocks", "disk_kv_path", "disk_kv_bytes",
 )
 
 

@@ -108,6 +108,9 @@ class EngineConfig:
     host_kv_blocks: int = 0
     disk_kv_path: str | None = None
     disk_kv_bytes: int = 1 << 30
+    # G4 remote block store ("host:port" of a RemoteBlockServer); chained
+    # after host/disk in the offload cascade.
+    remote_kv_addr: str | None = None
     seed: int = 0
     # A checkpoint PATH without loadable weights fails engine construction
     # unless this is set — a typo'd path must not silently serve garbage.

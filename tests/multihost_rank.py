@@ -27,11 +27,13 @@ from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, St
 from dynamo_tpu.utils.config import EngineConfig
 
 
-def engine_cfg() -> EngineConfig:
+def engine_cfg(kvbm: bool = False) -> EngineConfig:
     return EngineConfig(
         model="tiny-llama",
         block_size=4,
-        num_blocks=64,
+        # kvbm mode: a tight pool (12 usable blocks) so the fillers evict
+        # prompt A into the host tier and the re-run onboards it.
+        num_blocks=13 if kvbm else 64,
         max_batch_size=8,
         max_model_len=128,
         prefill_chunk=32,
@@ -39,6 +41,7 @@ def engine_cfg() -> EngineConfig:
         tp=2,   # tiny-llama has 2 kv heads; model axis must divide them
         dp=2,
         decode_window=2,   # exercise fused windows across hosts too
+        host_kv_blocks=64 if kvbm else 0,
     )
 
 
@@ -55,19 +58,56 @@ def make_reqs() -> list[PreprocessedRequest]:
     return reqs
 
 
-async def leader(coord_port: int) -> None:
+async def run_kvbm_workload(engine: AsyncJaxEngine) -> dict:
+    """Evict → offload → onboard through the (possibly sharded) host tier:
+    prompt A, disjoint fillers that churn A out of the device pool, prompt A
+    again. Returns both A streams plus the kvbm counters."""
+    async def one(req: PreprocessedRequest) -> list[int]:
+        toks: list[int] = []
+        async for out in engine.generate(req):
+            toks.extend(out.token_ids)
+        return toks
+
+    def req(prompt: list[int], rid: str, max_tokens: int) -> PreprocessedRequest:
+        r = PreprocessedRequest(
+            token_ids=prompt,
+            stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0))
+        r.request_id = rid
+        return r
+
+    prompt_a = [(100 + i) % 250 for i in range(24)]  # 6 blocks of 4
+    first = await one(req(prompt_a, "a1", 6))
+    for i in range(4):
+        await one(req([(200 + 30 * i + j) % 250 for j in range(24)], f"f{i}", 4))
+    second = await one(req(prompt_a, "a2", 6))
+    kvbm = engine.core.kvbm
+    assert kvbm is not None
+    return {"a1": first, "a2": second,
+            "offloaded": kvbm.stats.offloaded_blocks,
+            "onboarded": kvbm.stats.onboarded_blocks}
+
+
+async def leader(coord_port: int, kvbm: bool = False) -> None:
     mn = mh.MultiNodeConfig(num_nodes=2, node_rank=0,
                             leader_addr=f"127.0.0.1:{coord_port}")
     mh.initialize_distributed(mn)
     channel = mh.LeaderOpChannel(mn.resolved_op_port(), num_followers=1)
     await asyncio.get_running_loop().run_in_executor(None, channel.accept_followers, 120.0)
 
-    cfg = engine_cfg()
+    cfg = engine_cfg(kvbm)
     core = EngineCore(cfg)
     channel.broadcast(mh.leader_hello(
         dataclasses.replace(cfg, num_blocks=core.runner.spec.num_blocks)))
     await asyncio.get_running_loop().run_in_executor(None, channel.wait_ready)
     engine = AsyncJaxEngine(core, op_sink=channel.broadcast)
+
+    if kvbm:
+        out = await run_kvbm_workload(engine)
+        await engine.shutdown()
+        channel.close()
+        print("RESULT " + json.dumps(out), flush=True)
+        return
 
     async def one(req: PreprocessedRequest) -> list[int]:
         toks: list[int] = []
@@ -95,9 +135,15 @@ def follower(coord_port: int) -> None:
     print("FOLLOWER_DONE", flush=True)
 
 
-async def single() -> None:
+async def single(kvbm: bool = False) -> None:
     """Single-process 4-device reference run of the same workload."""
-    engine = AsyncJaxEngine(EngineCore(engine_cfg()))
+    engine = AsyncJaxEngine(EngineCore(engine_cfg(kvbm)))
+
+    if kvbm:
+        out = await run_kvbm_workload(engine)
+        await engine.shutdown()
+        print("RESULT " + json.dumps(out), flush=True)
+        return
 
     async def one(req: PreprocessedRequest) -> list[int]:
         toks: list[int] = []
@@ -117,7 +163,9 @@ if __name__ == "__main__":
     mode = sys.argv[3] if len(sys.argv) > 3 else "multi"
     if mode == "single":
         asyncio.run(single())
+    elif mode == "single-kvbm":
+        asyncio.run(single(kvbm=True))
     elif rank == 0:
-        asyncio.run(leader(port))
+        asyncio.run(leader(port, kvbm=(mode == "kvbm")))
     else:
         follower(port)

@@ -1,0 +1,184 @@
+"""G4 remote KV block tier (reference: lib/llm/src/block_manager.rs:63-75
+CacheLevel::G4; storage/nixl.rs remote storage): server store semantics,
+client tier protocol, namespace isolation, outage degradation, the
+host→disk→remote cascade, engine determinism through the remote tier, and
+cross-engine prefix sharing through one store.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.cache import KVCacheSpec
+from dynamo_tpu.engine.engine import EngineCore
+from dynamo_tpu.kvbm.pools import DiskBlockPool, HostBlockPool, block_shape
+from dynamo_tpu.kvbm.remote import RemoteBlockPool, RemoteBlockServer
+
+from tests.test_engine import make_req, run_to_completion, tiny_config
+
+SPEC = KVCacheSpec(num_blocks=8, block_size=4, num_layers=2, num_kv_heads=2,
+                   head_dim=8, dtype="float32")
+
+
+def rand_block(rng) -> np.ndarray:
+    return rng.standard_normal(block_shape(SPEC)).astype(np.float32)
+
+
+class StoreFixture:
+    """RemoteBlockServer on a private event loop thread (the engine-side
+    client is synchronous, so the server must live elsewhere)."""
+
+    def __init__(self, capacity_bytes: int = 1 << 20):
+        self.loop = asyncio.new_event_loop()
+        self.server = RemoteBlockServer(capacity_bytes=capacity_bytes)
+        self._thread = threading.Thread(target=self.loop.run_forever, daemon=True)
+        self._thread.start()
+        fut = asyncio.run_coroutine_threadsafe(
+            self.server.start("127.0.0.1", 0), self.loop)
+        self.port = fut.result(10)
+        self.addr = f"127.0.0.1:{self.port}"
+
+    def close(self) -> None:
+        asyncio.run_coroutine_threadsafe(self.server.stop(), self.loop).result(10)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(5)
+
+
+@pytest.fixture()
+def store():
+    s = StoreFixture()
+    yield s
+    s.close()
+
+
+def test_remote_pool_put_get_roundtrip(store):
+    pool = RemoteBlockPool(SPEC, store.addr, fingerprint="m")
+    rng = np.random.default_rng(0)
+    b = rand_block(rng)
+    pool.put(7, b)
+    assert 7 in pool
+    np.testing.assert_array_equal(pool.get(7), b)
+    assert pool.get(8) is None
+    assert len(pool) == 1
+    assert pool.stats.hits == 1 and pool.stats.lookups == 2
+
+
+def test_remote_pool_namespace_isolation(store):
+    """Two models (fingerprints) sharing one store can never exchange blocks."""
+    rng = np.random.default_rng(1)
+    a = RemoteBlockPool(SPEC, store.addr, fingerprint="model-a")
+    b = RemoteBlockPool(SPEC, store.addr, fingerprint="model-b")
+    a.put(5, rand_block(rng))
+    assert 5 in a
+    assert 5 not in b
+    assert b.get(5) is None
+
+
+def test_remote_server_lru_eviction(store):
+    block_bytes = int(np.prod(block_shape(SPEC))) * 4
+    small = StoreFixture(capacity_bytes=2 * block_bytes)
+    try:
+        pool = RemoteBlockPool(SPEC, small.addr)
+        rng = np.random.default_rng(2)
+        b1, b2, b3 = rand_block(rng), rand_block(rng), rand_block(rng)
+        pool.put(1, b1)
+        pool.put(2, b2)
+        assert pool.get(1) is not None   # touch 1 → 2 becomes LRU
+        pool.put(3, b3)
+        assert 2 not in pool and 1 in pool and 3 in pool
+        assert small.server.stats.evictions == 1
+    finally:
+        small.close()
+
+
+def test_remote_pool_outage_degrades_to_misses():
+    """An unreachable store yields misses/drops, never exceptions."""
+    pool = RemoteBlockPool(SPEC, "127.0.0.1:1", timeout=0.2)  # nothing listens
+    rng = np.random.default_rng(3)
+    pool.put(1, rand_block(rng))       # dropped silently
+    assert pool.get(1) is None
+    assert 1 not in pool
+    assert len(pool) == 0
+
+
+def test_disk_overflow_cascades_to_remote(tmp_path, store):
+    """G3 victims spill to G4 instead of being deleted."""
+    block_bytes = int(np.prod(block_shape(SPEC))) * 4
+    remote = RemoteBlockPool(SPEC, store.addr, fingerprint="m")
+    disk = DiskBlockPool(SPEC, tmp_path, capacity_bytes=2 * block_bytes,
+                         fingerprint="m", overflow=remote)
+    rng = np.random.default_rng(4)
+    blocks = {h: rand_block(rng) for h in (1, 2, 3)}
+    for h, b in blocks.items():
+        disk.put(h, b)
+    assert 1 not in disk                  # evicted from disk...
+    np.testing.assert_array_equal(remote.get(1), blocks[1])  # ...lives in G4
+
+
+def test_full_cascade_host_disk_remote(tmp_path, store):
+    """A block pushed through G2→G3→G4 remains retrievable via the chain
+    walk that OffloadManager._lookup performs."""
+    block_bytes = int(np.prod(block_shape(SPEC))) * 4
+    remote = RemoteBlockPool(SPEC, store.addr, fingerprint="m")
+    disk = DiskBlockPool(SPEC, tmp_path, capacity_bytes=block_bytes,
+                         fingerprint="m", overflow=remote)
+    host = HostBlockPool(SPEC, capacity_blocks=1, overflow=disk)
+    rng = np.random.default_rng(5)
+    blocks = {h: rand_block(rng) for h in (1, 2, 3)}
+    for h, b in blocks.items():
+        host.put(h, b)
+    # host holds 3; disk holds 2; remote holds 1
+    assert 3 in host and 2 in disk and 1 in remote
+    tiers = [host, disk, remote]
+
+    def lookup(h):
+        for t in tiers:
+            b = t.get(h)
+            if b is not None:
+                return b
+        return None
+
+    for h, b in blocks.items():
+        np.testing.assert_array_equal(lookup(h), b)
+
+
+# -- engine e2e --------------------------------------------------------------
+
+def test_engine_offload_onboard_via_remote_tier(store):
+    """Same determinism contract as the host-tier e2e, but the ONLY tier is
+    the remote store: evict → offload to G4 → onboard → bit-identical."""
+    core = EngineCore(tiny_config(num_blocks=13, remote_kv_addr=store.addr))
+    assert core.kvbm is not None
+    prompt_a = list(range(100, 124))
+
+    first, _ = run_to_completion(core, [make_req(prompt=prompt_a, max_tokens=6, rid="a1")])
+    fillers = [make_req(prompt=[200 + 30 * i + j for j in range(24)], max_tokens=4,
+                        rid=f"f{i}") for i in range(4)]
+    run_to_completion(core, fillers)
+    assert core.kvbm.stats.offloaded_blocks > 0
+    assert store.server.stats.stores > 0
+
+    second, _ = run_to_completion(core, [make_req(prompt=prompt_a, max_tokens=6, rid="a2")])
+    assert core.kvbm.stats.onboarded_blocks > 0
+    assert second["a2"] == first["a1"]
+
+
+def test_cross_engine_prefix_sharing(store):
+    """The G4 promise: engine B onboards a prefix engine A computed."""
+    prompt = list(range(300, 324))
+    core_a = EngineCore(tiny_config(num_blocks=13, remote_kv_addr=store.addr))
+    first, _ = run_to_completion(core_a, [make_req(prompt=prompt, max_tokens=6, rid="a")])
+    # Push A's blocks out to the store by churning its pool.
+    fillers = [make_req(prompt=[400 + 30 * i + j for j in range(24)], max_tokens=4,
+                        rid=f"f{i}") for i in range(4)]
+    run_to_completion(core_a, fillers)
+    assert store.server.stats.stores > 0
+
+    core_b = EngineCore(tiny_config(num_blocks=13, remote_kv_addr=store.addr))
+    second, _ = run_to_completion(core_b, [make_req(prompt=prompt, max_tokens=6, rid="b")])
+    assert core_b.kvbm is not None and core_b.kvbm.stats.onboarded_blocks > 0
+    assert second["b"] == first["a"]
