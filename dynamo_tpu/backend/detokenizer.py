@@ -58,6 +58,7 @@ class DetokenizerBackend:
                     token_ids=list(out.token_ids),
                     finish_reason=FinishReason.STOP,
                     cum_log_probs=out.cum_log_probs,
+                    log_probs=out.log_probs,
                 )
 
         # 2. stream end → flush the jail (no stop hit)
@@ -70,13 +71,16 @@ class DetokenizerBackend:
                 token_ids=list(out.token_ids),
                 finish_reason=out.finish_reason,
                 cum_log_probs=out.cum_log_probs,
+                log_probs=out.log_probs,
             )
 
         # 3. jail any suffix that could grow into a stop string
         k = _longest_partial_suffix(buf, self.stops) if self.stops else 0
         st.jailed = buf[len(buf) - k :] if k else ""
         emit = buf[: len(buf) - k] if k else buf
-        return BackendOutput(text=emit, token_ids=list(out.token_ids), cum_log_probs=out.cum_log_probs)
+        return BackendOutput(text=emit, token_ids=list(out.token_ids),
+                             cum_log_probs=out.cum_log_probs,
+                             log_probs=out.log_probs)
 
     @property
     def hit_stop(self) -> bool:

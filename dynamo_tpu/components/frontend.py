@@ -22,6 +22,7 @@ from dynamo_tpu.preprocessor.preprocessor import ModelDefaults
 from dynamo_tpu.protocols.common import LLMEngineOutput
 from dynamo_tpu.router.kv_router import KvPushRouter, KvRouterConfig
 from dynamo_tpu.runtime.client import EndpointClient, PushRouter, RouterMode
+from dynamo_tpu.runtime.pipeline import MapOutput, link
 from dynamo_tpu.runtime.protocols import MODEL_PREFIX, EndpointId
 from dynamo_tpu.runtime.runtime import DistributedRuntime
 from dynamo_tpu.tokenizer import load_tokenizer
@@ -130,12 +131,18 @@ class ModelWatcher:
                 async for item in push.generate(req.to_dict(), req.request_id):
                     yield item
 
-        migration = Migration(routed, migration_limit=self.args.migration_limit,
-                              wait_ready=client.wait_for_instances)
-
-        async def generate(req):
-            async for item in migration.generate(req):
-                yield LLMEngineOutput.from_dict(item)
+        # The routed model pipeline as a typed operator chain (reference:
+        # build_routed_pipeline, entrypoint/input/common.rs:259). Stream
+        # direction runs sink→left, so the decode stage is leftmost: the
+        # migration operator retries over raw wire dicts, the consumer
+        # receives LLMEngineOutput.
+        pipeline = link(
+            MapOutput(LLMEngineOutput.from_dict),
+            Migration(migration_limit=self.args.migration_limit,
+                      wait_ready=client.wait_for_instances),
+            sink=routed,
+        )
+        generate = pipeline.generate
 
         def stats_fn(client=client, router=router) -> dict:
             # Worker-published engine stats (incl. KVBM tiers) relayed over

@@ -23,15 +23,63 @@ from dynamo_tpu.protocols.openai import (
 )
 
 
+def chat_logprob_content(outs: list[BackendOutput], tokenizer) -> list[dict]:
+    """OpenAI chat ``logprobs.content`` entries for the sampled tokens
+    (reference shape: lib/async-openai chat logprobs; analysis consumers:
+    lib/llm/src/perf/logprobs.rs). ``top_logprobs`` is empty — the engine
+    samples without materializing alternatives (requests asking for
+    top_logprobs > 0 are rejected up front at the HTTP layer). A backend
+    that measured no logprob (mocker, old wire peers) yields ``null``, not
+    a fabricated certainty — same contract as the completions shape."""
+    content: list[dict] = []
+    for o in outs:
+        lps = o.log_probs or [None] * len(o.token_ids)
+        for tok, lp in zip(o.token_ids, lps):
+            piece = tokenizer.decode([tok]) if tokenizer is not None else ""
+            content.append({
+                "token": piece,
+                "logprob": lp,
+                "bytes": list(piece.encode("utf-8")),
+                "top_logprobs": [],
+            })
+    return content
+
+
+def completion_logprobs(outs: list[BackendOutput], tokenizer,
+                        start_offset: int = 0) -> dict:
+    """OpenAI completions ``logprobs`` object (tokens / token_logprobs /
+    text_offset; top_logprobs omitted — see chat_logprob_content).
+    ``start_offset`` continues cumulative text positions across streamed
+    chunks so stream and aggregate report identical offsets."""
+    tokens: list[str] = []
+    token_logprobs: list[float | None] = []
+    text_offset: list[int] = []
+    offset = start_offset
+    for o in outs:
+        lps = o.log_probs or [None] * len(o.token_ids)
+        for tok, lp in zip(o.token_ids, lps):
+            piece = tokenizer.decode([tok]) if tokenizer is not None else ""
+            tokens.append(piece)
+            token_logprobs.append(lp)
+            text_offset.append(offset)
+            offset += len(piece)
+    return {"tokens": tokens, "token_logprobs": token_logprobs,
+            "text_offset": text_offset, "top_logprobs": None}
+
+
 class ChatDeltaGenerator:
     """Builds chat.completion.chunk SSE events from backend deltas."""
 
-    def __init__(self, model: str, request_id: str | None = None):
+    def __init__(self, model: str, request_id: str | None = None,
+                 logprobs: bool = False, tokenizer=None):
         self.id = f"chatcmpl-{request_id or uuid.uuid4().hex}"
         self.model = model
         self._first = True
         self.completion_tokens = 0
         self.prompt_tokens = 0
+        self.logprobs = logprobs
+        self.tokenizer = tokenizer
+        self._pending_lp: list[BackendOutput] = []
 
     def role_chunk(self) -> ChatCompletionChunk:
         return ChatCompletionChunk(
@@ -42,12 +90,24 @@ class ChatDeltaGenerator:
     def chunk(self, out: BackendOutput) -> ChatCompletionChunk | None:
         self.completion_tokens += len(out.token_ids)
         if not out.text and out.finish_reason is None:
-            return None  # jailed/empty delta — emit nothing
+            # jailed/empty delta — emit nothing, but HOLD its tokens'
+            # logprobs: they ride the next emitted chunk so the stream's
+            # logprob entries stay complete (equal to completion_tokens).
+            if self.logprobs and out.token_ids:
+                self._pending_lp.append(out)
+            return None
+        lp = None
+        if self.logprobs:
+            carried = self._pending_lp + ([out] if out.token_ids else [])
+            self._pending_lp = []
+            if carried:
+                lp = {"content": chat_logprob_content(carried, self.tokenizer)}
         return ChatCompletionChunk(
             id=self.id, model=self.model,
             choices=[ChatChunkChoice(
                 delta=ChatChoiceDelta(content=out.text or None),
                 finish_reason=str(out.finish_reason) if out.finish_reason else None,
+                logprobs=lp,
             )],
         )
 
@@ -86,7 +146,8 @@ class ChatDeltaGenerator:
 
 
 def aggregate_chat(model: str, outs: list[BackendOutput], prompt_tokens: int,
-                   jail=None) -> ChatCompletionResponse:
+                   jail=None, logprobs: bool = False,
+                   tokenizer=None) -> ChatCompletionResponse:
     """Aggregate deltas into one chat response; with a ``jail``
     (parsers.StreamJail), tool calls and reasoning are parsed out of the
     text and finish_reason becomes tool_calls when calls were made."""
@@ -109,7 +170,11 @@ def aggregate_chat(model: str, outs: list[BackendOutput], prompt_tokens: int,
             finish = "tool_calls"
     return ChatCompletionResponse(
         model=model,
-        choices=[ChatChoice(message=message, finish_reason=finish)],
+        choices=[ChatChoice(
+            message=message, finish_reason=finish,
+            logprobs=({"content": chat_logprob_content(outs, tokenizer)}
+                      if logprobs else None),
+        )],
         usage=Usage(
             prompt_tokens=prompt_tokens,
             completion_tokens=completion_tokens,
@@ -118,13 +183,18 @@ def aggregate_chat(model: str, outs: list[BackendOutput], prompt_tokens: int,
     )
 
 
-def aggregate_completion(model: str, outs: list[BackendOutput], prompt_tokens: int) -> CompletionResponse:
+def aggregate_completion(model: str, outs: list[BackendOutput], prompt_tokens: int,
+                         logprobs: bool = False,
+                         tokenizer=None) -> CompletionResponse:
     text = "".join(o.text for o in outs)
     finish = next((str(o.finish_reason) for o in outs if o.finish_reason), None)
     completion_tokens = sum(len(o.token_ids) for o in outs)
     return CompletionResponse(
         model=model,
-        choices=[CompletionChoice(text=text, finish_reason=finish)],
+        choices=[CompletionChoice(
+            text=text, finish_reason=finish,
+            logprobs=(completion_logprobs(outs, tokenizer) if logprobs else None),
+        )],
         usage=Usage(
             prompt_tokens=prompt_tokens,
             completion_tokens=completion_tokens,

@@ -10,10 +10,11 @@ appended to the prompt (KV rebuilds via prefix cache or recompute), up to
 
 from __future__ import annotations
 
-from typing import Any, AsyncIterator, Awaitable, Callable, Protocol
+from typing import AsyncIterator, Awaitable, Callable
 
 from dynamo_tpu.protocols.common import PreprocessedRequest
 from dynamo_tpu.runtime.client import NoInstancesError, StreamError
+from dynamo_tpu.runtime.pipeline import NextFn, Operator
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("migration")
@@ -22,21 +23,29 @@ log = get_logger("migration")
 RoutedGenerate = Callable[[PreprocessedRequest], AsyncIterator[dict]]
 
 
-class Migration:
-    def __init__(self, inner: RoutedGenerate, migration_limit: int = 3,
+class Migration(Operator):
+    """Pipeline operator (runtime/pipeline.py): the retrying backward edge.
+    ``inner`` binds a fixed downstream for standalone use; inside a linked
+    pipeline the ``next`` callable supersedes it."""
+
+    def __init__(self, inner: RoutedGenerate | None = None,
+                 migration_limit: int = 3,
                  wait_ready: Callable[[float], Awaitable[None]] | None = None):
         self.inner = inner
         self.migration_limit = migration_limit
         self.wait_ready = wait_ready  # e.g. EndpointClient.wait_for_instances
 
-    async def generate(self, req: PreprocessedRequest) -> AsyncIterator[dict]:
+    async def generate(self, req: PreprocessedRequest,
+                       next: NextFn | None = None) -> AsyncIterator[dict]:
+        inner = next or self.inner
+        assert inner is not None, "Migration needs a downstream (inner or next)"
         attempts = 0
         generated: list[int] = []
         current = req
         while True:
             finished = False
             try:
-                async for out in self.inner(current):
+                async for out in inner(current):
                     toks = out.get("token_ids") or []
                     generated.extend(toks)
                     if out.get("finish_reason"):

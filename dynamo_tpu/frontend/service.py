@@ -50,6 +50,13 @@ def _error(status: int, message: str) -> web.Response:
     return web.Response(status=status, text=body, content_type="application/json")
 
 
+
+def _wants_logprobs(req, chat: bool) -> bool:
+    """THE chat-vs-completions logprob acceptance rule, in one place:
+    chat uses a boolean flag; completions uses an int where 0 still means
+    "sampled-token logprobs" (top-N alternatives are rejected upstream)."""
+    return bool(req.logprobs) if chat else req.logprobs is not None
+
 class HttpService:
     def __init__(self, models: ModelManager | None = None, metrics: MetricsRegistry | None = None):
         # NOT `models or ...`: ModelManager is empty (falsy by __len__) at
@@ -346,6 +353,18 @@ class HttpService:
             self._requests.inc(route=route, status="400")
             return _error(400, f"preprocessing failed: {exc}")
 
+        # Logprob surface: the sampled token's logprob streams end-to-end;
+        # alternatives (top_logprobs / completions logprobs>0) would need the
+        # engine to materialize top-k at sample time — rejected explicitly
+        # rather than silently returning empty lists.
+        if chat and (req.top_logprobs or 0) > 0:
+            self._requests.inc(route=route, status="400")
+            return _error(400, "top_logprobs > 0 is not supported "
+                               "(sampled-token logprobs only)")
+        if not chat and (req.logprobs or 0) > 0:
+            self._requests.inc(route=route, status="400")
+            return _error(400, "logprobs > 0 is not supported "
+                               "(pass 0 for sampled-token logprobs)")
         if req.n != 1:
             # Validate here, before the per-model counters tick — a rejected
             # request must not inflate load metrics.
@@ -454,10 +473,15 @@ class HttpService:
             self._requests.inc(route=route, status="500")
             return _error(500, error)
         n_prompt = len(pre.token_ids)
+        wants_lp = _wants_logprobs(req, chat)
         agg = ((lambda outs: aggregate_chat(req.model, outs, n_prompt,
-                                            jail=self._make_jail(entry, req)))
+                                            jail=self._make_jail(entry, req),
+                                            logprobs=wants_lp,
+                                            tokenizer=entry.tokenizer))
                if chat else
-               (lambda outs: aggregate_completion(req.model, outs, n_prompt)))
+               (lambda outs: aggregate_completion(req.model, outs, n_prompt,
+                                                  logprobs=wants_lp,
+                                                  tokenizer=entry.tokenizer)))
         parts = [agg(outs) for outs in all_outs]
         resp = parts[0]
         for i, part in enumerate(parts):
@@ -495,9 +519,11 @@ class HttpService:
                     error=str(exc)))
             return _error(500, str(exc))
         self._output_tokens.inc(sum(len(o.token_ids) for o in outs), model=req.model)
+        wants_lp = _wants_logprobs(req, chat)
         if chat:
             resp = aggregate_chat(req.model, outs, len(pre.token_ids),
-                                  jail=self._make_jail(entry, req))
+                                  jail=self._make_jail(entry, req),
+                                  logprobs=wants_lp, tokenizer=entry.tokenizer)
             if self._audit.bus() is not None:
                 self._audit.publish(self._audit.AuditRecord(
                     request_id=pre.request_id, model=req.model,
@@ -505,7 +531,9 @@ class HttpService:
                     request=req.model_dump(exclude_none=True),
                     response=resp.model_dump(exclude_none=True)))
         else:
-            resp = aggregate_completion(req.model, outs, len(pre.token_ids))
+            resp = aggregate_completion(req.model, outs, len(pre.token_ids),
+                                        logprobs=wants_lp,
+                                        tokenizer=entry.tokenizer)
         self._requests.inc(route=route, status="200")
         return web.Response(text=resp.model_dump_json(exclude_none=True), content_type="application/json")
 
@@ -518,7 +546,9 @@ class HttpService:
         )
         await resp.prepare(request)
         backend = DetokenizerBackend(entry.tokenizer, stops=pre.stop_conditions.stop)
-        gen = ChatDeltaGenerator(req.model, pre.request_id)
+        wants_lp = _wants_logprobs(req, chat)
+        gen = ChatDeltaGenerator(req.model, pre.request_id,
+                                 logprobs=wants_lp, tokenizer=entry.tokenizer)
         gen.prompt_tokens = len(pre.token_ids)
         jail = self._make_jail(entry, req) if chat else None
         jail_flushed = False
@@ -528,6 +558,8 @@ class HttpService:
         audit_text: list[str] = []
         audit_tool_calls: list = []
         audit_error: str | None = None
+        lp_pending: list[BackendOutput] = []  # completions: jailed-delta lps
+        lp_offset = 0                         # completions: cumulative text pos
         try:
             if chat:
                 await resp.write(encode_sse_json(gen.role_chunk()))
@@ -573,24 +605,43 @@ class HttpService:
                                 continue
                             out = BackendOutput(text=tail, token_ids=out.token_ids,
                                                 finish_reason=out.finish_reason,
-                                                cum_log_probs=out.cum_log_probs)
+                                                cum_log_probs=out.cum_log_probs,
+                                                log_probs=out.log_probs)
                         else:
                             out = BackendOutput(text=jd.content, token_ids=out.token_ids,
-                                                cum_log_probs=out.cum_log_probs)
+                                                cum_log_probs=out.cum_log_probs,
+                                                log_probs=out.log_probs)
                     chunk = gen.chunk(out)
                     if chunk is not None:
                         if out.text:
                             audit_text.append(out.text)
                         await resp.write(encode_sse_json(chunk))
                 else:
-                    if out.text or out.finish_reason:
+                    if not out.text and out.finish_reason is None:
+                        # jailed/empty delta: hold its tokens' logprobs for
+                        # the next emitted chunk (stream completeness).
+                        if wants_lp and out.token_ids:
+                            lp_pending.append(out)
+                    else:
+                        from dynamo_tpu.frontend.delta import completion_logprobs
                         from dynamo_tpu.protocols.openai import CompletionChoice, CompletionResponse
 
+                        lp = None
+                        if wants_lp:
+                            carried = lp_pending + ([out] if out.token_ids else [])
+                            lp_pending = []
+                            if carried:
+                                lp = completion_logprobs(
+                                    carried, entry.tokenizer,
+                                    start_offset=lp_offset)
+                                lp_offset = (lp["text_offset"][-1]
+                                             + len(lp["tokens"][-1]))
                         cr = CompletionResponse(
                             id=f"cmpl-{pre.request_id}", model=req.model,
                             choices=[CompletionChoice(
                                 text=out.text,
-                                finish_reason=str(out.finish_reason) if out.finish_reason else None)],
+                                finish_reason=str(out.finish_reason) if out.finish_reason else None,
+                                logprobs=lp)],
                         )
                         await resp.write(encode_sse_json(cr))
                 if backend.hit_stop:

@@ -173,3 +173,4 @@ class BackendOutput:
     token_ids: list[int] = field(default_factory=list)
     finish_reason: FinishReason | None = None
     cum_log_probs: float | None = None
+    log_probs: list[float] | None = None  # per token in token_ids

@@ -76,7 +76,14 @@ class DistributedRuntime:
         self._advertise_host = "127.0.0.1"
         self.data_port: int = 0
         self._inflight = self.metrics.gauge("runtime_inflight_requests", "in-flight handler streams")
-        self._tasks: set[asyncio.Task] = set()
+        # Structured ownership of every background coroutine this node runs
+        # (reference: utils/tasks/tracker.rs): handler streams live in a
+        # bounded child; components hang their own children off `tasks`.
+        from dynamo_tpu.runtime.tasks import TaskTracker
+
+        self.tasks = TaskTracker(name=f"rt{os.getpid()}")
+        self._streams = self.tasks.child(
+            "streams", max_concurrency=self.config.max_handler_streams)
         self._draining = False
         # Per-process system status server (reference:
         # system_status_server.rs), env-gated DYN_SYSTEM_ENABLED/PORT.
@@ -115,10 +122,12 @@ class DistributedRuntime:
                 await self.client.delete(
                     served.endpoint.instance_key(self.instance_id))
         deadline = time.monotonic() + self.config.drain_timeout_s
-        while self._tasks and time.monotonic() < deadline:
+        while self._streams.active and time.monotonic() < deadline:
             await asyncio.sleep(0.05)
-        for t in self._tasks:
-            t.cancel()
+        # Bounded teardown: drain_timeout_s caps the WHOLE shutdown — a
+        # handler wedged past cancellation is abandoned, not waited on.
+        await self.tasks.close(
+            timeout=max(deadline - time.monotonic(), 1.0))
         if self.status_server is not None:
             await self.status_server.stop()
         if self.primary_lease and self.client:
@@ -168,11 +177,12 @@ class DistributedRuntime:
                     await conn.send({"t": Frame.PONG})
                 elif t == Frame.CALL:
                     sid = msg["stream_id"]
-                    task = asyncio.create_task(self._run_stream(conn, sid, msg))
+                    task = self._streams.spawn(
+                        self._run_stream, conn, sid, msg,
+                        name=f"stream-{msg.get('endpoint', '?')}-{sid}")
                     streams[sid] = task
-                    self._tasks.add(task)
                     task.add_done_callback(
-                        lambda t_, sid=sid: (self._tasks.discard(t_), streams.pop(sid, None)))
+                        lambda t_, sid=sid: streams.pop(sid, None))
                 elif t == Frame.CANCEL:
                     task = streams.get(msg.get("stream_id"))
                     if task:

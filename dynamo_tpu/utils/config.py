@@ -51,6 +51,9 @@ class RuntimeConfig:
     lease_ttl_s: float = 20.0
     # Graceful shutdown drain deadline.
     drain_timeout_s: float = 30.0
+    # Scheduling-policy bound on concurrently-executing handler streams
+    # (excess CALLs queue; reference: tracker.rs semaphore policies).
+    max_handler_streams: int = 1024
 
     @classmethod
     def from_settings(cls, path: str | os.PathLike | None = None, **overrides: Any) -> "RuntimeConfig":

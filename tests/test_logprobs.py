@@ -1,0 +1,230 @@
+"""Logprob surface + analysis (reference: async-openai logprob types;
+lib/llm/src/perf/logprobs.rs): engine→detokenizer passthrough, chat and
+completions response shapes (aggregate + streaming), top-logprobs
+rejection, and the analysis statistics.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.frontend.model_manager import ModelManager
+from dynamo_tpu.frontend.service import HttpService
+from dynamo_tpu.preprocessor.preprocessor import ModelDefaults
+from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput
+from dynamo_tpu.tokenizer import ByteTokenizer
+from dynamo_tpu.utils.logprob_analysis import (
+    SequenceStats,
+    analyze_recording,
+    from_chat_response,
+    from_chat_stream,
+    from_completion_response,
+    from_engine_outputs,
+)
+
+
+def lp_generate(text: str, chunk: int = 4):
+    """Canned engine emitting deterministic per-token logprobs."""
+    tok = ByteTokenizer()
+    ids = tok.encode(text)
+
+    async def generate(pre):
+        for i in range(0, len(ids), chunk):
+            part = ids[i : i + chunk]
+            last = i + chunk >= len(ids)
+            yield LLMEngineOutput(
+                token_ids=part,
+                log_probs=[-0.25 * (i + j + 1) for j in range(len(part))],
+                cum_log_probs=0.0,
+                finish_reason=FinishReason.STOP if last else None)
+
+    return generate
+
+
+async def _serve(text: str = "hola mundo"):
+    models = ModelManager()
+    models.register("m", ByteTokenizer(), lp_generate(text),
+                    defaults=ModelDefaults())
+    svc = HttpService(models)
+    port = await svc.start(port=0)
+    return svc, f"http://127.0.0.1:{port}"
+
+
+async def test_chat_aggregate_logprobs():
+    svc, base = await _serve()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(f"{base}/v1/chat/completions", json={
+                "model": "m", "messages": [{"role": "user", "content": "hi"}],
+                "logprobs": True, "max_tokens": 64})
+            assert r.status == 200, await r.text()
+            data = await r.json()
+        content = data["choices"][0]["logprobs"]["content"]
+        assert len(content) == data["usage"]["completion_tokens"]
+        assert content[0]["logprob"] == pytest.approx(-0.25)
+        assert content[1]["logprob"] == pytest.approx(-0.5)
+        assert isinstance(content[0]["token"], str)
+        assert content[0]["bytes"] == list(content[0]["token"].encode())
+
+        # without the flag: no logprobs key
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(f"{base}/v1/chat/completions", json={
+                "model": "m", "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 64})
+            data = await r.json()
+        assert data["choices"][0].get("logprobs") is None
+    finally:
+        await svc.stop()
+
+
+async def test_completion_logprobs_and_stream():
+    svc, base = await _serve()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(f"{base}/v1/completions", json={
+                "model": "m", "prompt": "x", "logprobs": 0, "max_tokens": 64})
+            assert r.status == 200, await r.text()
+            data = await r.json()
+            lp = data["choices"][0]["logprobs"]
+            assert len(lp["tokens"]) == len(lp["token_logprobs"]) == len(lp["text_offset"])
+            assert lp["token_logprobs"][0] == pytest.approx(-0.25)
+            # offsets are cumulative text positions
+            assert lp["text_offset"][0] == 0
+            assert lp["text_offset"] == sorted(lp["text_offset"])
+
+            # streaming chat with logprobs: every content chunk carries them
+            got = []
+            async with s.post(f"{base}/v1/chat/completions", json={
+                    "model": "m", "messages": [{"role": "user", "content": "q"}],
+                    "logprobs": True, "stream": True, "max_tokens": 64}) as r:
+                assert r.status == 200
+                async for raw in r.content:
+                    line = raw.decode().strip()
+                    if not line.startswith("data:") or line == "data: [DONE]":
+                        continue
+                    ev = json.loads(line[5:])
+                    for c in ev.get("choices", []):
+                        content = (c.get("logprobs") or {}).get("content") or []
+                        got.extend(e["logprob"] for e in content)
+        assert got and got[0] == pytest.approx(-0.25)
+    finally:
+        await svc.stop()
+
+
+async def test_top_logprobs_rejected():
+    svc, base = await _serve()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(f"{base}/v1/chat/completions", json={
+                "model": "m", "messages": [{"role": "user", "content": "x"}],
+                "logprobs": True, "top_logprobs": 3})
+            assert r.status == 400 and "top_logprobs" in await r.text()
+            r = await s.post(f"{base}/v1/completions", json={
+                "model": "m", "prompt": "x", "logprobs": 2})
+            assert r.status == 400
+    finally:
+        await svc.stop()
+
+
+async def test_stream_logprobs_complete_under_jail():
+    """A delta ENTIRELY withheld by the stop-string jail (emit="", tokens
+    present → ChatDeltaGenerator.chunk returns None) still delivers its
+    tokens' logprobs, carried on the next emitted chunk: streamed entries
+    == completion_tokens."""
+    models = ModelManager()
+    # chunk=2 and a leading "WX" → the first delta's text is entirely a
+    # partial stop-string suffix: fully jailed.
+    models.register("m", ByteTokenizer(), lp_generate("WXabcd", chunk=2),
+                    defaults=ModelDefaults())
+    svc = HttpService(models)
+    port = await svc.start(port=0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            entries = 0
+            usage_tokens = None
+            async with s.post(f"{base}/v1/chat/completions", json={
+                    "model": "m", "messages": [{"role": "user", "content": "q"}],
+                    "logprobs": True, "stream": True, "max_tokens": 64,
+                    "stop": ["WXYZ"],
+                    "stream_options": {"include_usage": True}}) as r:
+                assert r.status == 200
+                async for raw in r.content:
+                    line = raw.decode().strip()
+                    if not line.startswith("data:") or line == "data: [DONE]":
+                        continue
+                    ev = json.loads(line[5:])
+                    if ev.get("usage"):
+                        usage_tokens = ev["usage"]["completion_tokens"]
+                    for c in ev.get("choices", []):
+                        entries += len((c.get("logprobs") or {}).get("content") or [])
+        assert usage_tokens is not None
+        assert entries == usage_tokens, (entries, usage_tokens)
+    finally:
+        await svc.stop()
+
+
+# -- analysis ----------------------------------------------------------------
+
+def chat_resp(lps):
+    return {"id": "c1", "object": "chat.completion", "choices": [{
+        "logprobs": {"content": [
+            {"token": f"t{i}", "logprob": lp} for i, lp in enumerate(lps)]}}]}
+
+
+def test_sequence_stats():
+    stats = from_chat_response(chat_resp([-0.1, -0.2, -6.0, -0.3]))
+    assert stats.num_tokens == 4
+    assert stats.total_logprob == pytest.approx(-6.6)
+    assert stats.perplexity == pytest.approx(math.exp(6.6 / 4))
+    worst = stats.min_logprob()
+    assert worst.position == 2 and worst.token == "t2"
+    assert [t.position for t in stats.low_confidence(threshold=-4.0)] == [2]
+    s = stats.summary()
+    assert s["min_logprob_token"] == "t2" and s["low_confidence_count"] == 1
+
+
+def test_window_perplexity_localizes_spike():
+    lps = [-0.1] * 16 + [-8.0] * 4 + [-0.1] * 16
+    stats = SequenceStats(tokens=[])
+    stats = from_chat_response(chat_resp(lps))
+    win = stats.window_perplexity(window=4)
+    assert len(win) == len(lps) - 3
+    assert max(win) == pytest.approx(math.exp(8.0))
+    assert win.index(max(win)) == 16  # spike located at the bad region
+
+
+def test_from_stream_and_completion_and_engine():
+    chunks = [chat_resp([-0.5]), chat_resp([-1.0, -1.5])]
+    stats = from_chat_stream(chunks)
+    assert [t.logprob for t in stats.tokens] == [-0.5, -1.0, -1.5]
+    assert stats.request_id == "c1"
+
+    comp = {"id": "x", "object": "text_completion", "choices": [{
+        "logprobs": {"tokens": ["a", "b"], "token_logprobs": [-0.2, None],
+                     "text_offset": [0, 1]}}]}
+    stats = from_completion_response(comp)
+    # unmeasured (None) entries are skipped, not treated as certainty
+    assert stats.num_tokens == 1 and stats.tokens[0].logprob == pytest.approx(-0.2)
+
+    outs = [LLMEngineOutput(token_ids=[1, 2], log_probs=[-0.3, -0.4])]
+    stats = from_engine_outputs(outs, request_id="e")
+    assert stats.total_logprob == pytest.approx(-0.7)
+
+
+def test_analyze_recording(tmp_path):
+    p = tmp_path / "rec.jsonl"
+    lines = [
+        json.dumps({"payload": chat_resp([-0.1, -0.2])}),
+        json.dumps(chat_resp([-1.0])),
+        json.dumps({"object": "something.else"}),
+        json.dumps({"payload": "not-json{{"}),
+    ]
+    p.write_text("\n".join(lines) + "\n")
+    out = analyze_recording(str(p))
+    assert len(out) == 2
+    assert out[0]["num_tokens"] == 2 and out[1]["num_tokens"] == 1

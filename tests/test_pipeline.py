@@ -1,0 +1,143 @@
+"""Typed operator pipeline (reference: lib/runtime/src/pipeline.rs
+Source/Sink/Operator + link(); echo tests lib/runtime/tests/pipeline.rs):
+composition order, forward/backward edges, nesting, retry operators,
+cancellation propagation, and the Migration operator in a linked chain.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.frontend.migration import Migration
+from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.runtime.client import StreamError
+from dynamo_tpu.runtime.pipeline import (
+    FnSink,
+    MapOutput,
+    MapRequest,
+    Operator,
+    Pipeline,
+    link,
+)
+
+
+class Tag(Operator):
+    """Tags the request on the way in and every item on the way out —
+    makes edge traversal order observable."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    async def generate(self, req, next):
+        async for item in next(req + [f">{self.name}"]):
+            yield f"{item}<{self.name}"
+
+
+async def echo(req):
+    yield "|".join(req)
+    yield "second"
+
+
+async def test_link_order_and_edges():
+    pipe = link(Tag("a"), Tag("b"), sink=echo)
+    items = [x async for x in pipe.generate(["req"])]
+    # forward: a then b; backward: b's tag applied first, then a's
+    assert items == ["req|>a|>b<b<a", "second<b<a"]
+
+
+async def test_map_request_and_output():
+    pipe = link(MapOutput(str.upper), MapRequest(lambda r: r * 2),
+                sink=lambda req: echo(req))
+    items = [x async for x in pipe.generate(["x"])]
+    assert items == ["X|X", "SECOND"]
+
+
+async def test_pipelines_nest():
+    inner = link(Tag("in"), sink=echo)
+    outer = link(Tag("out"), sink=inner)
+    items = [x async for x in outer.generate(["r"])]
+    assert items == ["r|>out|>in<in<out", "second<in<out"]
+
+
+async def test_bare_callable_sink_and_validation():
+    assert isinstance(link(sink=echo), Pipeline)
+    assert isinstance(link(echo), Pipeline)  # last positional is the sink
+    with pytest.raises(ValueError):
+        link()
+    with pytest.raises(TypeError):
+        link("not-an-operator", sink=echo)
+    items = [x async for x in FnSink(echo).generate(["z"])]
+    assert items == ["z", "second"]
+
+
+async def test_retry_operator_calls_next_again():
+    """An operator may re-invoke next — the retry/migration shape."""
+    calls = {"n": 0}
+
+    async def flaky(req):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            yield "partial"
+            raise StreamError("boom")
+        yield "ok"
+
+    class Retry(Operator):
+        async def generate(self, req, next):
+            try:
+                async for item in next(req):
+                    yield item
+            except StreamError:
+                async for item in next(req):
+                    yield item
+
+    items = [x async for x in link(Retry(), sink=flaky).generate(["r"])]
+    assert items == ["partial", "ok"]
+    assert calls["n"] == 2
+
+
+async def test_cancellation_closes_inner_generators():
+    """Closing the outer stream runs the sink's finalizer (async-generator
+    cancellation IS the pipeline's teardown path)."""
+    closed = asyncio.Event()
+
+    async def sink(req):
+        try:
+            for i in range(100):
+                yield i
+                await asyncio.sleep(0)
+        finally:
+            closed.set()
+
+    pipe = link(Tag("t"), sink=sink)
+
+    async def consume():
+        async for _ in pipe.generate(["r"]):
+            raise RuntimeError("stop early")
+
+    with pytest.raises(RuntimeError):
+        await consume()
+    await asyncio.wait_for(closed.wait(), 5)
+
+
+async def test_migration_as_linked_operator():
+    """Migration inside link(): retries through the pipeline's next, resumes
+    with generated tokens appended."""
+    attempts = []
+
+    async def worker(req):
+        attempts.append(list(req.token_ids))
+        if len(attempts) == 1:
+            yield {"token_ids": [7, 8]}
+            raise StreamError("worker died")
+        yield {"token_ids": [9], "finish_reason": "stop"}
+
+    pipe = link(Migration(migration_limit=2), sink=worker)
+    req = PreprocessedRequest(token_ids=[1, 2, 3])
+    req.request_id = "m1"
+    items = [x async for x in pipe.generate(req)]
+    toks = [t for item in items for t in item.get("token_ids", [])]
+    assert toks == [7, 8, 9]
+    assert attempts[0] == [1, 2, 3]
+    assert attempts[1] == [1, 2, 3, 7, 8]  # resumed with generated suffix

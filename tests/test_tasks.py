@@ -1,0 +1,146 @@
+"""Structured task tracker + compute pool (reference:
+lib/runtime/src/utils/tasks/tracker.rs scheduling/error policies,
+continuations, child trackers; utils/tasks/critical.rs; compute/pool.rs):
+concurrency bounding, retry with backoff, critical-task fatal hook,
+hierarchical cancel, counters, and off-loop blocking compute.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from dynamo_tpu.runtime.tasks import ComputePool, RetryPolicy, TaskTracker
+
+
+async def test_spawn_and_result():
+    tr = TaskTracker()
+
+    async def work(x):
+        return x * 2
+
+    assert await tr.spawn(work, 21) == 42
+    assert tr.counts.spawned == 1
+    await tr.join()
+    assert tr.counts.succeeded == 1 and tr.active == 0
+
+
+async def test_concurrency_bound_is_enforced():
+    tr = TaskTracker(max_concurrency=2)
+    running = 0
+    peak = 0
+
+    async def work():
+        nonlocal running, peak
+        running += 1
+        peak = max(peak, running)
+        await asyncio.sleep(0.02)
+        running -= 1
+
+    await asyncio.gather(*(tr.spawn(work) for _ in range(8)))
+    assert peak == 2
+    assert tr.counts.succeeded == 8
+
+
+async def test_retry_policy_retries_then_succeeds():
+    tr = TaskTracker()
+    attempts = {"n": 0}
+
+    async def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    out = await tr.spawn(flaky, retry=RetryPolicy(
+        max_attempts=5, backoff_base_s=0.01, retry_on=(ConnectionError,)))
+    assert out == "ok" and attempts["n"] == 3
+    assert tr.counts.retries == 2
+
+
+async def test_retry_policy_exhaustion_and_nonmatching():
+    tr = TaskTracker()
+
+    async def always_conn():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        await tr.spawn(always_conn, retry=RetryPolicy(
+            max_attempts=2, backoff_base_s=0.01, retry_on=(ConnectionError,)))
+
+    async def value_err():
+        raise ValueError("no retry for me")
+
+    with pytest.raises(ValueError):
+        await tr.spawn(value_err, retry=RetryPolicy(
+            max_attempts=5, backoff_base_s=0.01, retry_on=(ConnectionError,)))
+    await tr.join()
+    assert tr.counts.failed == 2
+
+
+async def test_critical_task_invokes_fatal_hook():
+    tr = TaskTracker()
+    fatal: list[BaseException] = []
+
+    async def doomed():
+        raise RuntimeError("engine dead")
+
+    t = tr.spawn_critical(doomed, on_fatal=fatal.append)
+    with pytest.raises(RuntimeError):
+        await t
+    assert len(fatal) == 1 and "engine dead" in str(fatal[0])
+
+    # a cancelled critical task is NOT fatal
+    async def forever():
+        await asyncio.sleep(60)
+
+    t2 = tr.spawn_critical(forever, on_fatal=fatal.append)
+    await asyncio.sleep(0.01)
+    t2.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await t2
+    assert len(fatal) == 1
+
+
+async def test_child_tracker_cancelled_with_parent():
+    parent = TaskTracker("p")
+    child = parent.child("c")
+    started = asyncio.Event()
+    cancelled = asyncio.Event()
+
+    async def forever():
+        started.set()
+        try:
+            await asyncio.sleep(60)
+        except asyncio.CancelledError:
+            cancelled.set()
+            raise
+
+    child.spawn(forever)
+    await started.wait()
+    await parent.close()
+    assert cancelled.is_set()
+    assert child.counts.cancelled == 1
+    with pytest.raises(RuntimeError):
+        child.spawn(forever)  # closed subtree refuses new work
+    snap = parent.snapshot()
+    assert snap["children"][0]["name"] == "p/c"
+
+
+async def test_compute_pool_runs_off_loop():
+    pool = ComputePool(max_workers=2)
+    loop_thread = threading.get_ident()
+
+    def blocking(x):
+        assert threading.get_ident() != loop_thread
+        time.sleep(0.01)
+        return x + 1
+
+    try:
+        results = await asyncio.gather(*(pool.run(blocking, i) for i in range(8)))
+        assert results == [i + 1 for i in range(8)]
+    finally:
+        pool.shutdown()
