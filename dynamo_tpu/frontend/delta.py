@@ -117,15 +117,29 @@ class ChatDeltaGenerator:
             choices=[ChatChunkChoice(delta=ChatChoiceDelta(reasoning_content=reasoning))],
         )
 
-    def tool_calls_chunk(self, calls: list) -> ChatCompletionChunk:
+    def tool_calls_chunk(self, calls: list,
+                         out: BackendOutput | None = None) -> ChatCompletionChunk:
         """Terminal chunk carrying the parsed calls (the jail withheld their
-        text) with finish_reason=tool_calls."""
+        text) with finish_reason=tool_calls. ``out`` (the final backend
+        delta, when its tokens weren't emitted by a preceding text chunk)
+        plus any held jailed-delta logprobs ride here, keeping streamed
+        logprob entries == completion_tokens even on the tool-call path.
+        Token accounting happens at the call site — this never bumps
+        completion_tokens."""
+        lp = None
+        if self.logprobs:
+            carried = self._pending_lp + (
+                [out] if out is not None and out.token_ids else [])
+            self._pending_lp = []
+            if carried:
+                lp = {"content": chat_logprob_content(carried, self.tokenizer)}
         return ChatCompletionChunk(
             id=self.id, model=self.model,
             choices=[ChatChunkChoice(
                 delta=ChatChoiceDelta(
                     tool_calls=[c.to_openai(index=i) for i, c in enumerate(calls)]),
                 finish_reason="tool_calls",
+                logprobs=lp,
             )],
         )
 

@@ -593,13 +593,17 @@ class HttpService:
                                 if tail:
                                     audit_text.append(tail)
                                     await resp.write(encode_sse_json(gen.chunk(
-                                        BackendOutput(text=tail, token_ids=out.token_ids))))
+                                        BackendOutput(text=tail, token_ids=out.token_ids,
+                                                      log_probs=out.log_probs))))
+                                    final_out = None  # tokens emitted above
                                 else:
                                     gen.completion_tokens += len(out.token_ids)
+                                    final_out = out
                                 audit_tool_calls.extend(
                                     c.to_openai(index=i)
                                     for i, c in enumerate(fin.tool_calls))
-                                await resp.write(encode_sse_json(gen.tool_calls_chunk(fin.tool_calls)))
+                                await resp.write(encode_sse_json(
+                                    gen.tool_calls_chunk(fin.tool_calls, final_out)))
                                 if backend.hit_stop:
                                     break
                                 continue

@@ -1,0 +1,241 @@
+"""Native (C++) runtime components, loaded over ctypes.
+
+The reference's runtime core is native (Rust, ~150k LoC); this package
+holds the C++ members of ours, compiled with the baked-in toolchain at
+first import and cached next to the sources (no pybind11 in the image —
+the ABI is plain C consumed through ctypes, per-call overhead amortized
+by batched array arguments).
+
+Currently: the KV-block radix indexer (native/indexer.cc — reference
+lib/llm/src/kv_router/indexer.rs). ``load_library()`` builds lazily and
+returns None when no compiler is available or the build fails, so every
+consumer keeps a pure-Python fallback; set ``DYN_NATIVE=0`` to force the
+fallback (parity tests exercise both).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("native")
+
+_DIR = Path(__file__).parent
+_SO = _DIR / "_build" / "libdynidx.so"
+_SRC = _DIR / "indexer.cc"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    """Compile to a temp file and rename into place: atomic for concurrent
+    cold starts (an flock serializes the g++ runs; os.replace means a
+    process that already mmapped the old .so keeps its inode — never a
+    truncated library under a live reader)."""
+    import fcntl
+    import tempfile
+
+    _SO.parent.mkdir(exist_ok=True)
+    lock_path = _SO.parent / ".build.lock"
+    with open(lock_path, "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+            return True  # another process built it while we waited
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SO.parent)
+        os.close(fd)
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               str(_SRC), "-o", tmp]
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired) as exc:
+            os.unlink(tmp)
+            log.warning("native build unavailable (%s); using Python fallback", exc)
+            return False
+        if out.returncode != 0:
+            os.unlink(tmp)
+            log.warning("native build failed; using Python fallback:\n%s",
+                        out.stderr[-1000:])
+            return False
+        os.replace(tmp, _SO)
+        return True
+
+
+def _build_needed() -> bool:
+    return not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime
+
+
+def load_library() -> ctypes.CDLL | None:
+    """The native library, building it on first use; None → use Python.
+
+    Never compiles on an asyncio event-loop thread: a cold start inside a
+    running loop (KvRouter construction in the frontend) falls back to
+    Python immediately and kicks the build to a daemon thread, so lease
+    keepalives on the loop can't miss their deadline behind g++."""
+    global _lib, _tried
+    if os.environ.get("DYN_NATIVE", "1") == "0":
+        return None
+    if _build_needed():
+        import asyncio
+
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            pass  # not on a loop: building synchronously is fine
+        else:
+            with _lock:
+                if not _tried:
+                    _tried = True  # this process: Python fallback for good
+                    threading.Thread(
+                        target=_build, name="dyn-native-build",
+                        daemon=True).start()
+                    log.info("native build deferred to background "
+                             "(event loop active); Python fallback this run")
+            return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if _build_needed():
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(str(_SO))
+        except OSError as exc:
+            log.warning("native library load failed (%s); Python fallback", exc)
+            return None
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.dyn_indexer_new.restype = ctypes.c_void_p
+        lib.dyn_indexer_free.argtypes = [ctypes.c_void_p]
+        lib.dyn_indexer_version.argtypes = [ctypes.c_void_p]
+        lib.dyn_indexer_version.restype = ctypes.c_uint64
+        lib.dyn_indexer_events_applied.argtypes = [ctypes.c_void_p]
+        lib.dyn_indexer_events_applied.restype = ctypes.c_uint64
+        lib.dyn_indexer_store.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, u64p, ctypes.c_size_t,
+            ctypes.c_uint64, ctypes.c_int]
+        lib.dyn_indexer_remove.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, u64p, ctypes.c_size_t]
+        lib.dyn_indexer_remove_worker.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.dyn_indexer_find_matches.argtypes = [
+            ctypes.c_void_p, u64p, ctypes.c_size_t, u64p, u32p, ctypes.c_size_t]
+        lib.dyn_indexer_find_matches.restype = ctypes.c_size_t
+        lib.dyn_indexer_block_count.argtypes = [ctypes.c_void_p]
+        lib.dyn_indexer_block_count.restype = ctypes.c_size_t
+        lib.dyn_indexer_worker_block_count.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64]
+        lib.dyn_indexer_worker_block_count.restype = ctypes.c_size_t
+        lib.dyn_indexer_dump_count.argtypes = [ctypes.c_void_p]
+        lib.dyn_indexer_dump_count.restype = ctypes.c_size_t
+        lib.dyn_indexer_dump.argtypes = [
+            ctypes.c_void_p, u64p, u64p, u64p, u8p, ctypes.c_size_t]
+        lib.dyn_indexer_dump.restype = ctypes.c_size_t
+        _lib = lib
+        log.info("native indexer loaded (%s)", _SO.name)
+        return _lib
+
+
+def _arr(values) -> "ctypes.Array":
+    return (ctypes.c_uint64 * len(values))(*values)
+
+
+class NativeRadixIndexer:
+    """Drop-in for router.indexer.RadixIndexer backed by the C++ library.
+    Raises RuntimeError if the library is unavailable — callers select via
+    :func:`make_indexer`."""
+
+    def __init__(self) -> None:
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native indexer unavailable")
+        self._lib = lib
+        self._ptr = lib.dyn_indexer_new()
+
+    def __del__(self) -> None:
+        ptr, self._ptr = getattr(self, "_ptr", None), None
+        if ptr and getattr(self, "_lib", None) is not None:
+            self._lib.dyn_indexer_free(ptr)
+
+    # -- properties mirroring the Python structure -------------------------
+    @property
+    def version(self) -> int:
+        return self._lib.dyn_indexer_version(self._ptr)
+
+    @property
+    def events_applied(self) -> int:
+        return self._lib.dyn_indexer_events_applied(self._ptr)
+
+    # ------------------------------------------------------------------
+    def apply_event(self, ev) -> None:
+        from dynamo_tpu.router.events import BlockRemoved, BlockStored
+
+        if isinstance(ev.event, BlockStored):
+            parent = ev.event.parent_hash
+            hashes = list(ev.event.block_hashes)
+            self._lib.dyn_indexer_store(
+                self._ptr, ev.worker_id, _arr(hashes), len(hashes),
+                parent or 0, 0 if parent is None else 1)
+        elif isinstance(ev.event, BlockRemoved):
+            hashes = list(ev.event.block_hashes)
+            self._lib.dyn_indexer_remove(
+                self._ptr, ev.worker_id, _arr(hashes), len(hashes))
+
+    def remove_worker(self, worker_id: int) -> None:
+        self._lib.dyn_indexer_remove_worker(self._ptr, worker_id)
+
+    def find_matches(self, seq_hashes: list[int]):
+        from dynamo_tpu.router.indexer import OverlapScores
+
+        out = OverlapScores(total_blocks=len(seq_hashes))
+        if not seq_hashes:
+            return out
+        cap = 4096  # routing fleets are tens of workers; 4096 is a hard roof
+        workers = (ctypes.c_uint64 * cap)()
+        scores = (ctypes.c_uint32 * cap)()
+        n = self._lib.dyn_indexer_find_matches(
+            self._ptr, _arr(seq_hashes), len(seq_hashes), workers, scores, cap)
+        for i in range(n):
+            out.scores[workers[i]] = scores[i]
+        return out
+
+    def dump_events(self) -> list:
+        from dynamo_tpu.router.events import BlockStored, RouterEvent
+
+        cap = self._lib.dyn_indexer_dump_count(self._ptr)
+        if cap == 0:
+            return []
+        workers = (ctypes.c_uint64 * cap)()
+        hashes = (ctypes.c_uint64 * cap)()
+        parents = (ctypes.c_uint64 * cap)()
+        has_parent = (ctypes.c_uint8 * cap)()
+        n = self._lib.dyn_indexer_dump(
+            self._ptr, workers, hashes, parents, has_parent, cap)
+        return [RouterEvent(
+            worker_id=workers[i],
+            event=BlockStored(
+                block_hashes=(hashes[i],),
+                parent_hash=parents[i] if has_parent[i] else None))
+            for i in range(n)]
+
+    def block_count(self) -> int:
+        return self._lib.dyn_indexer_block_count(self._ptr)
+
+    def worker_block_count(self, worker_id: int) -> int:
+        return self._lib.dyn_indexer_worker_block_count(self._ptr, worker_id)
+
+
+def make_indexer():
+    """Native indexer when buildable, else the Python RadixIndexer."""
+    if load_library() is not None:
+        return NativeRadixIndexer()
+    from dynamo_tpu.router.indexer import RadixIndexer
+
+    return RadixIndexer()

@@ -1,0 +1,198 @@
+// Native KV-block index: the router's hot routing data structure.
+//
+// Fills the role of the reference's Rust RadixTree indexer
+// (reference: lib/llm/src/kv_router/indexer.rs:336 RadixTree,
+// :463 find_matches, :472 apply_event, :628 worker removal) as the
+// C++ member of this framework's native runtime layer. Semantics are
+// exactly those of the Python RadixIndexer (dynamo_tpu/router/indexer.py)
+// — chained sequence hashes flatten the radix tree into a hash → node
+// map, so matching is a straight walk down the request's own hash chain.
+//
+// Exposed as a plain C ABI consumed through ctypes
+// (dynamo_tpu/native/__init__.py); all arrays are caller-allocated, all
+// ids/hashes are u64. Not thread-safe by design: the router applies
+// events and matches from one event loop, same as the Python structure.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 indexer.cc -o libdynidx.so
+
+#include <cstdint>
+#include <cstddef>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+struct Node {
+    // Workers holding this block. Routing fleets are small (tens), and
+    // find_matches intersects repeatedly — a sorted vector beats a hash
+    // set on both memory and walk speed at this cardinality.
+    std::vector<uint64_t> workers;
+    uint64_t parent = 0;
+    bool has_parent = false;
+
+    bool holds(uint64_t w) const {
+        return std::binary_search(workers.begin(), workers.end(), w);
+    }
+    void add(uint64_t w) {
+        auto it = std::lower_bound(workers.begin(), workers.end(), w);
+        if (it == workers.end() || *it != w) workers.insert(it, w);
+    }
+    void remove(uint64_t w) {
+        auto it = std::lower_bound(workers.begin(), workers.end(), w);
+        if (it != workers.end() && *it == w) workers.erase(it);
+    }
+};
+
+struct Indexer {
+    std::unordered_map<uint64_t, Node> nodes;
+    std::unordered_map<uint64_t, std::unordered_set<uint64_t>> worker_hashes;
+    uint64_t version = 0;
+    uint64_t events_applied = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dyn_indexer_new() { return new Indexer(); }
+
+void dyn_indexer_free(void* p) { delete static_cast<Indexer*>(p); }
+
+uint64_t dyn_indexer_version(void* p) {
+    return static_cast<Indexer*>(p)->version;
+}
+
+uint64_t dyn_indexer_events_applied(void* p) {
+    return static_cast<Indexer*>(p)->events_applied;
+}
+
+// BlockStored: hashes chain off parent (has_parent=0 → chain root).
+void dyn_indexer_store(void* p, uint64_t worker, const uint64_t* hashes,
+                       size_t n, uint64_t parent, int has_parent) {
+    auto* idx = static_cast<Indexer*>(p);
+    idx->events_applied++;
+    idx->version++;
+    for (size_t i = 0; i < n; i++) {
+        uint64_t h = hashes[i];
+        auto [it, created] = idx->nodes.try_emplace(h);
+        if (created) {
+            it->second.parent = parent;
+            it->second.has_parent = has_parent != 0;
+        }
+        it->second.add(worker);
+        idx->worker_hashes[worker].insert(h);
+        parent = h;
+        has_parent = 1;
+    }
+}
+
+void dyn_indexer_remove(void* p, uint64_t worker, const uint64_t* hashes,
+                        size_t n) {
+    auto* idx = static_cast<Indexer*>(p);
+    idx->events_applied++;
+    idx->version++;
+    auto wh = idx->worker_hashes.find(worker);
+    for (size_t i = 0; i < n; i++) {
+        auto it = idx->nodes.find(hashes[i]);
+        if (it == idx->nodes.end()) continue;
+        it->second.remove(worker);
+        if (wh != idx->worker_hashes.end()) wh->second.erase(hashes[i]);
+        if (it->second.workers.empty()) idx->nodes.erase(it);
+    }
+}
+
+void dyn_indexer_remove_worker(void* p, uint64_t worker) {
+    auto* idx = static_cast<Indexer*>(p);
+    idx->version++;
+    auto wh = idx->worker_hashes.find(worker);
+    if (wh == idx->worker_hashes.end()) return;
+    for (uint64_t h : wh->second) {
+        auto it = idx->nodes.find(h);
+        if (it == idx->nodes.end()) continue;
+        it->second.remove(worker);
+        if (it->second.workers.empty()) idx->nodes.erase(it);
+    }
+    idx->worker_hashes.erase(wh);
+}
+
+// Walk the request's hash chain; out_workers/out_scores receive one entry
+// per worker that held any prefix (score = contiguous depth). Returns the
+// number of entries written (bounded by max_out).
+size_t dyn_indexer_find_matches(void* p, const uint64_t* hashes, size_t n,
+                                uint64_t* out_workers, uint32_t* out_scores,
+                                size_t max_out) {
+    auto* idx = static_cast<Indexer*>(p);
+    // `active` = workers still contiguous at the current depth; workers
+    // that drop out keep the depth they reached (already recorded).
+    std::vector<uint64_t> active;
+    std::unordered_map<uint64_t, uint32_t> scores;
+    bool first = true;
+    for (size_t depth = 1; depth <= n; depth++) {
+        auto it = idx->nodes.find(hashes[depth - 1]);
+        if (it == idx->nodes.end() || it->second.workers.empty()) break;
+        if (first) {
+            active = it->second.workers;
+            first = false;
+        } else {
+            std::vector<uint64_t> next;
+            next.reserve(active.size());
+            for (uint64_t w : active)
+                if (it->second.holds(w)) next.push_back(w);
+            if (next.empty()) break;
+            active.swap(next);
+        }
+        for (uint64_t w : active) scores[w] = static_cast<uint32_t>(depth);
+    }
+    size_t i = 0;
+    for (const auto& [w, s] : scores) {
+        if (i >= max_out) break;
+        out_workers[i] = w;
+        out_scores[i] = s;
+        i++;
+    }
+    return i;
+}
+
+size_t dyn_indexer_block_count(void* p) {
+    return static_cast<Indexer*>(p)->nodes.size();
+}
+
+size_t dyn_indexer_worker_block_count(void* p, uint64_t worker) {
+    auto* idx = static_cast<Indexer*>(p);
+    auto it = idx->worker_hashes.find(worker);
+    return it == idx->worker_hashes.end() ? 0 : it->second.size();
+}
+
+size_t dyn_indexer_dump_count(void* p) {
+    auto* idx = static_cast<Indexer*>(p);
+    size_t n = 0;
+    for (const auto& [w, hs] : idx->worker_hashes) n += hs.size();
+    return n;
+}
+
+// One (worker, hash, parent, has_parent) tuple per worker-resident block —
+// replayable as single-block stored events (warm-start snapshots,
+// reference: indexer.rs:656 dump_tree_as_events).
+size_t dyn_indexer_dump(void* p, uint64_t* workers, uint64_t* hashes,
+                        uint64_t* parents, uint8_t* has_parent,
+                        size_t max_out) {
+    auto* idx = static_cast<Indexer*>(p);
+    size_t i = 0;
+    for (const auto& [w, hs] : idx->worker_hashes) {
+        for (uint64_t h : hs) {
+            if (i >= max_out) return i;
+            auto it = idx->nodes.find(h);
+            workers[i] = w;
+            hashes[i] = h;
+            parents[i] = it != idx->nodes.end() ? it->second.parent : 0;
+            has_parent[i] =
+                it != idx->nodes.end() && it->second.has_parent ? 1 : 0;
+            i++;
+        }
+    }
+    return i;
+}
+
+}  // extern "C"

@@ -18,7 +18,7 @@ import msgpack
 
 from dynamo_tpu.protocols.common import PreprocessedRequest
 from dynamo_tpu.router.events import RouterEvent
-from dynamo_tpu.router.indexer import ApproxKvIndexer, RadixIndexer, WorkerId
+from dynamo_tpu.router.indexer import ApproxKvIndexer, WorkerId
 from dynamo_tpu.router.publisher import kv_events_subject, load_metrics_subject
 from dynamo_tpu.router.scheduler import DefaultWorkerSelector, KvScheduler, WorkerLoad
 from dynamo_tpu.router.sequence import ActiveSequences
@@ -56,7 +56,11 @@ class KvRouter:
 
     def __init__(self, config: KvRouterConfig | None = None):
         self.config = config or KvRouterConfig()
-        self.indexer = RadixIndexer()
+        # C++ indexer when buildable (native/indexer.cc), Python otherwise —
+        # identical semantics, parity-tested (tests/test_native_indexer.py).
+        from dynamo_tpu.native import make_indexer
+
+        self.indexer = make_indexer()
         self.approx = ApproxKvIndexer(self.config.approx_ttl_s)
         self.scheduler = KvScheduler(DefaultWorkerSelector(
             overlap_weight=self.config.overlap_weight,

@@ -177,9 +177,17 @@ class DistributedRuntime:
                     await conn.send({"t": Frame.PONG})
                 elif t == Frame.CALL:
                     sid = msg["stream_id"]
-                    task = self._streams.spawn(
-                        self._run_stream, conn, sid, msg,
-                        name=f"stream-{msg.get('endpoint', '?')}-{sid}")
+                    try:
+                        task = self._streams.spawn(
+                            self._run_stream, conn, sid, msg,
+                            name=f"stream-{msg.get('endpoint', '?')}-{sid}")
+                    except RuntimeError:
+                        # Tracker already closed (shutdown race): refuse THIS
+                        # stream, keep the multiplexed connection alive for
+                        # its in-flight siblings.
+                        await conn.send({"t": Frame.ERR, "stream_id": sid,
+                                         "error": "shutting down"})
+                        continue
                     streams[sid] = task
                     task.add_done_callback(
                         lambda t_, sid=sid: streams.pop(sid, None))
