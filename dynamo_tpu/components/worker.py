@@ -71,6 +71,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--min-prefill-blocks", type=int, default=2,
                    help="decode mode: prompt blocks below which prefill stays local")
     # Multi-host engine (reference: lib/llm/src/engines.rs:29-44 MultiNodeConfig).
+    p.add_argument("--multihost-group", default=None,
+                   help="rendezvous group for multi-host ranks (default: "
+                        "namespace.component; MUST differ across replicas "
+                        "of one component)")
     p.add_argument("--num-nodes", type=int, default=1,
                    help="processes forming ONE SPMD engine (1 = single-host)")
     p.add_argument("--node-rank", type=int, default=0)
@@ -119,7 +123,10 @@ async def amain(ns: argparse.Namespace) -> None:
             raise SystemExit("multi-host engines do not yet support disagg")
         from dynamo_tpu.parallel import multihost as mh
 
-        group = f"{ns.namespace}.{ns.component}"
+        # Distinct multi-host replicas of one component must rendezvous in
+        # distinct groups (leader-key collision otherwise) — recipes pass
+        # --multihost-group per replica.
+        group = ns.multihost_group or f"{ns.namespace}.{ns.component}"
         leader_addr = ns.leader_addr
         op_port = 0
         loop = asyncio.get_running_loop()

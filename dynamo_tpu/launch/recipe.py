@@ -1,0 +1,256 @@
+"""Recipe launcher: declarative serving topologies → running processes.
+
+Fills the role of the reference's deployment recipes + K8s operator
+surface (reference: recipes/*/deploy.yaml `DynamoGraphDeployment` CRDs,
+deploy/cloud/operator) in a TPU-native shape: a `TpuServeDeployment`
+YAML names the model, the frontend(s), and worker pools with their mesh
+geometry (tp/pp/dp/ep/sp, multi-host node counts) — everything the
+operator would template into pods maps 1:1 onto this framework's
+component CLIs (`dynamo_tpu.components.*`).
+
+Two consumers:
+
+- ``plan``: print the exact process commands a deployment implies (what
+  a K8s operator would put in pod specs — also the contract tests pin).
+- ``up``: run the whole topology locally (one host): coordinator →
+  kv-store → workers → frontends, readiness-gated, torn down on SIGINT.
+  `--engine mocker` overrides every worker's engine for chip-free runs.
+
+    python -m dynamo_tpu.launch.recipe plan recipes/llama-3-70b/disagg-v5e-64.yaml
+    python -m dynamo_tpu.launch.recipe up recipes/llama-3-8b/agg.yaml --engine mocker
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+log = get_logger("recipe")
+
+KIND = "TpuServeDeployment"
+
+
+@dataclass
+class Process:
+    """One planned process: a component module + argv."""
+
+    name: str
+    module: str
+    args: list[str]
+    replicas: int = 1
+    ready_line: str | None = None
+
+    def argv(self) -> list[str]:
+        return [sys.executable, "-m", self.module, *self.args]
+
+
+@dataclass
+class Plan:
+    name: str
+    coordinator_url: str
+    processes: list[Process] = field(default_factory=list)
+
+
+def _engine_args(engine: dict[str, Any]) -> list[str]:
+    flags = {
+        "blockSize": "--block-size", "numBlocks": "--num-blocks",
+        "maxBatchSize": "--max-batch-size", "maxModelLen": "--max-model-len",
+        "decodeWindow": "--decode-window", "hostKvBlocks": "--host-kv-blocks",
+        "diskKvPath": "--disk-kv-path", "remoteKvAddr": "--remote-kv-addr",
+    }
+    out: list[str] = []
+    for key, flag in flags.items():
+        if key in engine:
+            out += [flag, str(engine[key])]
+    return out
+
+
+def _mesh_args(mesh: dict[str, Any]) -> list[str]:
+    out: list[str] = []
+    for axis in ("tp", "pp", "dp", "ep", "sp"):
+        if axis in mesh:
+            out += [f"--{axis}", str(mesh[axis])]
+    return out
+
+
+def load_spec(path: str | Path) -> dict:
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    if not isinstance(doc, dict) or doc.get("kind") != KIND:
+        raise ValueError(f"{path}: expected kind {KIND}")
+    if "spec" not in doc or "metadata" not in doc:
+        raise ValueError(f"{path}: missing spec/metadata")
+    return doc
+
+
+def build_plan(doc: dict, engine_override: str | None = None,
+               coordinator_port: int = 4222) -> Plan:
+    """Pure mapping: deployment spec → process list (the operator's job)."""
+    spec = doc["spec"]
+    name = doc["metadata"]["name"]
+    coord = spec.get("coordinator", {})
+    url = coord.get("external") or f"tcp://127.0.0.1:{coord.get('port', coordinator_port)}"
+    plan = Plan(name=name, coordinator_url=url)
+
+    if not coord.get("external"):
+        plan.processes.append(Process(
+            name="coordinator", module="dynamo_tpu.transports.coordinator",
+            args=["--host", "0.0.0.0", "--port", str(coord.get("port", coordinator_port))],
+            ready_line="COORDINATOR_READY"))
+
+    if "kvStore" in spec:
+        ks = spec["kvStore"]
+        plan.processes.append(Process(
+            name="kv-store", module="dynamo_tpu.components.kv_store",
+            args=["--coordinator", url,
+                  "--capacity-gib", str(ks.get("capacityGib", 4)),
+                  "--port", str(ks.get("port", 0))],
+            ready_line="KV_STORE_READY"))
+
+    model = spec["model"]
+    for w in spec.get("workers", []):
+        args = ["--coordinator", url, "--model", model,
+                "--engine", engine_override or w.get("engine_kind", "jax")]
+        if w.get("servedModelName") or spec.get("servedModelName"):
+            args += ["--served-model-name",
+                     w.get("servedModelName") or spec["servedModelName"]]
+        role = w.get("role", "none")
+        if role in ("prefill", "decode"):
+            args += ["--disagg", role]
+            if role == "prefill":
+                args += ["--component", "prefill"]
+        args += _mesh_args(w.get("mesh", {}))
+        args += _engine_args(w.get("engine", {}))
+        nodes = int(w.get("nodes", 1))
+        if nodes > 1:
+            # Multi-host: one process per (replica, rank); rank 0 leads
+            # (parallel/multihost.py resolves the leader through the
+            # coordination service). Each replica rendezvouses in its own
+            # group — two replicas of one component must not share a
+            # leader key.
+            for rep in range(int(w.get("replicas", 1))):
+                group = f"{name}.{w['name']}.r{rep}"
+                for rank in range(nodes):
+                    plan.processes.append(Process(
+                        name=f"{w['name']}-r{rep}-rank{rank}",
+                        module="dynamo_tpu.components.worker",
+                        args=args + ["--num-nodes", str(nodes),
+                                     "--node-rank", str(rank),
+                                     "--multihost-group", group],
+                        replicas=1,
+                        ready_line="WORKER_READY" if rank == 0 else None))
+        else:
+            plan.processes.append(Process(
+                name=w["name"], module="dynamo_tpu.components.worker",
+                args=args, replicas=int(w.get("replicas", 1)),
+                ready_line="WORKER_READY"))
+
+    fe = spec.get("frontend", {})
+    fe_args = ["--coordinator", url,
+               "--port", str(fe.get("port", 8080)),
+               "--router-mode", fe.get("routerMode", "kv")]
+    if "grpcPort" in fe:
+        fe_args += ["--grpc-port", str(fe["grpcPort"])]
+    if "migrationLimit" in fe:
+        fe_args += ["--migration-limit", str(fe["migrationLimit"])]
+    plan.processes.append(Process(
+        name="frontend", module="dynamo_tpu.components.frontend",
+        args=fe_args, replicas=int(fe.get("replicas", 1)),
+        ready_line="FRONTEND_READY"))
+
+    if spec.get("planner", {}).get("enabled"):
+        pl = spec["planner"]
+        pl_args = ["--coordinator", url]
+        for key, flag in (("ttftSla", "--ttft-sla"), ("itlSla", "--itl-sla"),
+                          ("minReplicas", "--min-replicas"),
+                          ("maxReplicas", "--max-replicas"),
+                          ("chipBudget", "--chip-budget"),
+                          ("adjustmentInterval", "--adjustment-interval"),
+                          ("mode", "--mode")):
+            if key in pl:
+                pl_args += [flag, str(pl[key])]
+        plan.processes.append(Process(
+            name="planner", module="dynamo_tpu.components.planner",
+            args=pl_args))
+    return plan
+
+
+def format_plan(plan: Plan) -> str:
+    lines = [f"deployment {plan.name} (coordinator {plan.coordinator_url}):"]
+    for p in plan.processes:
+        rep = f" x{p.replicas}" if p.replicas > 1 else ""
+        lines.append(f"  [{p.name}{rep}] " + " ".join(p.argv()))
+    return "\n".join(lines)
+
+
+def run_local(plan: Plan, timeout: float = 600.0) -> None:
+    """Launch every process on this host, readiness-gated in order."""
+    procs: list[tuple[Process, subprocess.Popen]] = []
+
+    def stop_all() -> None:
+        for _, sp in reversed(procs):
+            if sp.poll() is None:
+                sp.terminate()
+        for _, sp in reversed(procs):
+            try:
+                sp.wait(10)
+            except subprocess.TimeoutExpired:
+                sp.kill()
+
+    try:
+        for p in plan.processes:
+            for r in range(p.replicas):
+                sp = subprocess.Popen(
+                    p.argv(), stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT, text=True)
+                procs.append((p, sp))
+                log.info("started %s[%d] pid=%d", p.name, r, sp.pid)
+                if p.ready_line:
+                    deadline = time.monotonic() + timeout
+                    for line in sp.stdout:  # type: ignore[union-attr]
+                        sys.stdout.write(f"{p.name}: {line}")
+                        if p.ready_line in line:
+                            break
+                        if time.monotonic() > deadline:
+                            raise TimeoutError(
+                                f"{p.name} not ready within {timeout}s")
+                    else:
+                        raise RuntimeError(f"{p.name} exited before ready")
+        print(f"RECIPE_UP {plan.name} processes={len(procs)}", flush=True)
+        # Block BEFORE waiting: bare sigwait races the default SIGTERM
+        # action (process death without the finally → leaked children).
+        signal.pthread_sigmask(signal.SIG_BLOCK,
+                               {signal.SIGINT, signal.SIGTERM})
+        signal.sigwait({signal.SIGINT, signal.SIGTERM})
+    finally:
+        stop_all()
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser("dynamo-recipe", description=__doc__)
+    ap.add_argument("cmd", choices=["plan", "up"])
+    ap.add_argument("recipe")
+    ap.add_argument("--engine", default=None,
+                    help="override every worker's engine (e.g. mocker)")
+    ap.add_argument("--start-timeout", type=float, default=600.0)
+    ns = ap.parse_args(argv)
+    configure_logging()
+    plan = build_plan(load_spec(ns.recipe), engine_override=ns.engine)
+    if ns.cmd == "plan":
+        print(format_plan(plan))
+        return
+    run_local(plan, timeout=ns.start_timeout)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,8 @@ import subprocess
 import threading
 from pathlib import Path
 
+from dynamo_tpu.router.events import BlockRemoved, BlockStored
+from dynamo_tpu.router.indexer import OverlapScores
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("native")
@@ -42,29 +44,38 @@ def _build() -> bool:
     import fcntl
     import tempfile
 
-    _SO.parent.mkdir(exist_ok=True)
-    lock_path = _SO.parent / ".build.lock"
-    with open(lock_path, "w") as lockf:
-        fcntl.flock(lockf, fcntl.LOCK_EX)
-        if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
-            return True  # another process built it while we waited
-        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SO.parent)
-        os.close(fd)
-        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-               str(_SRC), "-o", tmp]
-        try:
-            out = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
-        except (OSError, subprocess.TimeoutExpired) as exc:
-            os.unlink(tmp)
-            log.warning("native build unavailable (%s); using Python fallback", exc)
-            return False
-        if out.returncode != 0:
-            os.unlink(tmp)
-            log.warning("native build failed; using Python fallback:\n%s",
-                        out.stderr[-1000:])
-            return False
-        os.replace(tmp, _SO)
-        return True
+    try:
+        _SO.parent.mkdir(exist_ok=True)
+        lock_path = _SO.parent / ".build.lock"
+        with open(lock_path, "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+                return True  # another process built it while we waited
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SO.parent)
+            os.close(fd)
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                   str(_SRC), "-o", tmp]
+            try:
+                out = subprocess.run(cmd, capture_output=True, text=True,
+                                     timeout=120)
+            except (OSError, subprocess.TimeoutExpired) as exc:
+                os.unlink(tmp)
+                log.warning("native build unavailable (%s); using Python "
+                            "fallback", exc)
+                return False
+            if out.returncode != 0:
+                os.unlink(tmp)
+                log.warning("native build failed; using Python fallback:\n%s",
+                            out.stderr[-1000:])
+                return False
+            os.replace(tmp, _SO)
+            return True
+    except OSError as exc:
+        # Read-only install dir (container image, Nix) or similar: the
+        # always-fall-back contract must hold for filesystem errors too.
+        log.warning("native build dir unwritable (%s); using Python fallback",
+                    exc)
+        return False
 
 
 def _build_needed() -> bool:
@@ -175,8 +186,6 @@ class NativeRadixIndexer:
 
     # ------------------------------------------------------------------
     def apply_event(self, ev) -> None:
-        from dynamo_tpu.router.events import BlockRemoved, BlockStored
-
         if isinstance(ev.event, BlockStored):
             parent = ev.event.parent_hash
             hashes = list(ev.event.block_hashes)
@@ -192,8 +201,6 @@ class NativeRadixIndexer:
         self._lib.dyn_indexer_remove_worker(self._ptr, worker_id)
 
     def find_matches(self, seq_hashes: list[int]):
-        from dynamo_tpu.router.indexer import OverlapScores
-
         out = OverlapScores(total_blocks=len(seq_hashes))
         if not seq_hashes:
             return out

@@ -1,0 +1,137 @@
+"""SLA profiling THROUGH the serving stack (frontend + runtime included).
+
+Fills the role of the reference's pre-deployment profiler driving a live
+deployment (reference: benchmarks/profiler/profile_sla.py:71-393 — sweeps
+run against the HTTP endpoint of a launched topology, not an in-process
+engine). The in-process :class:`planner.profiler.SlaProfiler` isolates
+engine capability; THIS profiler measures what a client actually sees —
+preprocessing, routing, wire framing, SSE — so planner interpolations
+built from it include every overhead between user and chip.
+
+One topology is launched (benchmarks/serve_bench.launch_topology: agg |
+distributed | disagg), then the operating-point grid sweeps over it with
+the HTTP load generator:
+
+- prefill points: concurrency 1, ``osl=1`` → TTFT(isl)
+- decode points: concurrency × context grid → ITL and tok/s/chip
+
+Output: the SAME npz schema as the in-process profiler
+(prefill_isl/prefill_ttft_s/... — planner/interpolator.py consumes both
+interchangeably), plus ``source='serve'`` metadata.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from pathlib import Path
+
+import numpy as np
+
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+log = get_logger("serve_profiler")
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+
+def _bench_modules():
+    """benchmarks/ lives at the repo root, not inside the package."""
+    if str(REPO_ROOT) not in sys.path:
+        sys.path.insert(0, str(REPO_ROOT))
+    from benchmarks.loadgen import run_load
+    from benchmarks.serve_bench import launch_topology, wait_http
+
+    return run_load, launch_topology, wait_http
+
+
+def profile_serving(ns: argparse.Namespace) -> dict:
+    """Launch the topology once, sweep the grids over its HTTP endpoint."""
+    run_load, launch_topology, wait_http = _bench_modules()
+    from benchmarks.serve_bench import base_env
+
+    env = base_env(ns.platform)
+    procs, base_url, chips = launch_topology(ns, env)
+    try:
+        wait_http(base_url + "/v1/models", ns.start_timeout)
+
+        ttft = np.zeros(len(ns.isl_grid))
+        p_thpt = np.zeros_like(ttft)
+        for i, isl in enumerate(ns.isl_grid):
+            load = asyncio.run(run_load(
+                base_url, ns.model, concurrency=1,
+                num_requests=ns.prefill_requests, isl=isl, osl=1,
+                warmup=ns.warmup))
+            if load["failed"]:
+                raise RuntimeError(
+                    f"prefill point isl={isl} had failures: {load['errors']}")
+            ttft[i] = load["ttft_avg_s"]
+            p_thpt[i] = isl / ttft[i] / chips if ttft[i] > 0 else 0.0
+            log.info("serve prefill isl=%d ttft=%.4fs", isl, ttft[i])
+
+        itl = np.zeros((len(ns.conc_grid), len(ns.ctx_grid)))
+        d_thpt = np.zeros_like(itl)
+        for i, conc in enumerate(ns.conc_grid):
+            for j, ctx in enumerate(ns.ctx_grid):
+                load = asyncio.run(run_load(
+                    base_url, ns.model, concurrency=conc,
+                    num_requests=max(ns.decode_requests, 2 * conc),
+                    isl=ctx, osl=ns.decode_steps, warmup=ns.warmup))
+                if load["failed"]:
+                    raise RuntimeError(f"decode point conc={conc} ctx={ctx} "
+                                       f"had failures: {load['errors']}")
+                itl[i, j] = load["itl_p50_s"]
+                d_thpt[i, j] = load["output_tok_s"] / chips
+                log.info("serve decode conc=%d ctx=%d itl=%.4fs thpt/chip=%.1f",
+                         conc, ctx, itl[i, j], d_thpt[i, j])
+    finally:
+        for p in reversed(procs):
+            p.stop()
+
+    return {
+        "prefill_isl": np.asarray(ns.isl_grid, np.float64),
+        "prefill_ttft_s": ttft,
+        "prefill_thpt_per_chip": p_thpt,
+        "decode_concurrency": np.asarray(ns.conc_grid, np.float64),
+        "decode_context": np.asarray(ns.ctx_grid, np.float64),
+        "decode_itl_s": itl,
+        "decode_thpt_per_chip": d_thpt,
+        "source": np.asarray("serve"),
+        "topology": np.asarray(ns.topology),
+        "chips": np.asarray(chips, np.float64),
+    }
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser("serve-profiler", description=__doc__)
+    # topology knobs (shared with serve_bench.launch_topology)
+    p.add_argument("--topology", choices=["agg", "distributed", "disagg"],
+                   default="agg")
+    p.add_argument("--platform", choices=["ambient", "cpu"], default="ambient")
+    p.add_argument("--model", default="llama-3-8b-lite")
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-blocks", type=int, default=0)
+    p.add_argument("--max-batch-size", type=int, default=32)
+    p.add_argument("--max-model-len", type=int, default=4096)
+    p.add_argument("--start-timeout", type=float, default=600.0)
+    # sweep grids (mirror the in-process profiler CLI)
+    p.add_argument("--isl-grid", type=int, nargs="+", default=[128, 512, 2048])
+    p.add_argument("--conc-grid", type=int, nargs="+", default=[1, 8, 32])
+    p.add_argument("--ctx-grid", type=int, nargs="+", default=[256, 1024])
+    p.add_argument("--decode-steps", type=int, default=32)
+    p.add_argument("--prefill-requests", type=int, default=4)
+    p.add_argument("--decode-requests", type=int, default=8)
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--output", default="serve_profile.npz")
+    ns = p.parse_args(argv)
+
+    configure_logging()
+    data = profile_serving(ns)
+    np.savez(ns.output, **data)
+    print(f"serve profile written to {ns.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -345,7 +345,8 @@ async def main() -> None:  # pragma: no cover - CLI entry
     p.add_argument("--port", type=int, default=6650)
     ns = p.parse_args()
     server = CoordinatorServer(ns.host, ns.port)
-    await server.start()
+    port = await server.start()
+    print(f"COORDINATOR_READY port={port}", flush=True)
     await asyncio.Event().wait()
 
 

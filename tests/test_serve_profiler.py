@@ -1,0 +1,50 @@
+"""Serve-level SLA profiler (reference: benchmarks/profiler/
+profile_sla.py:71-393 — profiling through a live deployment): a real agg
+topology is launched, the grid sweeps over its HTTP endpoint, and the
+resulting npz feeds the planner's interpolators unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.planner.interpolator import DecodeInterpolator, PrefillInterpolator
+from dynamo_tpu.planner.serve_profiler import profile_serving
+
+
+@pytest.mark.slow
+def test_serve_profile_agg_feeds_interpolators(tmp_path):
+    ns = argparse.Namespace(
+        topology="agg", platform="cpu", model="tiny-llama", workers=1,
+        # roomy enough for loadgen's ~190-token calibration probe
+        block_size=4, num_blocks=600, max_batch_size=4, max_model_len=512,
+        start_timeout=120.0,
+        isl_grid=[16, 48], conc_grid=[1, 2], ctx_grid=[32],
+        decode_steps=8, prefill_requests=2, decode_requests=2, warmup=1,
+    )
+    data = profile_serving(ns)
+
+    # schema identical to the in-process profiler
+    assert data["prefill_isl"].shape == (2,)
+    assert data["prefill_ttft_s"].shape == (2,)
+    assert data["decode_itl_s"].shape == (2, 1)
+    assert str(data["source"]) == "serve"
+    # serve-level latencies are end-to-end: strictly positive, TTFT grows
+    # (or at least doesn't collapse) with ISL
+    assert (data["prefill_ttft_s"] > 0).all()
+    assert (data["decode_itl_s"] > 0).all()
+    assert (data["decode_thpt_per_chip"] > 0).all()
+
+    # round-trips through npz into the planner's interpolators
+    path = tmp_path / "serve_profile.npz"
+    np.savez(path, **data)
+    with np.load(path) as z:
+        loaded = {k: z[k] for k in z.files}
+    pre = PrefillInterpolator.from_data(loaded)
+    dec = DecodeInterpolator.from_data(loaded)
+    assert pre.interpolate_ttft(32) > 0
+    assert dec.interpolate_itl(1.5, 32) > 0
+    assert dec.interpolate_thpt_per_chip(2, 32) > 0
