@@ -34,7 +34,12 @@ from jax import lax
 
 from dynamo_tpu.engine.cache import KVCacheSpec, allocate_cache
 from dynamo_tpu.engine.prefix_pool import PrefixPool
-from dynamo_tpu.engine.sampling import SamplingState, record_tokens, sample
+from dynamo_tpu.engine.sampling import (
+    SamplingState,
+    greedy_sample as _greedy_sample,
+    record_tokens,
+    sample,
+)
 from dynamo_tpu.engine.scheduler import Phase, PrefillWork, Scheduler, Seq, StepPlan
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.config import ModelConfig, resolve_model_config
@@ -218,7 +223,8 @@ class ModelRunner:
         return int(min(n, cap))
 
     # ------------------------------------------------------------------
-    def _build_step_fn(self, b: int, t: int, nblk: int, sp_prefill: bool = False):
+    def _build_step_fn(self, b: int, t: int, nblk: int, sp_prefill: bool = False,
+                       fast_greedy: bool = False):
         cfg = self.cfg
         trash_row = self.engine_cfg.max_batch_size
 
@@ -238,17 +244,25 @@ class ModelRunner:
                                            attn_impl=attn_impl, moe_impl=moe_impl,
                                            mesh=mesh, sp_prefill=sp_prefill)
             logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
-            st = SamplingState(
-                temperature=temp, top_k=top_k, top_p=top_p,
-                frequency_penalty=fp, presence_penalty=pp, repetition_penalty=rp,
-                keys=keys[slots], token_counts=counts[slots],
-            )
-            toks, lps, new_keys = sample(logits, st)
-            new_counts = record_tokens(st.token_counts, toks, do_sample)
-            # Only sampling rows persist state; others write to the trash row.
             write_slots = jnp.where(do_sample, slots, trash_row)
-            counts = counts.at[write_slots].set(new_counts)
-            keys = keys.at[write_slots].set(new_keys)
+            if fast_greedy:
+                # Whole batch greedy + penalty-free (host-verified at
+                # dispatch): argmax over raw logits is bit-identical to the
+                # general path and skips its PRNG, penalty-count gathers,
+                # and sorted top-k/p masking — the per-step vocab-sized
+                # traffic that isn't the model itself.
+                toks, lps = _greedy_sample(logits)
+            else:
+                st = SamplingState(
+                    temperature=temp, top_k=top_k, top_p=top_p,
+                    frequency_penalty=fp, presence_penalty=pp, repetition_penalty=rp,
+                    keys=keys[slots], token_counts=counts[slots],
+                )
+                toks, lps, new_keys = sample(logits, st)
+                new_counts = record_tokens(st.token_counts, toks, do_sample)
+                # Only sampling rows persist state; others write to trash.
+                counts = counts.at[write_slots].set(new_counts)
+                keys = keys.at[write_slots].set(new_keys)
             slot_toks = slot_toks.at[write_slots].set(toks)
             return ck, cv, counts, keys, slot_toks, toks, lps
 
@@ -270,7 +284,8 @@ class ModelRunner:
         cache = NamedSharding(self.mesh, kv_cache_spec())
         return {"out_shardings": (cache, cache, repl, repl, repl, repl, repl)}
 
-    def _build_window_fn(self, b: int, nblk: int, w: int):
+    def _build_window_fn(self, b: int, nblk: int, w: int,
+                         fast_greedy: bool = False):
         """Fused decode window: ``w`` single-token steps in ONE compiled
         dispatch, `lax.scan`-sequenced on device with each step's sampled
         token feeding the next — zero host round trips inside the window.
@@ -297,16 +312,21 @@ class ModelRunner:
                     params, cfg, cur[:, None], q_start + j, q_len, bt, ck, cv,
                     attn_impl=attn_impl, moe_impl=moe_impl, mesh=mesh)
                 logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
-                st = SamplingState(
-                    temperature=temp, top_k=top_k, top_p=top_p,
-                    frequency_penalty=fp, presence_penalty=pp,
-                    repetition_penalty=rp, keys=keys[slots],
-                    token_counts=counts[slots],
-                )
-                toks, lps, new_keys = sample(logits, st)
-                new_counts = record_tokens(st.token_counts, toks, do_sample)
-                counts = counts.at[write_slots].set(new_counts)
-                keys = keys.at[write_slots].set(new_keys)
+                if fast_greedy:
+                    # See _build_step_fn: bit-identical for all-greedy
+                    # penalty-free batches, minus the sampling machinery.
+                    toks, lps = _greedy_sample(logits)
+                else:
+                    st = SamplingState(
+                        temperature=temp, top_k=top_k, top_p=top_p,
+                        frequency_penalty=fp, presence_penalty=pp,
+                        repetition_penalty=rp, keys=keys[slots],
+                        token_counts=counts[slots],
+                    )
+                    toks, lps, new_keys = sample(logits, st)
+                    new_counts = record_tokens(st.token_counts, toks, do_sample)
+                    counts = counts.at[write_slots].set(new_counts)
+                    keys = keys.at[write_slots].set(new_keys)
                 slot_toks = slot_toks.at[write_slots].set(toks)
                 return (ck, cv, counts, keys, slot_toks, toks), (toks, lps)
 
@@ -319,15 +339,17 @@ class ModelRunner:
                        **self._jit_shardings())
 
     def step_fn(self, b: int, t: int, nblk: int, sp_prefill: bool = False,
-                window: int = 1):
-        key = (b, t, nblk, sp_prefill, window)
+                window: int = 1, fast_greedy: bool = False):
+        key = (b, t, nblk, sp_prefill, window, fast_greedy)
         if key not in self._step_fns:
-            log.info("compiling step fn B=%d T=%d NBLK=%d sp_prefill=%s W=%d",
-                     b, t, nblk, sp_prefill, window)
+            log.info("compiling step fn B=%d T=%d NBLK=%d sp_prefill=%s W=%d "
+                     "greedy=%s", b, t, nblk, sp_prefill, window, fast_greedy)
             if window > 1:
-                self._step_fns[key] = self._build_window_fn(b, nblk, window)
+                self._step_fns[key] = self._build_window_fn(
+                    b, nblk, window, fast_greedy)
             else:
-                self._step_fns[key] = self._build_step_fn(b, t, nblk, sp_prefill)
+                self._step_fns[key] = self._build_step_fn(
+                    b, t, nblk, sp_prefill, fast_greedy)
         return self._step_fns[key]
 
     def reset_slot(self, slot: int, seed: int | None) -> None:
@@ -373,6 +395,7 @@ class ModelRunner:
         q_len = np.zeros((b,), np.int32)
         bt = np.zeros((b, nblk), np.int32)
         slots = np.zeros((b,), np.int32)
+        fast_greedy = True  # padding rows (temp 0, rp 1) are greedy-compatible
         temp = np.zeros((b,), np.float32)
         top_k = np.zeros((b,), np.int32)
         top_p = np.ones((b,), np.float32)
@@ -406,8 +429,10 @@ class ModelRunner:
             pp[i] = so.presence_penalty or 0.0
             rp[i] = so.repetition_penalty or 1.0
             do_sample[i] = sample_rows[i]
+            if temp[i] > 0.0 or fp[i] != 0.0 or pp[i] != 0.0 or rp[i] != 1.0:
+                fast_greedy = False
 
-        fn = self.step_fn(b, t, nblk, sp_prefill, window)
+        fn = self.step_fn(b, t, nblk, sp_prefill, window, fast_greedy)
         place = self._place
         (self.cache_k, self.cache_v, self.counts, self.keys, self.slot_toks,
          toks, lps) = fn(

@@ -77,6 +77,22 @@ def sample(logits: jax.Array, st: SamplingState) -> tuple[jax.Array, jax.Array, 
     return tokens, lp, new_keys
 
 
+def greedy_sample(logits: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """All-greedy, penalty-free batch: argmax + its logprob.
+
+    Bit-identical to :func:`sample` when every row has temperature <= 0,
+    frequency/presence penalty 0, and repetition penalty 1 (callers verify
+    at dispatch) — penalties are then the identity, so argmax over raw
+    logits selects the same token and ``log_softmax`` yields the same
+    logprob. Skips the PRNG, the penalty-count gather/scatter, and the
+    sorted top-k/p masking — per-step vocab-sized traffic that is pure
+    waste for greedy serving."""
+    toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lp = jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
+                             toks[:, None], axis=-1)[:, 0]
+    return toks, lp
+
+
 def record_tokens(token_counts: jax.Array, tokens: jax.Array, active: jax.Array) -> jax.Array:
     """Scatter-add sampled tokens into the penalty counts (inactive rows skipped)."""
     inc = active.astype(jnp.int32)

@@ -49,6 +49,10 @@ class Process:
     args: list[str]
     replicas: int = 1
     ready_line: str | None = None
+    # Processes sharing a group are spawned TOGETHER before any readiness
+    # wait — multi-host ranks block in jax.distributed.initialize until
+    # every rank exists, so gating rank 0 alone would deadlock.
+    group: str | None = None
 
     def argv(self) -> list[str]:
         return [sys.executable, "-m", self.module, *self.args]
@@ -132,6 +136,10 @@ def build_plan(doc: dict, engine_override: str | None = None,
         args += _mesh_args(w.get("mesh", {}))
         args += _engine_args(w.get("engine", {}))
         nodes = int(w.get("nodes", 1))
+        if engine_override and engine_override != "jax":
+            # Chip-free override (mocker): a simulator doesn't shard — one
+            # process stands in for the whole multi-host engine.
+            nodes = 1
         if nodes > 1:
             # Multi-host: one process per (replica, rank); rank 0 leads
             # (parallel/multihost.py resolves the leader through the
@@ -147,7 +155,7 @@ def build_plan(doc: dict, engine_override: str | None = None,
                         args=args + ["--num-nodes", str(nodes),
                                      "--node-rank", str(rank),
                                      "--multihost-group", group],
-                        replicas=1,
+                        replicas=1, group=group,
                         ready_line="WORKER_READY" if rank == 0 else None))
         else:
             plan.processes.append(Process(
@@ -193,40 +201,83 @@ def format_plan(plan: Plan) -> str:
     return "\n".join(lines)
 
 
+class _Child:
+    """A spawned process with a drain thread: the pipe is read for the
+    process's whole life (a full 64KB pipe would block the child mid-serve)
+    and the ready line is detected without blocking the launcher."""
+
+    def __init__(self, spec: Process, idx: int):
+        import threading
+
+        self.spec = spec
+        self.proc = subprocess.Popen(
+            spec.argv(), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        self.name = spec.name if spec.replicas == 1 else f"{spec.name}[{idx}]"
+        self.ready = threading.Event()
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self) -> None:
+        for line in self.proc.stdout:  # type: ignore[union-attr]
+            sys.stdout.write(f"{self.name}: {line}")
+            sys.stdout.flush()
+            if self.spec.ready_line and self.spec.ready_line in line:
+                self.ready.set()
+
+    def wait_ready(self, deadline: float) -> None:
+        while not self.ready.wait(timeout=0.25):
+            if self.proc.poll() is not None:
+                raise RuntimeError(f"{self.name} exited rc={self.proc.returncode} "
+                                   "before ready")
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"{self.name} not ready in time")
+
+
 def run_local(plan: Plan, timeout: float = 600.0) -> None:
-    """Launch every process on this host, readiness-gated in order."""
-    procs: list[tuple[Process, subprocess.Popen]] = []
+    """Launch every process on this host. Processes are readiness-gated in
+    plan order, except that a ``group`` (multi-host rank set) is spawned in
+    full before its readiness wait — rank 0 cannot become ready until every
+    follower has joined the jax.distributed rendezvous."""
+    children: list[_Child] = []
 
     def stop_all() -> None:
-        for _, sp in reversed(procs):
-            if sp.poll() is None:
-                sp.terminate()
-        for _, sp in reversed(procs):
+        for c in reversed(children):
+            if c.proc.poll() is None:
+                c.proc.terminate()
+        for c in reversed(children):
             try:
-                sp.wait(10)
+                c.proc.wait(10)
             except subprocess.TimeoutExpired:
-                sp.kill()
+                c.proc.kill()
+
+    def spawn(p: Process) -> list[_Child]:
+        out = []
+        for r in range(p.replicas):
+            c = _Child(p, r)
+            children.append(c)
+            out.append(c)
+            log.info("started %s pid=%d", c.name, c.proc.pid)
+        return out
 
     try:
-        for p in plan.processes:
-            for r in range(p.replicas):
-                sp = subprocess.Popen(
-                    p.argv(), stdout=subprocess.PIPE,
-                    stderr=subprocess.STDOUT, text=True)
-                procs.append((p, sp))
-                log.info("started %s[%d] pid=%d", p.name, r, sp.pid)
-                if p.ready_line:
-                    deadline = time.monotonic() + timeout
-                    for line in sp.stdout:  # type: ignore[union-attr]
-                        sys.stdout.write(f"{p.name}: {line}")
-                        if p.ready_line in line:
-                            break
-                        if time.monotonic() > deadline:
-                            raise TimeoutError(
-                                f"{p.name} not ready within {timeout}s")
-                    else:
-                        raise RuntimeError(f"{p.name} exited before ready")
-        print(f"RECIPE_UP {plan.name} processes={len(procs)}", flush=True)
+        i = 0
+        procs = plan.processes
+        while i < len(procs):
+            group = procs[i].group
+            batch: list[_Child] = []
+            if group is None:
+                batch += spawn(procs[i])
+                i += 1
+            else:  # spawn the whole rank group before any wait
+                while i < len(procs) and procs[i].group == group:
+                    batch += spawn(procs[i])
+                    i += 1
+            deadline = time.monotonic() + timeout
+            for c in batch:
+                if c.spec.ready_line:
+                    c.wait_ready(deadline)
+        print(f"RECIPE_UP {plan.name} processes={len(children)}", flush=True)
         # Block BEFORE waiting: bare sigwait races the default SIGTERM
         # action (process death without the finally → leaked children).
         signal.pthread_sigmask(signal.SIG_BLOCK,

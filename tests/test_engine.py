@@ -386,3 +386,30 @@ def test_pp_engine_matches_unsharded():
     a, b = run(1), run(2)
     for i in range(3):
         assert b[f"p2-{i}"] == a[f"p1-{i}"], f"stream {i} diverged under pp"
+
+
+def test_fast_greedy_path_matches_general():
+    """An all-greedy penalty-free batch takes the fast_greedy step variant
+    and emits EXACTLY the stream the general sampling path produces for the
+    same greedy requests (greedy rows are independent of batch siblings, so
+    co-batching a temperature request forces the general path as oracle)."""
+    prompts = [[10 + i * 3 + j for j in range(9)] for i in range(2)]
+
+    fast_core = EngineCore(tiny_config(decode_window=2))
+    fast, _ = run_to_completion(fast_core, [
+        make_req(prompt=p, max_tokens=7, rid=f"g{i}")
+        for i, p in enumerate(prompts)])
+    keys = list(fast_core.runner._step_fns)
+    assert any(k[5] for k in keys), f"fast_greedy variant unused: {keys}"
+
+    gen_core = EngineCore(tiny_config(decode_window=2))
+    general, _ = run_to_completion(gen_core, [
+        *(make_req(prompt=p, max_tokens=7, rid=f"g{i}")
+          for i, p in enumerate(prompts)),
+        make_req(prompt=[7, 8, 9, 11], max_tokens=7, rid="sampled",
+                 temperature=0.8, seed=3),
+    ])
+    assert all(not k[5] for k in gen_core.runner._step_fns), \
+        "general core unexpectedly used the fast path"
+    for i in range(2):
+        assert fast[f"g{i}"] == general[f"g{i}"], (fast, general)

@@ -135,3 +135,15 @@ spec:
             proc.wait(15)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_mocker_override_collapses_multihost():
+    """--engine mocker must be runnable chip-free: multi-host worker pools
+    collapse to single-process simulators (a mocker doesn't shard)."""
+    plan = build_plan(load_spec(
+        Path(__file__).parent.parent / "recipes/llama-3-70b/disagg-v5e-64.yaml"),
+        engine_override="mocker")
+    for p in plan.processes:
+        assert "--num-nodes" not in p.args, p.name
+    names = [p.name for p in plan.processes]
+    assert "prefill" in names and "decode" in names
