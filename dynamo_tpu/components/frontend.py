@@ -44,6 +44,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--grpc-port", type=int, default=None,
                    help="also serve the KServe v2 gRPC protocol on this port "
                         "(0 = ephemeral; omitted = gRPC disabled)")
+    p.add_argument("--tls-cert", default=None,
+                   help="PEM certificate chain; serves HTTPS (and TLS gRPC)")
+    p.add_argument("--tls-key", default=None, help="PEM private key")
     return p.parse_args(argv)
 
 
@@ -183,13 +186,15 @@ async def amain(ns: argparse.Namespace) -> None:
     watcher = ModelWatcher(rt, models, ns)
     await watcher.start()
     svc = HttpService(models)
-    port = await svc.start(ns.host, ns.port)
+    port = await svc.start(ns.host, ns.port,
+                           tls_cert=ns.tls_cert, tls_key=ns.tls_key)
     grpc_srv = None
     if ns.grpc_port is not None:
         from dynamo_tpu.frontend.kserve_grpc import KServeGrpcServer
 
         grpc_srv = KServeGrpcServer(models, service=svc)
-        gport = await grpc_srv.start(ns.host, ns.grpc_port)
+        gport = await grpc_srv.start(ns.host, ns.grpc_port,
+                                     tls_cert=ns.tls_cert, tls_key=ns.tls_key)
         log.info("kserve grpc ready on :%d", gport)
         print(f"FRONTEND_GRPC_READY port={gport}", flush=True)
     log.info("frontend ready on :%d (router=%s)", port, ns.router_mode)

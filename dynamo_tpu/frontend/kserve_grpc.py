@@ -309,12 +309,22 @@ class KServeGrpcServer:
         self._server: grpc.aio.Server | None = None
         self.port: int | None = None
 
-    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+    async def start(self, host: str = "127.0.0.1", port: int = 0,
+                    tls_cert: str | None = None,
+                    tls_key: str | None = None) -> int:
         self._server = grpc.aio.server()
         self._server.add_generic_rpc_handlers((self._service.handler(),))
-        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if tls_cert or tls_key:
+            if not (tls_cert and tls_key):
+                raise ValueError("TLS needs BOTH --tls-cert and --tls-key")
+            with open(tls_key, "rb") as kf, open(tls_cert, "rb") as cf:
+                creds = grpc.ssl_server_credentials(((kf.read(), cf.read()),))
+            self.port = self._server.add_secure_port(f"{host}:{port}", creds)
+        else:
+            self.port = self._server.add_insecure_port(f"{host}:{port}")
         await self._server.start()
-        log.info("kserve grpc listening on %s:%d", host, self.port)
+        log.info("kserve grpc%s listening on %s:%d",
+                 " (tls)" if tls_cert else "", host, self.port)
         return self.port
 
     async def stop(self, grace: float = 1.0) -> None:

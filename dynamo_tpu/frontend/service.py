@@ -98,14 +98,27 @@ class HttpService:
         self.port: int = 0
 
     # ------------------------------------------------------------------
-    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+    async def start(self, host: str = "127.0.0.1", port: int = 0,
+                    tls_cert: str | None = None,
+                    tls_key: str | None = None) -> int:
+        """Serve plaintext, or TLS when a cert+key pair is given
+        (reference: the axum HttpService's TLS option, service_v2.rs)."""
         self._audit.maybe_init_from_env()
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, host, port)
+        ssl_ctx = None
+        if tls_cert or tls_key:
+            if not (tls_cert and tls_key):
+                raise ValueError("TLS needs BOTH --tls-cert and --tls-key")
+            import ssl
+
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(tls_cert, tls_key)
+        site = web.TCPSite(self._runner, host, port, ssl_context=ssl_ctx)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
-        log.info("http service listening on %s:%d", host, self.port)
+        log.info("http%s service listening on %s:%d",
+                 "s" if ssl_ctx else "", host, self.port)
         return self.port
 
     async def stop(self) -> None:

@@ -1,0 +1,101 @@
+"""TLS frontends (reference: the axum HttpService TLS option,
+http/service/service_v2.rs, and tonic TLS): HTTPS serving, TLS gRPC, and
+cert/key validation. Certs are generated per-run (cryptography lib) —
+nothing sensitive is committed.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ssl
+
+import aiohttp
+import grpc
+import pytest
+
+from dynamo_tpu.frontend import kserve_pb2 as pb
+from dynamo_tpu.frontend.kserve_grpc import KServeGrpcServer, make_client_stub
+from dynamo_tpu.frontend.model_manager import ModelManager
+from dynamo_tpu.frontend.service import HttpService
+from dynamo_tpu.preprocessor.preprocessor import ModelDefaults
+from dynamo_tpu.tokenizer import ByteTokenizer
+from tests.test_kserve import canned_generate
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    d = tmp_path_factory.mktemp("tls")
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName("localhost")]), critical=False)
+            .sign(key, hashes.SHA256()))
+    cert_path, key_path = d / "cert.pem", d / "key.pem"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()))
+    return str(cert_path), str(key_path)
+
+
+def _models() -> ModelManager:
+    models = ModelManager()
+    models.register("m", ByteTokenizer(), canned_generate("secure hello"),
+                    defaults=ModelDefaults())
+    return models
+
+
+async def test_https_serving(certs):
+    cert, key = certs
+    svc = HttpService(_models())
+    port = await svc.start("127.0.0.1", 0, tls_cert=cert, tls_key=key)
+    try:
+        ctx = ssl.create_default_context(cafile=cert)
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(f"https://localhost:{port}/v1/completions",
+                             json={"model": "m", "prompt": "x", "max_tokens": 32},
+                             ssl=ctx)
+            assert r.status == 200, await r.text()
+            data = await r.json()
+            assert data["choices"][0]["text"] == "secure hello"
+            # plaintext against the TLS port is refused
+            with pytest.raises(aiohttp.ClientError):
+                await s.get(f"http://127.0.0.1:{port}/v1/models")
+    finally:
+        await svc.stop()
+
+
+async def test_grpc_tls_serving(certs):
+    cert, key = certs
+    srv = KServeGrpcServer(_models())
+    port = await srv.start("127.0.0.1", 0, tls_cert=cert, tls_key=key)
+    try:
+        with open(cert, "rb") as f:
+            creds = grpc.ssl_channel_credentials(f.read())
+        async with grpc.aio.secure_channel(f"localhost:{port}", creds) as chan:
+            stub = make_client_stub(chan)
+            assert (await stub.ServerLive(pb.ServerLiveRequest())).live
+    finally:
+        await srv.stop()
+
+
+async def test_half_configured_tls_is_rejected(certs):
+    cert, _ = certs
+    svc = HttpService(_models())
+    with pytest.raises(ValueError, match="BOTH"):
+        await svc.start("127.0.0.1", 0, tls_cert=cert)
+    srv = KServeGrpcServer(_models())
+    with pytest.raises(ValueError, match="BOTH"):
+        await srv.start("127.0.0.1", 0, tls_cert=cert)
