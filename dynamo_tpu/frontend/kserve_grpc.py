@@ -312,11 +312,12 @@ class KServeGrpcServer:
     async def start(self, host: str = "127.0.0.1", port: int = 0,
                     tls_cert: str | None = None,
                     tls_key: str | None = None) -> int:
+        from dynamo_tpu.frontend.service import validate_tls_pair
+
+        use_tls = validate_tls_pair(tls_cert, tls_key)  # before server setup
         self._server = grpc.aio.server()
         self._server.add_generic_rpc_handlers((self._service.handler(),))
-        if tls_cert or tls_key:
-            if not (tls_cert and tls_key):
-                raise ValueError("TLS needs BOTH --tls-cert and --tls-key")
+        if use_tls:
             with open(tls_key, "rb") as kf, open(tls_cert, "rb") as cf:
                 creds = grpc.ssl_server_credentials(((kf.read(), cf.read()),))
             self.port = self._server.add_secure_port(f"{host}:{port}", creds)

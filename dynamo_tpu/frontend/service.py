@@ -51,6 +51,18 @@ def _error(status: int, message: str) -> web.Response:
 
 
 
+def validate_tls_pair(tls_cert: str | None, tls_key: str | None) -> bool:
+    """True → serve TLS; False → plaintext. One copy of the pair rule,
+    shared by the HTTP and gRPC servers (and callable pre-side-effects)."""
+    if tls_cert or tls_key:
+        if not (tls_cert and tls_key):
+            raise ValueError(
+                "TLS needs both a certificate and a private key "
+                "(--tls-cert/--tls-key on the frontend CLI)")
+        return True
+    return False
+
+
 def _wants_logprobs(req, chat: bool) -> bool:
     """THE chat-vs-completions logprob acceptance rule, in one place:
     chat uses a boolean flag; completions uses an int where 0 still means
@@ -103,17 +115,19 @@ class HttpService:
                     tls_key: str | None = None) -> int:
         """Serve plaintext, or TLS when a cert+key pair is given
         (reference: the axum HttpService's TLS option, service_v2.rs)."""
+        # Validate BEFORE side effects (audit init, runner setup) so a
+        # half-configured pair can't leak an initialized runner.
+        ssl_ctx = None
+        if validate_tls_pair(tls_cert, tls_key):
+            import ssl
+
+            # create_default_context carries the stdlib's server hardening
+            # (cipher restrictions, OP_NO_COMPRESSION) a bare context lacks.
+            ssl_ctx = ssl.create_default_context(ssl.Purpose.CLIENT_AUTH)
+            ssl_ctx.load_cert_chain(tls_cert, tls_key)
         self._audit.maybe_init_from_env()
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        ssl_ctx = None
-        if tls_cert or tls_key:
-            if not (tls_cert and tls_key):
-                raise ValueError("TLS needs BOTH --tls-cert and --tls-key")
-            import ssl
-
-            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ssl_ctx.load_cert_chain(tls_cert, tls_key)
         site = web.TCPSite(self._runner, host, port, ssl_context=ssl_ctx)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]

@@ -94,8 +94,8 @@ async def test_grpc_tls_serving(certs):
 async def test_half_configured_tls_is_rejected(certs):
     cert, _ = certs
     svc = HttpService(_models())
-    with pytest.raises(ValueError, match="BOTH"):
+    with pytest.raises(ValueError, match="both"):
         await svc.start("127.0.0.1", 0, tls_cert=cert)
     srv = KServeGrpcServer(_models())
-    with pytest.raises(ValueError, match="BOTH"):
+    with pytest.raises(ValueError, match="both"):
         await srv.start("127.0.0.1", 0, tls_cert=cert)
