@@ -37,6 +37,7 @@ from dynamo_tpu.frontend.kserve import (
 )
 from dynamo_tpu.frontend.model_manager import ModelManager
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tls import validate_tls_pair
 
 log = get_logger("kserve_grpc")
 
@@ -312,8 +313,6 @@ class KServeGrpcServer:
     async def start(self, host: str = "127.0.0.1", port: int = 0,
                     tls_cert: str | None = None,
                     tls_key: str | None = None) -> int:
-        from dynamo_tpu.frontend.service import validate_tls_pair
-
         use_tls = validate_tls_pair(tls_cert, tls_key)  # before server setup
         self._server = grpc.aio.server()
         self._server.add_generic_rpc_handlers((self._service.handler(),))

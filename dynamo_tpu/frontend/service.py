@@ -41,6 +41,7 @@ from dynamo_tpu.protocols.openai import (
 from dynamo_tpu.protocols.sse import DONE_EVENT, encode_sse_json
 from dynamo_tpu.utils.logging import get_logger
 from dynamo_tpu.utils.metrics import MetricsRegistry
+from dynamo_tpu.utils.tls import validate_tls_pair
 
 log = get_logger("frontend")
 
@@ -49,18 +50,6 @@ def _error(status: int, message: str) -> web.Response:
     body = ErrorResponse(error=ErrorInfo(message=message, code=status)).model_dump_json()
     return web.Response(status=status, text=body, content_type="application/json")
 
-
-
-def validate_tls_pair(tls_cert: str | None, tls_key: str | None) -> bool:
-    """True → serve TLS; False → plaintext. One copy of the pair rule,
-    shared by the HTTP and gRPC servers (and callable pre-side-effects)."""
-    if tls_cert or tls_key:
-        if not (tls_cert and tls_key):
-            raise ValueError(
-                "TLS needs both a certificate and a private key "
-                "(--tls-cert/--tls-key on the frontend CLI)")
-        return True
-    return False
 
 
 def _wants_logprobs(req, chat: bool) -> bool:
