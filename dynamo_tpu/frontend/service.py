@@ -576,10 +576,21 @@ class HttpService:
         audit_error: str | None = None
         lp_pending: list[BackendOutput] = []  # completions: jailed-delta lps
         lp_offset = 0                         # completions: cumulative text pos
+        stream = entry.generate(pre)
+        disconnected = False
         try:
             if chat:
                 await resp.write(encode_sse_json(gen.role_chunk()))
-            async for eo in entry.generate(pre):
+            async for eo in stream:
+                if request.transport is None or request.transport.is_closing():
+                    # Poll the transport each delta: between deltas nothing
+                    # writes, so a dead client would otherwise go unnoticed
+                    # until the next write — burning the token budget into a
+                    # void (reference: http/service/disconnect.rs:205). The
+                    # finally's stream.aclose() propagates the abort down to
+                    # the engine/worker.
+                    disconnected = True
+                    break
                 now = time.monotonic()
                 if eo.token_ids:
                     if first:
@@ -666,6 +677,16 @@ class HttpService:
                         await resp.write(encode_sse_json(cr))
                 if backend.hit_stop:
                     break
+            if disconnected:
+                # Own terminal path — never fall through to the success tail
+                # (jail flush, usage, DONE, the 200 counter) on a dead
+                # transport; 499 is recorded HERE, not via a failed write.
+                log.info("client disconnected mid-stream; aborting %s",
+                         pre.request_id)
+                audit_error = "client disconnected"
+                self._requests.inc(route="chat" if chat else "completions",
+                                   status="499")
+                return resp
             if jail is not None and not jail_flushed:
                 # Stream ended without a finish_reason (engine error or stop
                 # mid-jail): flush withheld text — a bare-JSON/mistral payload
@@ -706,6 +727,18 @@ class HttpService:
             audit_error = audit_error or "client disconnected"
             self._requests.inc(route="chat" if chat else "completions", status="499")
         finally:
+            # Deterministic teardown: close the generation stream NOW (not at
+            # GC) so a disconnect-abort reaches the engine/worker while this
+            # request's slot is still the thing being freed. Guarded — a
+            # teardown failure (or a generate impl without aclose) must not
+            # swallow the metric/audit lines below.
+            try:
+                aclose = getattr(stream, "aclose", None)
+                if aclose is not None:
+                    await aclose()
+            except Exception:  # noqa: BLE001
+                log.exception("generation stream teardown failed for %s",
+                              pre.request_id)
             self._output_tokens.inc(ntokens, model=req.model)
             if chat and self._audit.bus() is not None:
                 # From finally so disconnects and engine errors are audited

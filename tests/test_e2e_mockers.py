@@ -149,3 +149,94 @@ def test_worker_death_migration(cluster):
             pass
         time.sleep(0.5)
     assert ok >= 5, f"only {ok}/6 requests succeeded after worker death"
+
+
+def test_client_disconnect_aborts_generation():
+    """Dropping an SSE stream mid-generation aborts the request all the way
+    down: CANCEL rides the data plane to the worker and the engine frees
+    the slot (reference test model: tests/fault_tolerance/cancellation/).
+    A dedicated SLOW mocker (speedup 1 → 8ms/token → 400 tokens ≈ 3.2s)
+    makes the abort provable: the step counter must stop far short of the
+    request's budget."""
+    import http.client
+
+    coord_port, http_port = free_port(), free_port()
+    coordinator = ManagedProcess(
+        ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
+         "--port", str(coord_port)], name="coordinator").start()
+    url = f"tcp://127.0.0.1:{coord_port}"
+    time.sleep(1.0)
+    worker = ManagedProcess(
+        ["-m", "dynamo_tpu.components.worker", "--engine", "mocker",
+         "--coordinator", url, "--block-size", "4", "--speedup-ratio", "1",
+         "--max-model-len", "512", "--num-blocks", "128"], name="worker").start()
+    frontend = None
+    try:
+        worker.wait_for_line("WORKER_READY", 30)
+        frontend = ManagedProcess(
+            ["-m", "dynamo_tpu.components.frontend", "--coordinator", url,
+             "--host", "127.0.0.1", "--port", str(http_port),
+             "--router-mode", "kv"], name="frontend").start()
+        frontend.wait_for_line("FRONTEND_READY", 30)
+        base = f"http://127.0.0.1:{http_port}"
+        for _ in range(100):
+            if http_json(base + "/v1/models")["data"]:
+                break
+            time.sleep(0.1)
+
+        conn = http.client.HTTPConnection("127.0.0.1", http_port, timeout=30)
+        body = json.dumps({
+            "model": "tiny-llama", "prompt": "abort me please",
+            "max_tokens": 400, "ignore_eos": True, "stream": True,
+        })
+        conn.request("POST", "/v1/completions", body=body,
+                     headers={"content-type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        got = resp.read(120)  # a couple of live SSE chunks...
+        assert b"data:" in got
+
+        def worker_stats() -> dict:
+            return next(iter(http_json(base + "/engine_stats")
+                             .get("tiny-llama", {}).get("workers", {})
+                             .values()), {})
+
+        # Wait until the 0.25s-interval metrics have SEEN the generation —
+        # otherwise the post-disconnect idle poll could read a stale
+        # pre-request snapshot and pass vacuously.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if worker_stats().get("num_running", 0) > 0:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("generation never became visible in stats")
+
+        # hard disconnect mid-stream: shutdown() forces the FIN out even
+        # though resp's buffered reader still holds a socket reference
+        # (plain close() would leave the fd open until GC)
+        import socket as _socket
+
+        conn.sock.shutdown(_socket.SHUT_RDWR)
+        conn.sock.close()
+
+        # abort must land: engine drains to idle LONG before the 3.2s the
+        # full generation needs, and the step counter proves early stop
+        deadline = time.time() + 15
+        stats = {}
+        while time.time() < deadline:
+            stats = worker_stats()
+            if stats and stats.get("num_running", 1) == 0 \
+                    and stats.get("num_waiting", 1) == 0:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"still running after disconnect: {stats}")
+        assert stats.get("num_steps", 10**9) < 300, (
+            f"engine ran {stats.get('num_steps')} steps — the 400-token "
+            f"request was not aborted early")
+    finally:
+        if frontend:
+            frontend.stop()
+        worker.stop()
+        coordinator.stop()
