@@ -44,6 +44,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--allow-random-weights", action="store_true",
                    help="serve RANDOM weights when the model path has no "
                         "loadable safetensors (tests/benches only)")
+    p.add_argument("--spec-ngram", type=int, default=0,
+                   help="n-gram speculative decoding: propose continuations "
+                        "of the trailing n-gram, verify in one pass "
+                        "(greedy-exact; 0 = off)")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="max proposed tokens per verify step")
     p.add_argument("--decode-window", type=int, default=1,
                    help="decode steps fused per device dispatch")
     p.add_argument("--tokenizer", default=None)
@@ -225,6 +231,8 @@ async def amain(ns: argparse.Namespace) -> None:
             tp=ns.tp,
             pp=ns.pp,
             decode_window=ns.decode_window,
+            spec_ngram=ns.spec_ngram,
+            spec_k=ns.spec_k,
             allow_random_weights=ns.allow_random_weights,
             host_kv_blocks=ns.host_kv_blocks,
             disk_kv_path=ns.disk_kv_path,

@@ -78,6 +78,11 @@ class EngineMetrics:
     num_preemptions: int = 0
     prefix_hit_blocks: int = 0
     prefix_lookup_blocks: int = 0
+    # Speculative decoding (reference surface: SpecDecodeStats in
+    # ForwardPassMetrics): proposed = tokens offered for verification,
+    # accepted = proposals that matched the true greedy path.
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     def snapshot(self, sched: Scheduler, pool: PrefixPool) -> dict:
         return {
@@ -91,6 +96,8 @@ class EngineMetrics:
             "requests_finished": self.num_requests_finished,
             "preemptions": self.num_preemptions,
             "prefix_hit_rate": self.prefix_hit_blocks / max(self.prefix_lookup_blocks, 1),
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
         }
 
 
@@ -456,6 +463,76 @@ class ModelRunner:
         n = len(rows)
         return np.asarray(toks)[:n], np.asarray(lps)[:n]
 
+    # -- speculative verify --------------------------------------------
+    def _build_verify_fn(self, b: int, t: int, nblk: int):
+        """One forward over a [B, t] chunk of (current token + proposed
+        continuation), returning the ARGMAX token and its logprob at EVERY
+        position — the speculative-decoding verify step (engine/spec.py).
+        Greedy-only by contract (callers gate on greedy+penalty-free rows),
+        so no sampling state is read or written; KV for all positions is
+        written (rejected positions are overwritten by later true tokens)."""
+        cfg = self.cfg
+        attn_impl = self.attn_impl
+        moe_impl = "ep" if self.engine_cfg.ep > 1 else "dense"
+        mesh = self.mesh
+
+        def verify(params, ck, cv, tokens, q_start, q_len, bt):
+            hidden, ck, cv = llama.forward(
+                params, cfg, tokens, q_start, q_len, bt, ck, cv,
+                attn_impl=attn_impl, moe_impl=moe_impl, mesh=mesh,
+                return_all_hidden=True)
+            logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
+            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)      # [B, t]
+            lps = jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
+                                      toks[..., None], axis=-1)[..., 0]
+            return ck, cv, toks, lps
+
+        kw = {}
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from dynamo_tpu.parallel.mesh import kv_cache_spec
+
+            repl = NamedSharding(self.mesh, P())
+            cache = NamedSharding(self.mesh, kv_cache_spec())
+            kw["out_shardings"] = (cache, cache, repl, repl)
+        return jax.jit(verify, donate_argnums=(1, 2), **kw)
+
+    def dispatch_verify(self, rows: list[tuple[Seq, int, int]],
+                        chunks: list[list[int]]) -> tuple[jax.Array, jax.Array]:
+        """Enqueue one verify step; chunk tokens are EXPLICIT (the proposals
+        are not in seq.tokens yet). Returns ([B, t] argmax tokens, lps)."""
+        ec = self.engine_cfg
+        n = len(rows)
+        t_max = max(len(c) for c in chunks)
+        b = _bucket(n, ec.decode_bucket)
+        # clamp: _pow2_bucket's hi stops further doubling but doesn't cap
+        # the result — a 5-token chunk must not mint (and pay for) T=8
+        t = min(_pow2_bucket(t_max, 2, ec.spec_k + 1), ec.spec_k + 1)
+        nblk_need = max(len(s.block_ids) for s, _, _ in rows)
+        nblk = min(_pow2_bucket(max(nblk_need, 1), 4, self.max_nblk), self.max_nblk)
+
+        tokens = np.zeros((b, t), np.int32)
+        q_start = np.zeros((b,), np.int32)
+        q_len = np.zeros((b,), np.int32)
+        bt = np.zeros((b, nblk), np.int32)
+        for i, (seq, start, length) in enumerate(rows):
+            tokens[i, : len(chunks[i])] = chunks[i]
+            q_start[i] = start
+            q_len[i] = len(chunks[i])
+            bt[i, : len(seq.block_ids)] = seq.block_ids
+
+        key = ("verify", b, t, nblk)
+        if key not in self._step_fns:
+            log.info("compiling verify fn B=%d T=%d NBLK=%d", b, t, nblk)
+            self._step_fns[key] = self._build_verify_fn(b, t, nblk)
+        fn = self._step_fns[key]
+        place = self._place
+        self.cache_k, self.cache_v, toks, lps = fn(
+            self.params, self.cache_k, self.cache_v,
+            place(tokens), place(q_start), place(q_len), place(bt))
+        return toks, lps
+
     # -- embeddings ----------------------------------------------------
     def _build_embed_fn(self, b: int, t: int):
         """Prefill-only forward returning the final-norm hidden state at the
@@ -547,6 +624,14 @@ class EngineCore:
                                         engine_cfg.max_model_len),
             )
         self.engine_cfg = engine_cfg
+        if engine_cfg.spec_ngram > 0:
+            if engine_cfg.decode_window > 1:
+                raise ValueError(
+                    "spec_ngram and decode_window>1 are mutually exclusive "
+                    "(both amortize dispatches over future tokens; pick one)")
+            if engine_cfg.pp > 1:
+                raise ValueError("spec_ngram requires pp=1 (forward_pp has "
+                                 "no all-positions output)")
         if engine_cfg.pp > 1 and (engine_cfg.tp > 1 or engine_cfg.ep > 1
                                   or engine_cfg.sp > 1):
             raise ValueError(
@@ -573,6 +658,8 @@ class EngineCore:
             max_model_len=engine_cfg.max_model_len,
             max_tokens_per_step=engine_cfg.max_tokens_per_step,
             decode_window=engine_cfg.decode_window,
+            spec_lookahead=(engine_cfg.spec_k if engine_cfg.spec_ngram > 0
+                            else 0),
         )
         self.metrics = EngineMetrics()
         self._seqs: dict[str, Seq] = {}
@@ -729,8 +816,19 @@ class EngineCore:
         # not one padded batch).
         pending = PendingStep()
         batches: list[tuple[str, list, list[bool], int]] = []
-        if plan.decode:
-            rows = [(s, s.num_computed, 1) for s in plan.decode]
+        decode_seqs = plan.decode
+        if self.engine_cfg.spec_ngram > 0 and decode_seqs:
+            verify_rows, verify_chunks, decode_seqs = self._plan_verify(decode_seqs)
+            if verify_rows:
+                toks, lps = self.runner.dispatch_verify(verify_rows, verify_chunks)
+                for seq, start, length in verify_rows:
+                    seq.num_computed = start + length
+                    seq.inflight_samples += 1
+                    seq.verify_inflight = True
+                pending.batches.append(
+                    ("verify", verify_rows, verify_chunks, toks, lps))
+        if decode_seqs:
+            rows = [(s, s.num_computed, 1) for s in decode_seqs]
             batches.append(("decode", rows, [True] * len(rows), plan.decode_window))
         if plan.prefill:
             rows = [(w.seq, w.start, w.length) for w in plan.prefill]
@@ -757,12 +855,95 @@ class EngineCore:
             pending.batches.append((kind, rows, sample_rows, toks, lps))
         return pending
 
+    def _plan_verify(self, decode_seqs: list
+                     ) -> tuple[list, list[list[int]], list]:
+        """Partition decode seqs into speculative-verify rows and plain
+        decode. A seq verifies when it is greedy + penalty-free (verify is
+        argmax-exact only then), its last token is host-known (no in-flight
+        device-fed sample), and the n-gram proposer finds a continuation
+        (engine/spec.py).
+
+        Pipelined entry: under the overlapped step loop a decode seq's last
+        token is ALWAYS still in flight at plan time — so when the known
+        prefix already shows a repetition signal (a proposal exists even
+        without the pending token), the seq PAUSES one plan cycle (dropped
+        from this step) so its token materializes and the next plan can
+        verify. The bubble costs one cycle; an accepted run repays it with
+        up to spec_k+1 tokens. No signal → plain pipelined decode, no
+        bubble."""
+        from dynamo_tpu.engine.spec import greedy_eligible, propose
+
+        ec = self.engine_cfg
+        verify_rows, verify_chunks, plain = [], [], []
+        for seq in decode_seqs:
+            if not greedy_eligible(seq.req.sampling_options):
+                plain.append(seq)
+                continue
+            # cap proposals to stay inside the model context
+            k = min(ec.spec_k, ec.max_model_len - 1 - seq.num_computed)
+            proposal = propose(seq.tokens, ec.spec_ngram, k) if k > 0 else []
+            if seq.inflight_samples > 0:
+                if not proposal:
+                    plain.append(seq)   # no signal: stay fully pipelined
+                # else: pause this cycle (dispatch nothing for this seq)
+                continue
+            if not proposal:
+                plain.append(seq)
+                continue
+            start = seq.num_computed
+            chunk = [seq.tokens[start], *proposal]
+            verify_rows.append((seq, start, len(chunk)))
+            verify_chunks.append(chunk)
+            self.metrics.spec_proposed += len(proposal)
+        return verify_rows, verify_chunks, plain
+
+    def _emit_and_finish(self, seq, candidates: list[int], lps_row,
+                         outputs: dict[str, LLMEngineOutput],
+                         count_decode: bool) -> int:
+        """THE finalize tail, shared by decode/window and verify batches so
+        the greedy-equivalence guarantee can't drift between them: append
+        candidate tokens until a stop fires, commit blocks, transfer
+        prefix-hit stats, assemble the output, run finish bookkeeping.
+        Returns the number of tokens emitted."""
+        emitted: list[int] = []
+        reason = None
+        for token in candidates:
+            seq.tokens.append(token)
+            seq.block_seq.append(token)
+            emitted.append(token)
+            reason = self._check_stop(seq, token)
+            if reason is not None:
+                break
+        if count_decode:
+            self.metrics.num_decode_tokens += len(emitted)
+        self.sched.commit_computed_blocks(seq)
+        if seq.prefix_hit_blocks:
+            self.metrics.prefix_hit_blocks += seq.prefix_hit_blocks
+            seq.prefix_hit_blocks = 0
+        per_tok = [float(x) for x in lps_row[: len(emitted)]]
+        out = LLMEngineOutput(
+            token_ids=emitted,
+            cum_log_probs=sum(per_tok),
+            log_probs=per_tok,
+        )
+        if reason is not None:
+            out.finish_reason = reason
+            self.sched.finish(seq, reason)
+            self.metrics.num_requests_finished += 1
+            del self._seqs[seq.request_id]
+        outputs[seq.request_id] = out
+        return len(emitted)
+
     def step_finalize(self, pending: "PendingStep") -> dict[str, LLMEngineOutput]:
         """Materialize a dispatched step's tokens and apply value-dependent
         effects: append tokens, commit full blocks (hash chain), evaluate
         stop conditions, assemble per-request outputs."""
         outputs: dict[str, LLMEngineOutput] = {}
         for kind, rows, sample_rows, toks_dev, lps_dev in pending.batches:
+            if kind == "verify":
+                self._finalize_verify(rows, sample_rows, toks_dev, lps_dev,
+                                      outputs)
+                continue
             n = len(rows)
             # Normalize to [n, W]: single-step dispatches return [B], fused
             # decode windows [B, W] — one finalize path serves both.
@@ -787,35 +968,39 @@ class EngineCore:
                 # Append window tokens until a stop fires; the rest of the
                 # window is discarded (its KV lives in blocks this seq owns,
                 # freed at finish).
-                emitted: list[int] = []
-                reason = None
-                for j in range(width):
-                    token = int(toks[i, j])
-                    seq.tokens.append(token)
-                    seq.block_seq.append(token)
-                    emitted.append(token)
-                    reason = self._check_stop(seq, token)
-                    if reason is not None:
-                        break
-                if kind == "decode":
-                    self.metrics.num_decode_tokens += len(emitted)
-                self.sched.commit_computed_blocks(seq)
-                if seq.prefix_hit_blocks:
-                    self.metrics.prefix_hit_blocks += seq.prefix_hit_blocks
-                    seq.prefix_hit_blocks = 0
-                per_tok = [float(x) for x in lps[i, : len(emitted)]]
-                out = LLMEngineOutput(
-                    token_ids=emitted,
-                    cum_log_probs=sum(per_tok),
-                    log_probs=per_tok,
-                )
-                if reason is not None:
-                    out.finish_reason = reason
-                    self.sched.finish(seq, reason)
-                    self.metrics.num_requests_finished += 1
-                    del self._seqs[seq.request_id]
-                outputs[seq.request_id] = out
+                self._emit_and_finish(
+                    seq, [int(x) for x in toks[i]], lps[i], outputs,
+                    count_decode=(kind == "decode"))
         return outputs
+
+    def _finalize_verify(self, rows, chunks, toks_dev, lps_dev,
+                         outputs: dict[str, LLMEngineOutput]) -> None:
+        """Accept/rollback a speculative verify step (engine/spec.py).
+
+        Position j's argmax is on the true greedy path iff every earlier
+        proposal matched; accepted tokens append exactly as decode tokens
+        would have, the rest of the chunk rolls back (its KV is stale but
+        unreachable — later true tokens overwrite those positions)."""
+        from dynamo_tpu.engine.spec import accept
+
+        n = len(rows)
+        toks = np.asarray(toks_dev)[:n]
+        lps = np.asarray(lps_dev)[:n]
+        for i, (seq, start, length) in enumerate(rows):
+            seq.verify_inflight = False
+            if seq.phase is Phase.FINISHED:
+                continue  # finished (abort) while in flight: discard
+            seq.inflight_samples -= 1
+            emitted_all = accept(chunks[i], [int(x) for x in toks[i, :length]])
+            # Untouched-state check: a preemption while in flight reset
+            # num_computed — leave its bookkeeping alone, discard the step.
+            in_flight_intact = seq.num_computed == start + length
+            n_emitted = self._emit_and_finish(
+                seq, emitted_all, lps[i], outputs, count_decode=True)
+            if in_flight_intact:
+                # keep KV only for positions whose inputs were true tokens
+                seq.num_computed = start + n_emitted
+            self.metrics.spec_accepted += max(n_emitted - 1, 0)
 
     def step(self) -> dict[str, LLMEngineOutput]:
         """Run one engine step synchronously; returns per-request deltas."""

@@ -57,6 +57,9 @@ class Seq:
     # not enough: with step N in flight, step N-1's finalize must not make
     # step N+1's dispatch read the (not yet appended) host token.
     inflight_samples: int = 0
+    # A speculative verify step is in flight: the scheduler must not plan
+    # this seq again until finalize accepts/rolls back (engine/spec.py).
+    verify_inflight: bool = False
 
     def __post_init__(self) -> None:
         self.tokens = list(self.req.token_ids)
@@ -89,6 +92,12 @@ class Seq:
         return -(-upto_tokens // self.block_size)  # ceil div
 
 
+def _spec_eligible(seq: "Seq") -> bool:
+    from dynamo_tpu.engine.spec import greedy_eligible
+
+    return greedy_eligible(seq.req.sampling_options)
+
+
 @dataclass
 class PrefillWork:
     seq: Seq
@@ -118,6 +127,7 @@ class Scheduler:
         max_model_len: int,
         max_tokens_per_step: int = 8192,
         decode_window: int = 1,
+        spec_lookahead: int = 0,
     ):
         self.pool = pool
         self.max_batch_size = max_batch_size
@@ -125,6 +135,9 @@ class Scheduler:
         self.max_model_len = max_model_len
         self.max_tokens_per_step = max_tokens_per_step
         self.decode_window = max(decode_window, 1)
+        # Speculative verify chunks write KV for up to spec_k proposed
+        # positions ahead — block growth must cover them (engine/spec.py).
+        self.spec_lookahead = spec_lookahead
         self.waiting: deque[Seq] = deque()
         self.running: list[Seq] = []
         self._slot_free: list[int] = list(range(max_batch_size - 1, -1, -1))
@@ -262,12 +275,24 @@ class Scheduler:
         for seq in list(self.running):
             if not seq.in_decode:
                 continue
+            if seq.verify_inflight:
+                # A dispatched-but-unfinalized verify step owns this seq's
+                # next positions; replanning it before the accept/rollback
+                # lands would read garbage state.
+                continue
+            if self.spec_lookahead and _spec_eligible(seq):
+                # Only verify-eligible seqs reserve lookahead blocks —
+                # sampled/penalized seqs never speculate, and over-reserving
+                # for them would trigger preemptions for capacity nobody uses.
+                grow_ahead = max(w, 1 + self.spec_lookahead)
+            else:
+                grow_ahead = w
             if seq.num_computed >= self.max_model_len:
                 # At capacity: the finalize of an in-flight step will finish
                 # this seq (pipelined stepping plans ahead of stop checks);
                 # decoding past max_model_len would outgrow the block table.
                 continue
-            while not self._grow_for_decode(seq, w):
+            while not self._grow_for_decode(seq, grow_ahead):
                 # preempt the most recently admitted other seq
                 victims = [s for s in reversed(self.running) if s is not seq]
                 if not victims:

@@ -729,28 +729,35 @@ class HttpService:
         finally:
             # Deterministic teardown: close the generation stream NOW (not at
             # GC) so a disconnect-abort reaches the engine/worker while this
-            # request's slot is still the thing being freed. Guarded — a
-            # teardown failure (or a generate impl without aclose) must not
-            # swallow the metric/audit lines below.
+            # request's slot is still the thing being freed. The bookkeeping
+            # below lives in a nested finally: teardown awaits the data
+            # plane, so a CancelledError landing there (the disconnect path
+            # itself!) must not skip the metric/audit lines.
             try:
                 aclose = getattr(stream, "aclose", None)
                 if aclose is not None:
                     await aclose()
+            except asyncio.CancelledError:
+                # handler is already terminating; the request's terminal
+                # state is recorded below either way
+                log.info("stream teardown cancelled for %s", pre.request_id)
             except Exception:  # noqa: BLE001
                 log.exception("generation stream teardown failed for %s",
                               pre.request_id)
-            self._output_tokens.inc(ntokens, model=req.model)
-            if chat and self._audit.bus() is not None:
-                # From finally so disconnects and engine errors are audited
-                # too — a compliance log that misses exactly the anomalous
-                # streams would be worthless. Streamed text is accumulated
-                # (the reference captures the full response the same way).
-                self._audit.publish(self._audit.AuditRecord(
-                    request_id=pre.request_id, model=req.model,
-                    requested_streaming=True,
-                    request=req.model_dump(exclude_none=True),
-                    response={"content": "".join(audit_text),
-                              "tool_calls": audit_tool_calls or None,
-                              "completion_tokens": gen.completion_tokens},
-                    error=audit_error))
+            finally:
+                self._output_tokens.inc(ntokens, model=req.model)
+                if chat and self._audit.bus() is not None:
+                    # From finally so disconnects and engine errors are
+                    # audited too — a compliance log that misses exactly the
+                    # anomalous streams would be worthless. Streamed text is
+                    # accumulated (the reference captures the full response
+                    # the same way).
+                    self._audit.publish(self._audit.AuditRecord(
+                        request_id=pre.request_id, model=req.model,
+                        requested_streaming=True,
+                        request=req.model_dump(exclude_none=True),
+                        response={"content": "".join(audit_text),
+                                  "tool_calls": audit_tool_calls or None,
+                                  "completion_tokens": gen.completion_tokens},
+                        error=audit_error))
         return resp

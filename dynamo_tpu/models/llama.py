@@ -251,8 +251,11 @@ def forward(
     moe_impl: str = "dense",
     mesh=None,
     sp_prefill: bool = False,
+    return_all_hidden: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """One engine step. Returns (last_hidden [B,H], cache_k, cache_v).
+    """One engine step. Returns (last_hidden [B,H], cache_k, cache_v) —
+    or (hidden [B,T,H], ...) with ``return_all_hidden`` (the speculative
+    verify step needs logits at every chunk position).
 
     Query token t of sequence b sits at position q_start[b]+t; its KV is
     written into the cache slot named by the block table; attention sees all
@@ -368,6 +371,8 @@ def forward(
     h, (cache_k, cache_v) = lax.scan(layer_fn, h, (params["layers"], cache_k, cache_v))
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
 
+    if return_all_hidden:
+        return h, cache_k, cache_v                                 # [B, T, H]
     # Hidden state at each sequence's last valid query token.
     last_idx = jnp.clip(q_len - 1, 0, t - 1)                       # [B]
     last_h = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [B, H]

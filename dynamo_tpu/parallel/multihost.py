@@ -334,6 +334,9 @@ _HELLO_FIELDS = (
     # is deliberately NOT here — a shared remote store cannot guarantee
     # rank-identical hit/miss results, so EngineCore refuses it multi-host.
     "host_kv_blocks", "disk_kv_path", "disk_kv_bytes",
+    # Speculative decoding partitions decode batches into verify/plain rows
+    # — a proposal mismatch across ranks would desync dispatch shapes.
+    "spec_ngram", "spec_k",
 )
 
 

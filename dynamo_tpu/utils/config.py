@@ -114,6 +114,11 @@ class EngineConfig:
     # G4 remote block store ("host:port" of a RemoteBlockServer); chained
     # after host/disk in the offload cascade.
     remote_kv_addr: str | None = None
+    # N-gram speculative decoding (engine/spec.py): 0 = off; n>0 proposes
+    # continuations of the trailing n-gram, verified k at a time in one
+    # forward pass. Greedy-exact; mutually exclusive with decode_window>1.
+    spec_ngram: int = 0
+    spec_k: int = 4
     seed: int = 0
     # A checkpoint PATH without loadable weights fails engine construction
     # unless this is set — a typo'd path must not silently serve garbage.

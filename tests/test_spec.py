@@ -1,0 +1,150 @@
+"""N-gram speculative decoding (engine/spec.py + the engine's verify
+batches): proposer/acceptance units, greedy bit-exactness against plain
+decode (sync AND pipelined loops), sampled-request exclusion, stop
+handling mid-acceptance, and acceptance actually firing on repetitive
+output.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from dynamo_tpu.engine.engine import AsyncJaxEngine, EngineCore
+from dynamo_tpu.engine.spec import accept, propose
+from dynamo_tpu.utils.config import EngineConfig
+
+from tests.test_engine import make_req, run_to_completion, tiny_config
+
+
+# -- units -------------------------------------------------------------------
+
+def test_propose_matches_most_recent_ngram():
+    #          0  1  2  3  4  5  6  7
+    tokens = [1, 2, 3, 9, 1, 2, 3, 5]
+    # trailing 2-gram negative? trailing [3, 5] — no earlier occurrence
+    assert propose(tokens, 2, 4) == []
+    tokens = [1, 2, 3, 9, 1, 2]          # trailing [1, 2] matches pos 0
+    assert propose(tokens, 2, 4) == [3, 9, 1, 2][:4]
+    # most RECENT match wins
+    tokens = [7, 8, 1, 7, 8, 2, 7, 8]
+    assert propose(tokens, 2, 2) == [2, 7]
+    # k caps the continuation
+    assert propose([1, 2, 3, 1, 2], 2, 1) == [3]
+    # degenerate inputs
+    assert propose([1, 2], 2, 4) == []
+    assert propose([1, 2, 3], 0, 4) == []
+    assert propose([1, 2, 3], 2, 0) == []
+
+
+def test_accept_walk():
+    # chunk = [cur, p1, p2, p3]; argmax_out per position
+    assert accept([5, 10, 11, 12], [10, 11, 12, 13]) == [10, 11, 12, 13]
+    assert accept([5, 10, 99, 12], [10, 11, 12, 13]) == [10, 11]  # p2 wrong
+    assert accept([5, 99], [10, 11]) == [10]                      # p1 wrong
+    assert accept([5], [10]) == [10]                              # no proposals
+
+
+# -- engine equivalence ------------------------------------------------------
+
+def spec_config(**kw) -> EngineConfig:
+    return tiny_config(spec_ngram=2, spec_k=4, **kw)
+
+
+@pytest.mark.parametrize("prompt", [
+    # repetitive: proposals hit (tiny random-weight models loop anyway)
+    [5, 6, 7, 8, 5, 6, 7, 8, 5, 6],
+    # non-repetitive: most proposals miss
+    list(range(40, 57)),
+])
+def test_spec_greedy_stream_bit_identical(prompt):
+    plain, _ = run_to_completion(EngineCore(tiny_config()), [
+        make_req(prompt=prompt, max_tokens=24, rid="r")])
+    spec_core = EngineCore(spec_config())
+    spec, _ = run_to_completion(spec_core, [
+        make_req(prompt=prompt, max_tokens=24, rid="r")])
+    assert spec["r"] == plain["r"]
+    assert spec_core.metrics.spec_proposed > 0
+
+
+def test_spec_acceptance_fires_on_repetition():
+    """Tiny random-weight greedy decode loops; the proposer must convert
+    that into accepted multi-token steps (fewer engine steps than tokens)."""
+    core = EngineCore(spec_config())
+    out, _ = run_to_completion(core, [
+        make_req(prompt=[5, 6, 7, 8, 5, 6, 7, 8, 5, 6], max_tokens=32, rid="r")])
+    assert len(out["r"]) == 32
+    assert core.metrics.spec_accepted > 0, core.metrics
+    # accepted tokens rode verify steps: strictly fewer steps than a
+    # step-per-token engine would need
+    assert core.metrics.num_steps < 32 + 4  # prefill + decode/verify steps
+
+
+def test_spec_skips_sampled_and_penalized_requests():
+    core = EngineCore(spec_config())
+    out, _ = run_to_completion(core, [
+        make_req(prompt=[5, 6, 5, 6, 5], max_tokens=12, rid="s",
+                 temperature=0.9, seed=7),
+        make_req(prompt=[9, 10, 9, 10, 9], max_tokens=12, rid="p",
+                 repetition_penalty=1.3),
+    ])
+    assert core.metrics.spec_proposed == 0
+    assert len(out["s"]) == 12 and len(out["p"]) == 12
+
+    # the same sampled request produces the same stream as a spec-free core
+    plain, _ = run_to_completion(EngineCore(tiny_config()), [
+        make_req(prompt=[5, 6, 5, 6, 5], max_tokens=12, rid="s",
+                 temperature=0.9, seed=7)])
+    spec, _ = run_to_completion(EngineCore(spec_config()), [
+        make_req(prompt=[5, 6, 5, 6, 5], max_tokens=12, rid="s",
+                 temperature=0.9, seed=7)])
+    assert spec["s"] == plain["s"]
+
+
+def test_spec_mixed_batch_matches_plain():
+    """Greedy seqs verify while a sampled sibling decodes normally — every
+    stream identical to the spec-free engine."""
+    reqs = lambda: [  # noqa: E731
+        make_req(prompt=[5, 6, 7, 5, 6, 7, 5, 6], max_tokens=16, rid="g"),
+        make_req(prompt=list(range(70, 82)), max_tokens=16, rid="s",
+                 temperature=0.8, seed=3),
+    ]
+    plain, _ = run_to_completion(EngineCore(tiny_config()), reqs())
+    spec, _ = run_to_completion(EngineCore(spec_config()), reqs())
+    assert spec == plain
+
+
+def test_spec_max_tokens_exact_mid_acceptance():
+    """A stop firing inside an accepted run truncates exactly at budget."""
+    core = EngineCore(spec_config())
+    out, _ = run_to_completion(core, [
+        make_req(prompt=[5, 6, 5, 6, 5, 6], max_tokens=7, rid="r")])
+    assert len(out["r"]) == 7
+
+
+async def test_spec_pipelined_engine_matches_sync():
+    """The production AsyncJaxEngine loop (overlapped step_begin/finalize)
+    over a spec engine emits the sync engine's exact streams."""
+    sync, _ = run_to_completion(EngineCore(spec_config()), [
+        make_req(prompt=[5, 6, 7, 8, 5, 6, 7, 8], max_tokens=20, rid="a"),
+        make_req(prompt=[11, 12, 11, 12, 11], max_tokens=15, rid="b"),
+    ])
+    engine = AsyncJaxEngine(EngineCore(spec_config()))
+
+    async def one(rid, prompt, n):
+        req = make_req(prompt=prompt, max_tokens=n, rid=rid)
+        toks = []
+        async for out in engine.generate(req):
+            toks.extend(out.token_ids)
+        return toks
+
+    import asyncio
+
+    a, b = await asyncio.gather(
+        one("a", [5, 6, 7, 8, 5, 6, 7, 8], 20),
+        one("b", [11, 12, 11, 12, 11], 15))
+    await engine.shutdown()
+    assert a == sync["a"]
+    assert b == sync["b"]
+    # the overlapped loop must actually ENGAGE the verify path (pause-then-
+    # verify entry), not silently degrade to plain pipelined decode
+    assert engine.core.metrics.spec_accepted > 0, engine.core.metrics
