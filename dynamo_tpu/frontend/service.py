@@ -410,14 +410,19 @@ class HttpService:
     # ------------------------------------------------------------------
     @staticmethod
     def _make_jail(entry: ModelEntry, req):
-        """Per-request StreamJail when the model has parsers configured
-        (tool jail only engages when the request actually sent tools)."""
+        """Per-request StreamJail when the model has parsers configured.
+        The tool jail normally engages only when the request sent tools —
+        EXCEPT for structural formats (harmony), whose channel framing the
+        model emits regardless; without the parser, raw protocol markers
+        would leak into user-visible content."""
         tool_cfg = None
         reasoning = None
-        if entry.tool_parser and getattr(req, "tools", None):
+        if entry.tool_parser:
             from dynamo_tpu.parsers import get_tool_parser
 
-            tool_cfg = get_tool_parser(entry.tool_parser)
+            cfg = get_tool_parser(entry.tool_parser)
+            if getattr(req, "tools", None) or cfg.format == "harmony":
+                tool_cfg = cfg
         if entry.reasoning_parser:
             from dynamo_tpu.parsers import get_reasoning_parser
 

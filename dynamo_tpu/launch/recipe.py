@@ -128,6 +128,11 @@ def build_plan(doc: dict, engine_override: str | None = None,
         if w.get("servedModelName") or spec.get("servedModelName"):
             args += ["--served-model-name",
                      w.get("servedModelName") or spec["servedModelName"]]
+        parsers = w.get("parsers", spec.get("parsers", {}))
+        if parsers.get("toolCall"):
+            args += ["--tool-call-parser", parsers["toolCall"]]
+        if parsers.get("reasoning"):
+            args += ["--reasoning-parser", parsers["reasoning"]]
         role = w.get("role", "none")
         if role in ("prefill", "decode"):
             args += ["--disagg", role]

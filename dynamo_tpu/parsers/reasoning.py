@@ -31,9 +31,14 @@ class ReasoningConfig:
     close_token: str = "</think>"
     # Model is already "thinking" at generation start (no open marker emitted).
     force_reasoning: bool = False
+    # Structural markers DROPPED from normal text (harmony channel headers:
+    # they are protocol framing, not content). Withheld while a partial
+    # match could still grow, like the open/close markers.
+    strip_tokens: tuple[str, ...] = ()
 
 
-# Same registry names as the reference (reasoning/mod.rs:18-31).
+# Same registry names as the reference (reasoning/mod.rs:18-31; gpt_oss:
+# reasoning/gpt_oss_parser.rs — the harmony channel structure).
 REASONING_PARSERS: dict[str, ReasoningConfig] = {
     "basic": ReasoningConfig(),
     "deepseek_r1": ReasoningConfig(force_reasoning=True),
@@ -45,6 +50,21 @@ REASONING_PARSERS: dict[str, ReasoningConfig] = {
     "granite": ReasoningConfig(
         open_token="Here is my thought process:",
         close_token="Here is my response:"),
+    # gpt-oss harmony: the analysis channel is reasoning; final-channel
+    # headers and message terminators are framing to strip. Commentary
+    # channels pass through untouched — the harmony TOOL parser owns them.
+    "gpt_oss": ReasoningConfig(
+        open_token="<|channel|>analysis<|message|>",
+        close_token="<|end|>",
+        # NOTE: "<|end|>" is NOT stripped here — it terminates commentary
+        # preambles, which the harmony TOOL parser owns (it needs to see
+        # the terminator to release preamble text mid-stream).
+        strip_tokens=(
+            "<|start|>assistant<|channel|>final<|message|>",
+            "<|channel|>final<|message|>",
+            "<|start|>assistant",
+            "<|return|>",
+        )),
 }
 
 
@@ -92,20 +112,40 @@ class ReasoningParser:
         normal: list[str] = []
         reasoning: list[str] = []
         while text:
-            marker = self.cfg.close_token if self.in_reasoning else self.cfg.open_token
-            sink = reasoning if self.in_reasoning else normal
-            i = text.find(marker)
-            if i >= 0:
-                sink.append(text[:i])
-                text = text[i + len(marker):]
-                self.in_reasoning = not self.in_reasoning
+            if self.in_reasoning:
+                marker = self.cfg.close_token
+                i = text.find(marker)
+                if i >= 0:
+                    reasoning.append(text[:i])
+                    text = text[i + len(marker):]
+                    self.in_reasoning = False
+                    continue
+                k = _partial_suffix(text, marker)
+                if k:
+                    reasoning.append(text[:-k])
+                    self._buf = text[-k:]
+                else:
+                    reasoning.append(text)
+                break
+            # normal mode: the earliest of the open marker or any strip
+            # marker wins (longest match on a tie, so a more specific
+            # header beats its own prefix)
+            tokens = (self.cfg.open_token, *self.cfg.strip_tokens)
+            hits = sorted(
+                ((i, -len(t), t) for t in tokens if (i := text.find(t)) >= 0))
+            if hits:
+                i, _, tok = hits[0]
+                normal.append(text[:i])
+                text = text[i + len(tok):]
+                if tok == self.cfg.open_token:
+                    self.in_reasoning = True
                 continue
-            k = _partial_suffix(text, marker)
+            k = longest_partial_suffix(text, tokens)
             if k:
-                sink.append(text[:-k])
+                normal.append(text[:-k])
                 self._buf = text[-k:]
             else:
-                sink.append(text)
+                normal.append(text)
             break
         return ParserResult("".join(normal), "".join(reasoning))
 

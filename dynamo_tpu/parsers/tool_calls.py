@@ -83,6 +83,13 @@ TOOL_PARSERS: dict[str, ToolCallConfig] = {
         start_tokens=("<｜tool▁calls▁begin｜>",),
         end_tokens=("<｜tool▁calls▁end｜>",)),
     "pythonic": ToolCallConfig(format="pythonic"),
+    # gpt-oss harmony (reference: lib/parsers/src/tool_calling/harmony/):
+    # commentary channels addressed to functions.NAME carry one JSON body
+    # terminated by <|call|>. Pair with reasoning parser "gpt_oss", which
+    # owns the analysis channel and strips final-channel framing.
+    "harmony": ToolCallConfig(
+        start_tokens=("<|channel|>commentary",), end_tokens=("<|call|>",),
+        format="harmony"),
     "default": ToolCallConfig(
         start_tokens=("<TOOLCALL>", "<|python_tag|>"), end_tokens=("</TOOLCALL>", ""),
         bare_json=True),
@@ -172,6 +179,15 @@ def find_call_end(text: str, start: int, cfg: ToolCallConfig) -> int:
     if cfg.format == "pythonic":
         m = _PYTHONIC_RE.match(text, start)
         return _balanced_end(text, start) if m else -1
+    if cfg.format == "harmony":
+        # a commentary segment ends at <|call|> (tool call) OR <|end|>
+        # (user-visible preamble) — whichever comes first
+        ends = [(j, t) for t in ("<|call|>", "<|end|>")
+                if (j := text.find(t, start)) >= 0]
+        if not ends:
+            return -1
+        j, tok = min(ends)
+        return j + len(tok)
     for s_tok, e_tok in zip(cfg.start_tokens, cfg.end_tokens):
         if not text.startswith(s_tok, start):
             continue
@@ -269,6 +285,45 @@ def _parse_pythonic(text: str) -> tuple[list[ToolCall], str | None]:
     return calls, normal or None
 
 
+# Commentary header: optional "to=functions.NAME" (a call) — absent on
+# user-visible preambles — and optional "<|constrain|>json".
+_HARMONY_HEADER_RE = re.compile(
+    r"<\|channel\|>commentary(?:\s+to=(?:functions\.)?([\w.-]+))?\s*"
+    r"(?:<\|constrain\|>\w+)?\s*<\|message\|>")
+
+
+def _parse_harmony(text: str) -> tuple[list[ToolCall], str | None]:
+    """Harmony commentary channels: ``to=functions.X`` segments become tool
+    calls; segments without ``to=`` are user-visible preambles (framing
+    stripped, body kept). Segments terminate at <|call|> or <|end|>. Other
+    text passes through — the gpt_oss reasoning parser already consumed the
+    analysis channel and final-channel framing upstream."""
+    calls: list[ToolCall] = []
+    normal_parts: list[str] = []
+    pos = 0
+    while pos < len(text):
+        m = _HARMONY_HEADER_RE.search(text, pos)
+        if not m:
+            normal_parts.append(text[pos:])
+            break
+        normal_parts.append(text[pos:m.start()])
+        ends = [j for t in ("<|call|>", "<|end|>")
+                if (j := text.find(t, m.end())) >= 0]
+        end = min(ends) if ends else len(text)
+        body = text[m.end():end].strip()
+        name = m.group(1)
+        if name:
+            calls.append(ToolCall(name=name, arguments=body or "{}"))
+        elif body:
+            normal_parts.append(body)
+        if end >= len(text):
+            break
+        pos = end + (len("<|call|>") if text.startswith("<|call|>", end)
+                     else len("<|end|>"))
+    normal = "".join(normal_parts).strip()
+    return calls, (normal or None)
+
+
 def parse_tool_calls(text: str, cfg: ToolCallConfig) -> tuple[list[ToolCall], str | None]:
     """Parse every tool call in a complete message.
 
@@ -278,6 +333,8 @@ def parse_tool_calls(text: str, cfg: ToolCallConfig) -> tuple[list[ToolCall], st
     """
     if cfg.format == "pythonic":
         return _parse_pythonic(text)
+    if cfg.format == "harmony":
+        return _parse_harmony(text)
 
     calls: list[ToolCall] = []
     normal_parts: list[str] = []

@@ -293,3 +293,149 @@ def test_jail_bare_json_with_leading_whitespace():
         jail, ['\n{"name": "search", "arguments": {"q": "x"}}'])
     assert [c.name for c in calls] == ["search"]
     assert content.strip() == ""
+
+
+# ---------------------------------------------------------------------------
+# Harmony (gpt-oss) — reference: lib/parsers/src/tool_calling/harmony/,
+# reasoning/gpt_oss_parser.rs
+# ---------------------------------------------------------------------------
+
+def test_harmony_tool_call_parse():
+    from dynamo_tpu.parsers.tool_calls import get_tool_parser, parse_tool_calls
+
+    cfg = get_tool_parser("harmony")
+    text = ('<|channel|>commentary to=functions.get_weather '
+            '<|constrain|>json<|message|>{"location": "Tokyo"}<|call|>')
+    calls, normal = parse_tool_calls(text, cfg)
+    assert len(calls) == 1
+    assert calls[0].name == "get_weather"
+    assert '"Tokyo"' in calls[0].arguments
+    assert normal is None
+
+    # two calls + surrounding text; bare to= (no functions. prefix)
+    text = ('before <|channel|>commentary to=lookup <|message|>{"q":1}<|call|>'
+            '<|channel|>commentary to=functions.save <|message|>{"v":2}<|call|> after')
+    calls, normal = parse_tool_calls(text, cfg)
+    assert [c.name for c in calls] == ["lookup", "save"]
+    assert normal == "before  after"
+
+    # commentary preamble without to= is user-visible content, frame stripped
+    text = "<|channel|>commentary<|message|>let me check that<|call|>"
+    calls, normal = parse_tool_calls(text, cfg)
+    assert calls == [] and normal == "let me check that"
+
+
+def test_gpt_oss_reasoning_channels():
+    from dynamo_tpu.parsers.reasoning import (
+        REASONING_PARSERS,
+        ReasoningParser,
+    )
+
+    cfg = REASONING_PARSERS["gpt_oss"]
+    text = ("<|channel|>analysis<|message|>user wants weather<|end|>"
+            "<|start|>assistant<|channel|>final<|message|>It is sunny.<|return|>")
+    res = ReasoningParser.parse_complete(text, cfg)
+    assert res.reasoning_text == "user wants weather"
+    assert res.normal_text == "It is sunny."
+
+
+def test_gpt_oss_reasoning_streaming_partial_markers():
+    from dynamo_tpu.parsers.reasoning import REASONING_PARSERS, ReasoningParser
+
+    p = ReasoningParser(REASONING_PARSERS["gpt_oss"])
+    text = ("<|channel|>analysis<|message|>thinking...<|end|>"
+            "<|channel|>final<|message|>done<|return|>")
+    normal = reasoning = ""
+    for i in range(0, len(text), 3):  # 3-char deltas split every marker
+        r = p.step(text[i:i + 3])
+        normal += r.normal_text
+        reasoning += r.reasoning_text
+    r = p.finish()
+    normal += r.normal_text
+    reasoning += r.reasoning_text
+    assert reasoning == "thinking..."
+    assert normal == "done"
+
+
+def test_harmony_full_jail_pipeline():
+    """analysis → reasoning, final → content, commentary → tool call, all
+    through the streaming jail (the production chat path)."""
+    from dynamo_tpu.parsers import StreamJail, get_reasoning_parser, get_tool_parser
+
+    jail = StreamJail(tool_cfg=get_tool_parser("harmony"),
+                      reasoning=get_reasoning_parser("gpt_oss"))
+    text = ("<|channel|>analysis<|message|>need the weather tool<|end|>"
+            '<|channel|>commentary to=functions.get_weather '
+            '<|constrain|>json<|message|>{"city": "Paris"}<|call|>'
+            "<|channel|>final<|message|>Checking!<|return|>")
+    content = reasoning = ""
+    for i in range(0, len(text), 5):
+        d = jail.feed(text[i:i + 5])
+        content += d.content
+        reasoning += d.reasoning
+    fin = jail.finish()
+    content += fin.content
+    reasoning += fin.reasoning
+    calls = jail.tool_calls  # accumulates mid-stream AND finish-parsed calls
+    assert reasoning == "need the weather tool"
+    assert len(calls) == 1 and calls[0].name == "get_weather"
+    assert '"Paris"' in calls[0].arguments
+    assert content.strip() == "Checking!"
+
+
+def test_harmony_preamble_before_call_keeps_framing_out():
+    """A user-visible preamble BEFORE a call: framing stripped from the
+    inter-call segment too, preamble text kept."""
+    from dynamo_tpu.parsers.tool_calls import get_tool_parser, parse_tool_calls
+
+    cfg = get_tool_parser("harmony")
+    text = ("<|channel|>commentary<|message|>I will check the weather.<|end|>"
+            '<|channel|>commentary to=functions.get_weather '
+            '<|message|>{"city":"Paris"}<|call|>')
+    calls, normal = parse_tool_calls(text, cfg)
+    assert [c.name for c in calls] == ["get_weather"]
+    assert normal == "I will check the weather."
+    assert "<|" not in (normal or "")
+
+
+def test_harmony_preamble_streams_without_tool_call():
+    """A commentary preamble terminated by <|end|> must be RELEASED during
+    the stream (not buffered to finish): the harmony jail treats <|end|>
+    as a segment terminator."""
+    from dynamo_tpu.parsers import StreamJail, get_reasoning_parser, get_tool_parser
+
+    jail = StreamJail(tool_cfg=get_tool_parser("harmony"),
+                      reasoning=get_reasoning_parser("gpt_oss"))
+    text = ("<|channel|>analysis<|message|>thinking<|end|>"
+            "<|channel|>commentary<|message|>Let me check that.<|end|>"
+            "<|channel|>final<|message|>It is sunny.<|return|>")
+    released_before_finish = ""
+    for i in range(0, len(text), 5):
+        released_before_finish += jail.feed(text[i:i + 5]).content
+    fin = jail.finish()
+    total = released_before_finish + fin.content
+    assert "Let me check that." in total
+    assert "It is sunny." in total
+    assert "<|" not in total
+    # the preamble was released before stream end, not hoarded by the jail
+    assert "Let me check that." in released_before_finish
+    assert jail.tool_calls == []
+
+
+def test_harmony_jail_active_without_request_tools():
+    """Tools-free request against a harmony model: channel framing must
+    still be parsed out of content (the model emits it regardless)."""
+    from dynamo_tpu.frontend.model_manager import ModelManager
+    from dynamo_tpu.frontend.service import HttpService
+    from dynamo_tpu.preprocessor.preprocessor import ModelDefaults
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.tokenizer import ByteTokenizer
+
+    models = ModelManager()
+    models.register("m", ByteTokenizer(), None, defaults=ModelDefaults(),
+                    tool_parser="harmony", reasoning_parser="gpt_oss")
+    entry = models.get("m")
+    req = ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "hi"}])
+    jail = HttpService._make_jail(entry, req)
+    assert jail is not None and jail.tool_cfg is not None
