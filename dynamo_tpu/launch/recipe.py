@@ -128,7 +128,7 @@ def build_plan(doc: dict, engine_override: str | None = None,
         if w.get("servedModelName") or spec.get("servedModelName"):
             args += ["--served-model-name",
                      w.get("servedModelName") or spec["servedModelName"]]
-        parsers = w.get("parsers", spec.get("parsers", {}))
+        parsers = w.get("parsers") or spec.get("parsers") or {}
         if parsers.get("toolCall"):
             args += ["--tool-call-parser", parsers["toolCall"]]
         if parsers.get("reasoning"):

@@ -28,6 +28,7 @@ from dynamo_tpu.parsers.tool_calls import (
     match_start,
     parse_tool_calls,
     possible_start,
+    strip_framing,
 )
 
 
@@ -98,6 +99,13 @@ class StreamJail:
                 self._call_buf = self._pending[i:]
                 self._pending = ""
                 self._in_call = True
+                continue
+            # stray framing tokens (harmony <|end|> outside a segment) are
+            # dropped, not released; the jail withholds partial matches of
+            # them via possible_start's extended token set
+            stripped = strip_framing(self._pending, self.tool_cfg)
+            if stripped != self._pending:
+                self._pending = stripped
                 continue
             k = possible_start(self._pending, self.tool_cfg)
             if k:

@@ -35,6 +35,13 @@ class ReasoningConfig:
     # they are protocol framing, not content). Withheld while a partial
     # match could still grow, like the open/close markers.
     strip_tokens: tuple[str, ...] = ()
+    # After the open token matches, framing continues up to this terminator
+    # (harmony: '<|channel|>analysis[ to=python][ <|constrain|>..]<|message|>'
+    # — the variable recipient part must be consumed, not emitted).
+    open_header_terminator: str | None = None
+    # Additional reasoning terminators (harmony analysis tool calls end with
+    # '<|call|>' instead of '<|end|>').
+    extra_close_tokens: tuple[str, ...] = ()
 
 
 # Same registry names as the reference (reasoning/mod.rs:18-31; gpt_oss:
@@ -50,15 +57,17 @@ REASONING_PARSERS: dict[str, ReasoningConfig] = {
     "granite": ReasoningConfig(
         open_token="Here is my thought process:",
         close_token="Here is my response:"),
-    # gpt-oss harmony: the analysis channel is reasoning; final-channel
-    # headers and message terminators are framing to strip. Commentary
-    # channels pass through untouched — the harmony TOOL parser owns them.
+    # gpt-oss harmony: the analysis channel (any recipient — 'to=python'
+    # headers included) is reasoning; final-channel headers and message
+    # terminators are framing to strip. Commentary channels pass through
+    # untouched — the harmony TOOL parser owns them (incl. their '<|end|>'
+    # terminators, which is why '<|end|>' is not stripped HERE; the tool
+    # layer strips strays).
     "gpt_oss": ReasoningConfig(
-        open_token="<|channel|>analysis<|message|>",
+        open_token="<|channel|>analysis",
+        open_header_terminator="<|message|>",
         close_token="<|end|>",
-        # NOTE: "<|end|>" is NOT stripped here — it terminates commentary
-        # preambles, which the harmony TOOL parser owns (it needs to see
-        # the terminator to release preamble text mid-stream).
+        extra_close_tokens=("<|call|>",),
         strip_tokens=(
             "<|start|>assistant<|channel|>final<|message|>",
             "<|channel|>final<|message|>",
@@ -91,6 +100,7 @@ class ReasoningParser:
     def __init__(self, cfg: ReasoningConfig):
         self.cfg = cfg
         self.in_reasoning = cfg.force_reasoning
+        self.in_header = False  # consuming open-header framing (harmony)
         self._buf = ""  # withheld partial-marker fragment
 
     # -- one-shot ----------------------------------------------------------
@@ -112,15 +122,30 @@ class ReasoningParser:
         normal: list[str] = []
         reasoning: list[str] = []
         while text:
-            if self.in_reasoning:
-                marker = self.cfg.close_token
-                i = text.find(marker)
+            if self.in_header:
+                # open-header framing: consume (emit nowhere) through the
+                # terminator; withhold a possible partial terminator
+                term = self.cfg.open_header_terminator or ""
+                i = text.find(term)
                 if i >= 0:
+                    text = text[i + len(term):]
+                    self.in_header = False
+                    continue
+                k = _partial_suffix(text, term)
+                self._buf = text[-k:] if k else ""
+                break
+            if self.in_reasoning:
+                closes = (self.cfg.close_token, *self.cfg.extra_close_tokens)
+                hits = sorted(
+                    ((i, -len(t), t) for t in closes
+                     if (i := text.find(t)) >= 0))
+                if hits:
+                    i, _, tok = hits[0]
                     reasoning.append(text[:i])
-                    text = text[i + len(marker):]
+                    text = text[i + len(tok):]
                     self.in_reasoning = False
                     continue
-                k = _partial_suffix(text, marker)
+                k = longest_partial_suffix(text, closes)
                 if k:
                     reasoning.append(text[:-k])
                     self._buf = text[-k:]
@@ -139,6 +164,8 @@ class ReasoningParser:
                 text = text[i + len(tok):]
                 if tok == self.cfg.open_token:
                     self.in_reasoning = True
+                    if self.cfg.open_header_terminator:
+                        self.in_header = True
                 continue
             k = longest_partial_suffix(text, tokens)
             if k:
@@ -151,9 +178,10 @@ class ReasoningParser:
 
     def finish(self) -> ParserResult:
         """Flush the withheld fragment at stream end (an unfinished marker
-        is literal text of whichever side we are on)."""
+        is literal text of whichever side we are on; an unfinished open
+        header is framing — dropped)."""
         buf, self._buf = self._buf, ""
-        if not buf:
+        if not buf or self.in_header:
             return ParserResult()
         if self.in_reasoning:
             return ParserResult(reasoning_text=buf)

@@ -57,6 +57,10 @@ class ToolCallConfig:
     args_keys: tuple[str, ...] = ("arguments", "parameters")
     # Accept a bare JSON object/array at the start of the message (no marker).
     bare_json: bool = False
+    # Protocol framing removed from released normal text (harmony: stray
+    # message terminators outside any channel segment). Withheld while a
+    # partial match could still grow.
+    strip_tokens: tuple[str, ...] = ()
 
     def __post_init__(self):
         if len(self.start_tokens) != len(self.end_tokens):
@@ -89,7 +93,10 @@ TOOL_PARSERS: dict[str, ToolCallConfig] = {
     # owns the analysis channel and strips final-channel framing.
     "harmony": ToolCallConfig(
         start_tokens=("<|channel|>commentary",), end_tokens=("<|call|>",),
-        format="harmony"),
+        format="harmony",
+        # a final-channel message may terminate with <|end|> outside any
+        # commentary segment — framing, never content
+        strip_tokens=("<|end|>", "<|return|>")),
     "default": ToolCallConfig(
         start_tokens=("<TOOLCALL>", "<|python_tag|>"), end_tokens=("</TOOLCALL>", ""),
         bare_json=True),
@@ -132,11 +139,19 @@ def match_start(text: str, cfg: ToolCallConfig) -> int:
     return best
 
 
+def strip_framing(text: str, cfg: ToolCallConfig) -> str:
+    """Remove stray protocol framing tokens from normal text."""
+    for t in cfg.strip_tokens:
+        if t:
+            text = text.replace(t, "")
+    return text
+
+
 def possible_start(text: str, cfg: ToolCallConfig) -> int:
     """Length of the trailing fragment of ``text`` that could be the prefix
-    of a start marker (0 = tail is definitely normal text). The jail
-    withholds exactly this suffix."""
-    longest = longest_partial_suffix(text, cfg.start_tokens)
+    of a start marker OR of a strip token (0 = tail is definitely normal
+    text). The jail withholds exactly this suffix."""
+    longest = longest_partial_suffix(text, cfg.start_tokens + cfg.strip_tokens)
     if cfg.format == "pythonic":
         # "[", "[get", "[ get_weather " ... can still become "[name(" —
         # find the earliest such viable tail.
@@ -180,14 +195,8 @@ def find_call_end(text: str, start: int, cfg: ToolCallConfig) -> int:
         m = _PYTHONIC_RE.match(text, start)
         return _balanced_end(text, start) if m else -1
     if cfg.format == "harmony":
-        # a commentary segment ends at <|call|> (tool call) OR <|end|>
-        # (user-visible preamble) — whichever comes first
-        ends = [(j, t) for t in ("<|call|>", "<|end|>")
-                if (j := text.find(t, start)) >= 0]
-        if not ends:
-            return -1
-        j, tok = min(ends)
-        return j + len(tok)
+        end, tok = _harmony_segment_end(text, start)
+        return -1 if end < 0 else end + len(tok)
     for s_tok, e_tok in zip(cfg.start_tokens, cfg.end_tokens):
         if not text.startswith(s_tok, start):
             continue
@@ -285,19 +294,34 @@ def _parse_pythonic(text: str) -> tuple[list[ToolCall], str | None]:
     return calls, normal or None
 
 
-# Commentary header: optional "to=functions.NAME" (a call) — absent on
-# user-visible preambles — and optional "<|constrain|>json".
+# Commentary header: optional "to=RECIPIENT" — functions.* recipients are
+# client tool calls; other recipients (python, browser.*) are builtin-tool
+# traffic; absent = a user-visible preamble. Optional "<|constrain|>json".
 _HARMONY_HEADER_RE = re.compile(
-    r"<\|channel\|>commentary(?:\s+to=(?:functions\.)?([\w.-]+))?\s*"
+    r"<\|channel\|>commentary(?:\s+to=([\w.-]+))?\s*"
     r"(?:<\|constrain\|>\w+)?\s*<\|message\|>")
+
+_HARMONY_TERMINATORS = ("<|call|>", "<|end|>")
+
+
+def _harmony_segment_end(text: str, start: int) -> tuple[int, str]:
+    """(index, token) of the earliest segment terminator at/after ``start``;
+    (-1, "") if none — ONE copy of the scan, used by both the streaming
+    jail (find_call_end) and the complete parser."""
+    ends = [(j, t) for t in _HARMONY_TERMINATORS
+            if (j := text.find(t, start)) >= 0]
+    return min(ends) if ends else (-1, "")
 
 
 def _parse_harmony(text: str) -> tuple[list[ToolCall], str | None]:
-    """Harmony commentary channels: ``to=functions.X`` segments become tool
-    calls; segments without ``to=`` are user-visible preambles (framing
-    stripped, body kept). Segments terminate at <|call|> or <|end|>. Other
-    text passes through — the gpt_oss reasoning parser already consumed the
-    analysis channel and final-channel framing upstream."""
+    """Harmony commentary channels: ``to=functions.X`` segments become
+    client tool calls; other recipients (python, browser.*) are builtin
+    tool traffic this server cannot execute — dropped, never surfaced as
+    fake function calls; segments without ``to=`` are user-visible
+    preambles (framing stripped, body kept). Segments terminate at
+    <|call|> or <|end|>; stray terminators outside segments are framing.
+    The gpt_oss reasoning parser already consumed the analysis channel and
+    final-channel headers upstream."""
     calls: list[ToolCall] = []
     normal_parts: list[str] = []
     pos = 0
@@ -307,21 +331,32 @@ def _parse_harmony(text: str) -> tuple[list[ToolCall], str | None]:
             normal_parts.append(text[pos:])
             break
         normal_parts.append(text[pos:m.start()])
-        ends = [j for t in ("<|call|>", "<|end|>")
-                if (j := text.find(t, m.end())) >= 0]
-        end = min(ends) if ends else len(text)
+        end, tok = _harmony_segment_end(text, m.end())
+        if end < 0:
+            end, tok = len(text), ""
         body = text[m.end():end].strip()
-        name = m.group(1)
-        if name:
-            calls.append(ToolCall(name=name, arguments=body or "{}"))
+        recipient = m.group(1)
+        if recipient and recipient.startswith("functions."):
+            calls.append(ToolCall(name=recipient[len("functions."):],
+                                  arguments=body or "{}"))
+        elif recipient:
+            log_dropped_builtin(recipient)
         elif body:
             normal_parts.append(body)
-        if end >= len(text):
+        pos = end + len(tok)
+        if not tok:
             break
-        pos = end + (len("<|call|>") if text.startswith("<|call|>", end)
-                     else len("<|end|>"))
-    normal = "".join(normal_parts).strip()
+    cfg = TOOL_PARSERS["harmony"]
+    normal = strip_framing("".join(normal_parts), cfg).strip()
     return calls, (normal or None)
+
+
+def log_dropped_builtin(recipient: str) -> None:  # pragma: no cover - logging
+    from dynamo_tpu.utils.logging import get_logger
+
+    get_logger("parsers").debug(
+        "dropping harmony builtin-tool segment to=%s (not a client function)",
+        recipient)
 
 
 def parse_tool_calls(text: str, cfg: ToolCallConfig) -> tuple[list[ToolCall], str | None]:

@@ -312,8 +312,8 @@ def test_harmony_tool_call_parse():
     assert '"Tokyo"' in calls[0].arguments
     assert normal is None
 
-    # two calls + surrounding text; bare to= (no functions. prefix)
-    text = ('before <|channel|>commentary to=lookup <|message|>{"q":1}<|call|>'
+    # two calls + surrounding text (functions.* namespace only)
+    text = ('before <|channel|>commentary to=functions.lookup <|message|>{"q":1}<|call|>'
             '<|channel|>commentary to=functions.save <|message|>{"v":2}<|call|> after')
     calls, normal = parse_tool_calls(text, cfg)
     assert [c.name for c in calls] == ["lookup", "save"]
@@ -439,3 +439,68 @@ def test_harmony_jail_active_without_request_tools():
         model="m", messages=[{"role": "user", "content": "hi"}])
     jail = HttpService._make_jail(entry, req)
     assert jail is not None and jail.tool_cfg is not None
+
+
+def test_gpt_oss_analysis_with_recipient_is_reasoning():
+    """'<|channel|>analysis to=python<|message|>...<|call|>' — the whole
+    analysis channel (any recipient) is reasoning, never content."""
+    from dynamo_tpu.parsers.reasoning import REASONING_PARSERS, ReasoningParser
+
+    text = ("<|channel|>analysis to=python<|message|>print(1)<|call|>"
+            "<|channel|>final<|message|>ok<|return|>")
+    res = ReasoningParser.parse_complete(text, REASONING_PARSERS["gpt_oss"])
+    assert res.reasoning_text == "print(1)"
+    assert res.normal_text == "ok"
+    assert "<|" not in res.normal_text
+
+
+def test_harmony_stray_end_token_stripped():
+    """A final message terminated by <|end|> (instead of <|return|>) must not
+    leak the terminator to the client — streaming or aggregate."""
+    from dynamo_tpu.parsers import StreamJail, get_reasoning_parser, get_tool_parser
+
+    jail = StreamJail(tool_cfg=get_tool_parser("harmony"),
+                      reasoning=get_reasoning_parser("gpt_oss"))
+    text = ("<|channel|>analysis<|message|>t<|end|>"
+            "<|channel|>final<|message|>Hello<|end|>")
+    content = ""
+    for i in range(0, len(text), 4):
+        content += jail.feed(text[i:i + 4]).content
+    content += jail.finish().content
+    assert content == "Hello", repr(content)
+
+
+def test_harmony_builtin_recipients_not_client_calls():
+    """to=python / to=browser.search segments are builtin-tool traffic —
+    dropped, never surfaced as fake OpenAI function calls."""
+    from dynamo_tpu.parsers.tool_calls import get_tool_parser, parse_tool_calls
+
+    cfg = get_tool_parser("harmony")
+    text = ("<|channel|>commentary to=python <|message|>import math<|call|>"
+            '<|channel|>commentary to=functions.calc <|message|>{"x":1}<|call|>'
+            "<|channel|>commentary to=browser.search <|message|>q<|call|>")
+    calls, normal = parse_tool_calls(text, cfg)
+    assert [c.name for c in calls] == ["calc"]
+    assert normal is None
+
+
+def test_recipe_null_parsers_key(tmp_path):
+    """A YAML 'parsers:' with null children must not crash build_plan."""
+    from dynamo_tpu.launch.recipe import build_plan, load_spec
+
+    p = tmp_path / "r.yaml"
+    p.write_text("""
+apiVersion: dynamo-tpu/v1
+kind: TpuServeDeployment
+metadata: {name: x}
+spec:
+  model: tiny-llama
+  parsers:
+  frontend: {port: 8080}
+  workers:
+    - name: w
+      engine: {blockSize: 4}
+""")
+    plan = build_plan(load_spec(p))
+    w = next(pr for pr in plan.processes if pr.name == "w")
+    assert "--tool-call-parser" not in w.args
