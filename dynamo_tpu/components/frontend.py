@@ -60,6 +60,7 @@ class ModelWatcher:
         self._instances: dict[str, set[str]] = {}   # model -> instance keys
         self._pipelines: dict[str, tuple] = {}       # model -> (client, router)
         self._task: asyncio.Task | None = None
+        self._sweep_task: asyncio.Task | None = None
 
     async def start(self) -> None:
         assert self.rt.client is not None
@@ -70,6 +71,19 @@ class ModelWatcher:
         async for ev in watch:
             log.debug("model watch event: %s %s", ev.op, ev.key)
             try:
+                if ev.op == "reset":
+                    # Coordinator reconnect: keep pipelines (they would only
+                    # churn), but forget the instance bookkeeping — the
+                    # replay re-populates it for live workers. Workers that
+                    # died DURING the outage produce neither replay nor
+                    # delete events (the restarted coordinator never knew
+                    # them), so sweep still-empty models after workers have
+                    # had time to re-register.
+                    self._instances.clear()
+                    if self._sweep_task is None or self._sweep_task.done():
+                        self._sweep_task = asyncio.create_task(
+                            self._sweep_stale_models())
+                    continue
                 # key: dyn/models/{name}/{instance}
                 _, _, rest = ev.key.partition(MODEL_PREFIX + "/")
                 name, _, inst = rest.partition("/")
@@ -167,6 +181,17 @@ class ModelWatcher:
         )
         self._pipelines[name] = (client, router)
         log.info("model added: %s via %s (router=%s)", name, endpoint, mode)
+
+    async def _sweep_stale_models(self, settle_s: float = 10.0) -> None:
+        """Post-reset: models whose workers never re-registered within the
+        settle window are gone for good — unregister them (no delete event
+        will ever arrive for keys the restarted coordinator never held)."""
+        await asyncio.sleep(settle_s)
+        for name in list(self._pipelines):
+            if not self._instances.get(name):
+                log.warning("model %s has no instances after coordinator "
+                            "reconnect settle; removing", name)
+                await self._remove_model(name)
 
     async def _remove_model(self, name: str) -> None:
         self.models.unregister(name)

@@ -374,10 +374,16 @@ async def amain(ns: argparse.Namespace) -> None:
     if ns.disagg != "prefill":
         # Prefill workers are internal capacity — only decode/agg workers
         # publish a model card for the frontend to discover.
-        await rt.client.put(
-            f"{MODEL_PREFIX}/{name}/{rt.instance_id:016x}",
-            json.dumps(model_card(ns, name)).encode(),
-            lease_id=rt.primary_lease.id)
+        async def put_card() -> None:
+            await rt.client.put(
+                f"{MODEL_PREFIX}/{name}/{rt.instance_id:016x}",
+                json.dumps(model_card(ns, name)).encode(),
+                lease_id=rt.primary_lease.id)
+
+        await put_card()
+        # A coordinator restart loses the card with the lease — re-declare
+        # it whenever the runtime re-registers this worker.
+        rt.on_reconnect(put_card)
     log.info("worker ready: engine=%s model=%s disagg=%s instance=%x",
              ns.engine, name, ns.disagg, rt.instance_id)
     print(f"WORKER_READY instance={rt.instance_id:016x}", flush=True)

@@ -84,6 +84,16 @@ class StreamJail:
                 self._in_call = False
                 continue
             i = match_start(self._pending, self.tool_cfg)
+            # Strip stray framing from the text BEFORE the first call
+            # marker (only — a terminator past the marker belongs to that
+            # segment); must happen pre-release or a strip token and a call
+            # start arriving in one delta leak the token to the client.
+            head = self._pending if i < 0 else self._pending[:i]
+            stripped_head = strip_framing(head, self.tool_cfg)
+            if stripped_head != head:
+                self._pending = stripped_head + (
+                    "" if i < 0 else self._pending[i:])
+                continue
             if self.tool_cfg.bare_json and i >= 0 and not self._pending[i:].startswith(
                 tuple(self.tool_cfg.start_tokens) or ("\0",)
             ):
@@ -99,13 +109,6 @@ class StreamJail:
                 self._call_buf = self._pending[i:]
                 self._pending = ""
                 self._in_call = True
-                continue
-            # stray framing tokens (harmony <|end|> outside a segment) are
-            # dropped, not released; the jail withholds partial matches of
-            # them via possible_start's extended token set
-            stripped = strip_framing(self._pending, self.tool_cfg)
-            if stripped != self._pending:
-                self._pending = stripped
                 continue
             k = possible_start(self._pending, self.tool_cfg)
             if k:

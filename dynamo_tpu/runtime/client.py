@@ -127,7 +127,15 @@ class EndpointClient:
 
     async def _watch_loop(self, watch) -> None:
         async for ev in watch:
-            if ev.op == "put" and ev.value:
+            if ev.op == "reset":
+                # coordinator reconnect: the replay that follows is the
+                # complete truth — instances that died during the outage
+                # would otherwise linger forever
+                if self.instances:
+                    log.info("instance set for %s reset on reconnect (%d dropped)",
+                             self.endpoint, len(self.instances))
+                self.instances.clear()
+            elif ev.op == "put" and ev.value:
                 inst = Instance.from_bytes(ev.value)
                 self.instances[inst.instance_id] = inst
                 self._quarantine.pop(inst.instance_id, None)

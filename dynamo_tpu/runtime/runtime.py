@@ -85,6 +85,7 @@ class DistributedRuntime:
         self._streams = self.tasks.child(
             "streams", max_concurrency=self.config.max_handler_streams)
         self._draining = False
+        self._reconnect_hooks: list = []
         # Per-process system status server (reference:
         # system_status_server.rs), env-gated DYN_SYSTEM_ENABLED/PORT.
         self.status_server = None
@@ -93,7 +94,9 @@ class DistributedRuntime:
     @classmethod
     async def create(cls, config: RuntimeConfig | None = None) -> "DistributedRuntime":
         rt = cls(config)
-        rt.client = await CoordinatorClient.connect(rt.config.coordinator_url)
+        rt.client = await CoordinatorClient.connect(
+            rt.config.coordinator_url, auto_reconnect=True)
+        rt.client.on_reconnected.append(rt._restore_registrations)
         rt.primary_lease = await rt.client.lease_grant(ttl=rt.config.lease_ttl_s)
         # Coordinator lease ids are server-unique — mixing one in makes
         # instance ids collision-free even for runtimes created in the same
@@ -136,6 +139,49 @@ class DistributedRuntime:
             self._server.close()
         if self.client:
             await self.client.close()
+
+    async def _restore_registrations(self) -> None:
+        """After a coordinator reconnect (possibly a RESTARTED coordinator
+        with empty state): leases are gone — grant a fresh primary lease and
+        re-put every served instance under it (same instance_id: identity is
+        stable across outages), then run component-level hooks (model cards
+        etc.). The reference gets this durability from etcd itself; our
+        built-in coordinator gets it from clients re-declaring their state."""
+        assert self.client is not None
+        if self.primary_lease is not None:
+            # Stop the old lease's keepalive (a client-side-only blip would
+            # otherwise leave it renewing a superseded lease forever) and
+            # best-effort revoke it — unknown to a restarted coordinator.
+            if self.primary_lease._task:
+                self.primary_lease._task.cancel()
+            try:
+                await self.client._request(
+                    {"op": "lease_revoke", "lease_id": self.primary_lease.id})
+            except Exception:
+                pass
+        self.primary_lease = await self.client.lease_grant(
+            ttl=self.config.lease_ttl_s)
+        import dataclasses as _dc
+
+        for served in self._served.values():
+            served.instance = _dc.replace(
+                served.instance, lease_id=self.primary_lease.id)
+            await self.client.put(
+                served.endpoint.instance_key(self.instance_id),
+                served.instance.to_bytes(),
+                lease_id=self.primary_lease.id)
+        log.info("re-registered %d endpoint(s) after coordinator reconnect",
+                 len(self._served))
+        for hook in list(self._reconnect_hooks):
+            try:
+                await hook()
+            except Exception:
+                log.exception("reconnect hook failed")
+
+    def on_reconnect(self, hook) -> None:
+        """Register an async callback run after coordinator reconnection +
+        instance re-registration (components re-put model cards here)."""
+        self._reconnect_hooks.append(hook)
 
     @property
     def advertise_address(self) -> str:

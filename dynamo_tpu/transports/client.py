@@ -31,7 +31,10 @@ class CoordinatorError(RuntimeError):
 
 @dataclass
 class WatchEvent:
-    op: str            # "put" | "delete"
+    # "put" | "delete" | "reset" — reset precedes the post-reconnect replay:
+    # consumers must drop accumulated state (deletions during the outage
+    # are not replayable; the replay after reset is the complete truth).
+    op: str
     key: str
     value: bytes | None = None
     initial: bool = False
@@ -56,9 +59,13 @@ class Watch:
             yield ev
 
     async def cancel(self) -> None:
-        await self._client._request({"op": "unwatch", "watch_id": self.watch_id})
         self._client._watches.pop(self.watch_id, None)
+        self._client._watch_prefixes.pop(self.watch_id, None)
         self.queue.put_nowait(None)
+        try:
+            await self._client._request({"op": "unwatch", "watch_id": self.watch_id})
+        except CoordinatorError:
+            pass  # disconnected: the server session is gone anyway
 
 
 class Subscription:
@@ -78,9 +85,13 @@ class Subscription:
             yield item
 
     async def cancel(self) -> None:
-        await self._client._request({"op": "unsubscribe", "sub_id": self.sub_id})
         self._client._subs.pop(self.sub_id, None)
+        self._client._sub_subjects.pop(self.sub_id, None)
         self.queue.put_nowait(None)
+        try:
+            await self._client._request({"op": "unsubscribe", "sub_id": self.sub_id})
+        except CoordinatorError:
+            pass  # disconnected: the server session is gone anyway
 
 
 @dataclass
@@ -98,40 +109,67 @@ class Lease:
 
 
 class CoordinatorClient:
-    def __init__(self, url: str):
+    def __init__(self, url: str, auto_reconnect: bool = False):
         self.url = url
+        self.auto_reconnect = auto_reconnect
         self._conn: MsgpackConnection | None = None
+        self._connected = False
         self._ids = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
         self._watches: dict[int, Watch] = {}
+        self._watch_prefixes: dict[int, str] = {}    # for re-registration
         self._subs: dict[int, Subscription] = {}
+        self._sub_subjects: dict[int, str] = {}
         self._reader_task: asyncio.Task | None = None
+        self._reconnect_task: asyncio.Task | None = None
         self._closed = False
+        self.reconnects = 0
+        # Async callbacks run after every successful reconnect, AFTER
+        # watches/subs are re-registered — the place to re-grant leases and
+        # re-put lease-bound keys (the coordinator lost them with the
+        # session; a RESTARTED coordinator lost everything).
+        self.on_reconnected: list[Callable[[], Awaitable[None]]] = []
 
     # ------------------------------------------------------------------
     @classmethod
-    async def connect(cls, url: str, retries: int = 30, delay: float = 0.2) -> "CoordinatorClient":
-        client = cls(url)
-        host, port = parse_url(url)
+    async def connect(cls, url: str, retries: int = 30, delay: float = 0.2,
+                      auto_reconnect: bool = False) -> "CoordinatorClient":
+        client = cls(url, auto_reconnect=auto_reconnect)
+        await client._dial(retries=retries, delay=delay)
+        return client
+
+    async def _dial(self, retries: int = 30, delay: float = 0.2) -> None:
+        if self._conn is not None:
+            self._conn.close()  # never leak a half-dead connection
+        host, port = parse_url(self.url)
         last: Exception | None = None
         for _ in range(retries):
             try:
-                client._conn = await MsgpackConnection.connect(host, port)
+                self._conn = await MsgpackConnection.connect(host, port)
                 break
             except OSError as exc:
                 last = exc
                 await asyncio.sleep(delay)
         else:
-            raise CoordinatorError(f"cannot reach coordinator at {url}: {last}")
-        client._reader_task = asyncio.create_task(client._read_loop())
-        return client
+            raise CoordinatorError(f"cannot reach coordinator at {self.url}: {last}")
+        self._connected = True
+        self._reader_task = asyncio.create_task(self._read_loop())
 
     async def close(self) -> None:
         self._closed = True
         if self._reader_task:
             self._reader_task.cancel()
+        if self._reconnect_task:
+            self._reconnect_task.cancel()
         if self._conn:
             self._conn.close()
+        # Poison every stream: with auto_reconnect the read-loop's finally
+        # deliberately skips this, so a close() during an outage must do it
+        # or consumers iterate empty queues forever.
+        for w in self._watches.values():
+            w.queue.put_nowait(None)
+        for s in self._subs.values():
+            s.queue.put_nowait(None)
 
     # ------------------------------------------------------------------
     async def _read_loop(self) -> None:
@@ -146,17 +184,67 @@ class CoordinatorClient:
             if not self._closed:
                 log.warning("coordinator reader failed: %s", exc)
         finally:
+            self._connected = False
             if not self._closed:
-                log.warning("coordinator connection lost")
-            # Fail pending requests and end all watch/subscription streams so
-            # no consumer blocks forever on a dead connection.
+                log.warning("coordinator connection lost%s",
+                            " (reconnecting)" if self.auto_reconnect else "")
+            # In-flight requests cannot be retried safely (the op may have
+            # applied); fail them either way.
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(CoordinatorError("connection lost"))
-            for w in self._watches.values():
-                w.queue.put_nowait(None)
-            for s in self._subs.values():
-                s.queue.put_nowait(None)
+            self._pending.clear()
+            if self._closed or not self.auto_reconnect:
+                # End all watch/subscription streams so no consumer blocks
+                # forever on a dead connection.
+                for w in self._watches.values():
+                    w.queue.put_nowait(None)
+                for s in self._subs.values():
+                    s.queue.put_nowait(None)
+            elif self._reconnect_task is None or self._reconnect_task.done():
+                # single owner: a reconnect loop already mid-rebuild keeps
+                # going (its redial handles this death); two loops would
+                # double-register watches and double-fire on_reconnected
+                self._reconnect_task = asyncio.create_task(self._reconnect_loop())
+
+    async def _reconnect_loop(self) -> None:
+        """Re-dial with backoff, then rebuild server-side session state:
+        watches re-register under their ORIGINAL ids (the server accepts a
+        caller-chosen watch_id) after pushing a synthetic ``reset`` event so
+        consumers drop state accumulated before the outage — the replay
+        that follows is the complete current truth, and deletions that
+        happened while disconnected would otherwise be missed forever.
+        Subscriptions re-subscribe (messages during the outage are lost —
+        pub/sub is fire-and-forget, consumers tolerate gaps by design)."""
+        delay = 0.2
+        while not self._closed:
+            try:
+                await self._dial(retries=1)
+            except CoordinatorError:
+                delay = min(delay * 1.7, 5.0)
+                await asyncio.sleep(delay)
+                continue
+            try:
+                for wid, prefix in list(self._watch_prefixes.items()):
+                    w = self._watches.get(wid)
+                    if w is not None:
+                        w.queue.put_nowait(WatchEvent(op="reset", key=prefix))
+                    await self._request(
+                        {"op": "watch", "prefix": prefix, "watch_id": wid})
+                for sid, subject in list(self._sub_subjects.items()):
+                    await self._request(
+                        {"op": "subscribe", "subject": subject, "sub_id": sid})
+                self.reconnects += 1
+                log.info("coordinator reconnected (%d watches, %d subs)",
+                         len(self._watch_prefixes), len(self._sub_subjects))
+                for cb in list(self.on_reconnected):
+                    try:
+                        await cb()
+                    except Exception:
+                        log.exception("on_reconnected callback failed")
+                return
+            except CoordinatorError:
+                continue  # connection died again mid-rebuild; redial
 
     def _dispatch_frame(self, msg: dict) -> None:
         t = msg.get("t")
@@ -183,7 +271,10 @@ class CoordinatorClient:
 
 
     async def _request(self, body: dict) -> dict:
-        assert self._conn is not None, "not connected"
+        if self._conn is None or not self._connected:
+            # Fail fast during an outage: callers see the same error shape
+            # as a mid-flight loss and apply their own retry policy.
+            raise CoordinatorError("not connected")
         rid = next(self._ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
@@ -216,6 +307,7 @@ class CoordinatorClient:
         # events for this watch may already be queued in _read_loop order;
         # register before returning (watch_id assigned server-side)
         wid = resp["watch_id"]
+        self._watch_prefixes[wid] = prefix
         w = self._watches.get(wid)
         if w is None:
             w = Watch(self, wid)
@@ -247,6 +339,7 @@ class CoordinatorClient:
     async def subscribe(self, subject: str) -> Subscription:
         resp = await self._request({"op": "subscribe", "subject": subject, "sub_id": 0})
         sid = resp["sub_id"]
+        self._sub_subjects[sid] = subject
         s = self._subs.get(sid)
         if s is None:
             s = Subscription(self, sid)

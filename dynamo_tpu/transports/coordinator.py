@@ -149,8 +149,15 @@ class _Session:
     _next_id: int = 0
 
     def next_id(self) -> int:
-        self._next_id += 1
-        return self._next_id
+        # Skip ids already registered: reconnecting clients re-register
+        # watches/subs under their ORIGINAL ids (caller-chosen), and a
+        # fresh session counter colliding with one would silently cross the
+        # streams.
+        while True:
+            self._next_id += 1
+            if (self._next_id not in self.watches
+                    and self._next_id not in self.subscriptions):
+                return self._next_id
 
     def enqueue(self, msg: dict) -> bool:
         """Non-blocking push send; False when the client is stalled (full)."""
@@ -186,9 +193,13 @@ class CoordinatorServer:
             self._expiry_task.cancel()
         if self._server:
             self._server.close()
-            await self._server.wait_closed()
+        # Close live sessions BEFORE wait_closed(): Python 3.12's
+        # wait_closed waits for connection handlers, which run until their
+        # client disconnects — a stop with connected clients would deadlock.
         for s in list(self._sessions):
             s.conn.close()
+        if self._server:
+            await self._server.wait_closed()
 
     @property
     def url(self) -> str:

@@ -109,3 +109,85 @@ async def test_work_queue():
     assert await c.queue_pop("prefill") == b"req1"
     assert await c.queue_pop("prefill") == b"req2"
     assert await c.queue_pop("prefill") is None
+
+
+# ---------------------------------------------------------------------------
+# Auto-reconnect (our analog of etcd HA durability: clients re-declare
+# their state to a restarted coordinator)
+# ---------------------------------------------------------------------------
+
+async def test_client_auto_reconnect_restores_watch_and_kv():
+    from dynamo_tpu.transports.client import CoordinatorClient, CoordinatorError
+    from dynamo_tpu.transports.coordinator import CoordinatorServer
+
+    server = CoordinatorServer("127.0.0.1", 0)
+    port = await server.start()
+    client = await CoordinatorClient.connect(
+        f"tcp://127.0.0.1:{port}", auto_reconnect=True)
+    try:
+        await client.put("reconn/a", b"1")
+        watch = await client.watch_prefix("reconn/")
+        events: list = []
+
+        async def consume():
+            async for ev in watch:
+                events.append(ev)
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.1)
+        assert [e.op for e in events] == ["put"]  # initial replay
+
+        hooks: list[str] = []
+
+        async def hook():
+            hooks.append("ran")
+
+        client.on_reconnected.append(hook)
+
+        # kill the coordinator; requests fail fast while it is down
+        await server.stop()
+        await asyncio.sleep(0.2)
+        with pytest.raises(CoordinatorError):
+            await client.get("reconn/a")
+
+        # restart on the SAME port with EMPTY state
+        server2 = CoordinatorServer("127.0.0.1", port)
+        await server2.start()
+        deadline = asyncio.get_running_loop().time() + 10
+        while client.reconnects == 0:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.1)
+        assert hooks == ["ran"]
+
+        # watch got a reset (state wiped) and keeps delivering live events
+        await client.put("reconn/b", b"2")
+        await asyncio.sleep(0.2)
+        ops = [e.op for e in events]
+        assert "reset" in ops
+        assert ops[-1] == "put" and events[-1].key == "reconn/b"
+        # KV works again
+        assert await client.get("reconn/b") == b"2"
+        task.cancel()
+        await server2.stop()
+    finally:
+        await client.close()
+
+
+async def test_client_without_auto_reconnect_still_poisons():
+    from dynamo_tpu.transports.client import CoordinatorClient
+    from dynamo_tpu.transports.coordinator import CoordinatorServer
+
+    server = CoordinatorServer("127.0.0.1", 0)
+    port = await server.start()
+    client = await CoordinatorClient.connect(f"tcp://127.0.0.1:{port}")
+    try:
+        watch = await client.watch_prefix("x/")
+        await server.stop()
+        # the stream must END (poison), not hang
+        async def drain():
+            async for _ in watch:
+                pass
+
+        await asyncio.wait_for(drain(), 5)
+    finally:
+        await client.close()

@@ -157,9 +157,13 @@ def test_client_disconnect_aborts_generation():
     the slot (reference test model: tests/fault_tolerance/cancellation/).
     A dedicated SLOW mocker (speedup 1 → 8ms/token → 400 tokens ≈ 3.2s)
     makes the abort provable: the step counter must stop far short of the
-    request's budget."""
-    import http.client
+    request's budget.
 
+    Attempt-based: whether an abortive close's RST is actually DELIVERED to
+    the serving process mid-response is kernel-timing dependent (~1-in-8
+    observed misses even with SO_LINGER 0 on a single-fd raw socket). One
+    early-stopped attempt proves the product path; a BROKEN abort path
+    fails every attempt deterministically (always 400 steps)."""
     coord_port, http_port = free_port(), free_port()
     coordinator = ManagedProcess(
         ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
@@ -184,52 +188,183 @@ def test_client_disconnect_aborts_generation():
                 break
             time.sleep(0.1)
 
-        conn = http.client.HTTPConnection("127.0.0.1", http_port, timeout=30)
-        body = json.dumps({
-            "model": "tiny-llama", "prompt": "abort me please",
-            "max_tokens": 400, "ignore_eos": True, "stream": True,
-        })
-        conn.request("POST", "/v1/completions", body=body,
-                     headers={"content-type": "application/json"})
-        resp = conn.getresponse()
-        assert resp.status == 200
-        got = resp.read(120)  # a couple of live SSE chunks...
-        assert b"data:" in got
-
         def worker_stats() -> dict:
             return next(iter(http_json(base + "/engine_stats")
                              .get("tiny-llama", {}).get("workers", {})
                              .values()), {})
 
-        # hard disconnect IMMEDIATELY (any pre-disconnect wait races the
-        # 3.2s generation under load): shutdown() forces the FIN out even
-        # though resp's buffered reader still holds a socket reference
-        # (plain close() would leave the fd open until GC)
         import socket as _socket
+        import struct as _struct
 
-        conn.sock.shutdown(_socket.SHUT_RDWR)
-        conn.sock.close()
-
-        # abort must land: wait until metrics show the request both RAN
-        # (steps > 0 — guards against a stale pre-request snapshot) and
-        # drained; then the step counter proves the early stop. No
-        # pre-disconnect wait, so the check can't race the generation.
-        deadline = time.time() + 15
-        stats = {}
-        while time.time() < deadline:
-            stats = worker_stats()
-            if (stats.get("num_steps", 0) > 0
-                    and stats.get("num_running", 1) == 0
-                    and stats.get("num_waiting", 1) == 0):
-                break
-            time.sleep(0.2)
-        else:
+        def attempt() -> int:
+            """One request + mid-stream abortive close; returns the step
+            DELTA the request consumed. Raw single-fd socket so SO_LINGER's
+            RST is not defeated by dup'd fds (http.client dups)."""
+            steps_before = worker_stats().get("num_steps", 0)
+            body = json.dumps({
+                "model": "tiny-llama", "prompt": "abort me please",
+                "max_tokens": 400, "ignore_eos": True, "stream": True,
+            }).encode()
+            sock = _socket.create_connection(("127.0.0.1", http_port),
+                                             timeout=30)
+            sock.sendall(
+                b"POST /v1/completions HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n"
+                + body)
+            got = b""
+            while b"data:" not in got:  # headers + one live SSE chunk
+                chunk = sock.recv(4096)
+                assert chunk, f"stream ended early: {got!r}"
+                got += chunk
+            assert b" 200 " in got.split(b"\r\n", 1)[0]
+            # disconnect IMMEDIATELY (a wait would race the generation):
+            # abortive close — RST, not FIN (a FIN mid-response can sit
+            # unread behind paused reads)
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_LINGER,
+                            _struct.pack("ii", 1, 0))
+            sock.close()
+            # wait until the request RAN (delta > 0 guards against stale
+            # snapshots) and the engine drained
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                stats = worker_stats()
+                delta = stats.get("num_steps", 0) - steps_before
+                if (delta > 0 and stats.get("num_running", 1) == 0
+                        and stats.get("num_waiting", 1) == 0):
+                    return delta
+                time.sleep(0.2)
             raise AssertionError(f"no drained post-run stats: {stats}")
-        assert stats["num_steps"] < 390, (
-            f"engine ran {stats['num_steps']} steps — the 400-token "
-            f"request was not aborted early")
+
+        deltas = []
+        for _ in range(3):
+            deltas.append(attempt())
+            if deltas[-1] < 390:
+                break
+        assert min(deltas) < 390, (
+            f"every attempt ran its full budget ({deltas}) — disconnects "
+            f"are not aborting generations")
     finally:
         if frontend:
             frontend.stop()
         worker.stop()
+        coordinator.stop()
+
+
+def test_coordinator_restart_recovery():
+    """Chaos: kill the coordinator mid-serving and restart it (same port,
+    EMPTY state). Worker and frontend auto-reconnect: the worker re-grants
+    its lease, re-registers its instance and model card; the frontend's
+    watches reset+replay — and completions serve again. (The reference
+    leans on etcd HA for this; our built-in coordinator gets durability
+    from clients re-declaring their state.)"""
+    coord_port, http_port = free_port(), free_port()
+    coordinator = ManagedProcess(
+        ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
+         "--port", str(coord_port)], name="coordinator").start()
+    url = f"tcp://127.0.0.1:{coord_port}"
+    time.sleep(1.0)
+    worker = ManagedProcess(
+        ["-m", "dynamo_tpu.components.worker", "--engine", "mocker",
+         "--coordinator", url, "--block-size", "4", "--speedup-ratio", "50",
+         "--max-model-len", "512", "--num-blocks", "128"], name="worker").start()
+    frontend = coordinator2 = None
+    try:
+        worker.wait_for_line("WORKER_READY", 30)
+        frontend = ManagedProcess(
+            ["-m", "dynamo_tpu.components.frontend", "--coordinator", url,
+             "--host", "127.0.0.1", "--port", str(http_port),
+             "--router-mode", "kv"], name="frontend").start()
+        frontend.wait_for_line("FRONTEND_READY", 30)
+        base = f"http://127.0.0.1:{http_port}"
+
+        def completion_ok() -> bool:
+            try:
+                resp = http_json(base + "/v1/completions", {
+                    "model": "tiny-llama", "prompt": "hello", "max_tokens": 4,
+                    "ignore_eos": True}, timeout=10)
+                return resp["choices"][0]["finish_reason"] == "length"
+            except Exception:
+                return False
+
+        deadline = time.time() + 20
+        while not completion_ok():
+            assert time.time() < deadline, "never served before the chaos"
+            time.sleep(0.5)
+
+        # CHAOS: kill the coordinator entirely...
+        coordinator.stop()
+        time.sleep(1.5)
+        # ...and restart it on the same port with empty state
+        coordinator2 = ManagedProcess(
+            ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
+             "--port", str(coord_port)], name="coordinator2").start()
+        coordinator2.wait_for_line("COORDINATOR_READY", 20)
+
+        # serving must recover end-to-end: worker re-registers (lease,
+        # instance, model card), frontend re-discovers, requests succeed
+        deadline = time.time() + 40
+        while not completion_ok():
+            assert time.time() < deadline, (
+                "serving did not recover after coordinator restart;\n"
+                "frontend tail:\n" + "".join(frontend._lines[-15:])
+                + "worker tail:\n" + "".join(worker._lines[-15:]))
+            time.sleep(0.5)
+
+        # The durability proof (direct data-plane connections could mask a
+        # missing re-registration): the RESTARTED coordinator must hold the
+        # worker's re-declared instance + model card...
+        import asyncio
+
+        from dynamo_tpu.transports.client import CoordinatorClient
+
+        async def coordinator_state():
+            c = await CoordinatorClient.connect(url)
+            try:
+                inst = await c.get_prefix("dyn/instances/")
+                cards = await c.get_prefix("dyn/models/")
+                return inst, cards
+            finally:
+                await c.close()
+
+        deadline = time.time() + 20
+        while True:
+            inst, cards = asyncio.run(coordinator_state())
+            if inst and cards:
+                break
+            assert time.time() < deadline, (
+                f"worker never re-declared state: instances={list(inst)} "
+                f"cards={list(cards)}")
+            time.sleep(0.5)
+
+        # ...and a FRESH frontend (no pre-outage state) can discover + serve
+        fe2_port = free_port()
+        frontend2 = ManagedProcess(
+            ["-m", "dynamo_tpu.components.frontend", "--coordinator", url,
+             "--host", "127.0.0.1", "--port", str(fe2_port),
+             "--router-mode", "kv"], name="frontend2").start()
+        try:
+            frontend2.wait_for_line("FRONTEND_READY", 30)
+            base2 = f"http://127.0.0.1:{fe2_port}"
+            deadline = time.time() + 20
+            while True:
+                try:
+                    r = http_json(base2 + "/v1/completions", {
+                        "model": "tiny-llama", "prompt": "fresh frontend",
+                        "max_tokens": 4, "ignore_eos": True}, timeout=10)
+                    assert r["choices"][0]["finish_reason"] == "length"
+                    break
+                except Exception:
+                    assert time.time() < deadline, (
+                        "fresh frontend could not serve from re-declared "
+                        "state:\n" + "".join(frontend2._lines[-15:]))
+                    time.sleep(0.5)
+        finally:
+            frontend2.stop()
+    finally:
+        if frontend:
+            frontend.stop()
+        worker.stop()
+        if coordinator2:
+            coordinator2.stop()
         coordinator.stop()

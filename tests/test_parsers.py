@@ -504,3 +504,17 @@ spec:
     plan = build_plan(load_spec(p))
     w = next(pr for pr in plan.processes if pr.name == "w")
     assert "--tool-call-parser" not in w.args
+
+
+def test_harmony_stray_end_same_delta_as_call_start():
+    """A stray <|end|> and a commentary start arriving in ONE delta: the
+    terminator is stripped from the released head, the call still parses."""
+    from dynamo_tpu.parsers import StreamJail, get_tool_parser
+
+    jail = StreamJail(tool_cfg=get_tool_parser("harmony"))
+    d = jail.feed('Sure.<|end|><|channel|>commentary to=functions.f '
+                  '<|message|>{"a":1}<|call|>')
+    fin = jail.finish()
+    content = d.content + fin.content
+    assert content == "Sure."
+    assert [c.name for c in jail.tool_calls] == ["f"]
