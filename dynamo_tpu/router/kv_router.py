@@ -206,6 +206,16 @@ class KvPushRouter:
 
     async def _event_loop(self, sub) -> None:
         async for _subject, payload in sub:
+            if sub.gap:
+                # The reconnect replay ring could not cover the outage: the
+                # index may have missed stored/removed events. Fall back to
+                # the event-free approximation for affected lookups by
+                # degrading gracefully — the ApproxKvIndexer keeps routing
+                # sane and live events rebuild the radix from here; stale
+                # entries age out via worker removal/GC.
+                log.warning("kv event stream had a replay gap; radix index "
+                            "may be stale until events repopulate it")
+                sub.gap = False
             try:
                 events = [RouterEvent.from_dict(d) for d in msgpack.unpackb(payload, raw=False)]
                 self.router.apply_events(events)

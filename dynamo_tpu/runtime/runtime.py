@@ -149,16 +149,29 @@ class DistributedRuntime:
         built-in coordinator gets it from clients re-declaring their state."""
         assert self.client is not None
         if self.primary_lease is not None:
-            # Stop the old lease's keepalive (a client-side-only blip would
-            # otherwise leave it renewing a superseded lease forever) and
-            # best-effort revoke it — unknown to a restarted coordinator.
+            # Client-side-only blip (coordinator survived, lease TTL not yet
+            # expired): the lease AND every key bound to it are intact —
+            # reuse it (revoking would broadcast deletes and churn every
+            # frontend's pipelines for nothing). Just restart the keepalive,
+            # whose loop died with the old connection.
+            try:
+                alive = (await self.client._request(
+                    {"op": "lease_keepalive",
+                     "lease_id": self.primary_lease.id})).get("alive")
+            except Exception:
+                alive = False
+            if alive:
+                if self.primary_lease._task:
+                    self.primary_lease._task.cancel()
+                self.primary_lease._task = asyncio.create_task(
+                    self.client._keepalive_loop(self.primary_lease))
+                log.info("coordinator blip: primary lease %d survived; "
+                         "registrations intact", self.primary_lease.id)
+                return
+            # Lease is gone (expired, or the coordinator restarted): stop
+            # the orphaned keepalive and re-declare everything fresh.
             if self.primary_lease._task:
                 self.primary_lease._task.cancel()
-            try:
-                await self.client._request(
-                    {"op": "lease_revoke", "lease_id": self.primary_lease.id})
-            except Exception:
-                pass
         self.primary_lease = await self.client.lease_grant(
             ttl=self.config.lease_ttl_s)
         import dataclasses as _dc

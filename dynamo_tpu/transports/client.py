@@ -73,6 +73,12 @@ class Subscription:
         self._client = client
         self.sub_id = sub_id
         self.queue: asyncio.Queue[tuple[str, bytes]] = asyncio.Queue()
+        # Durable-resume bookkeeping (JetStream role): highest seq seen;
+        # reconnects resume from here. ``gap`` flips when the outage outran
+        # the server's replay ring — the consumer lost messages and should
+        # recover out-of-band (e.g. router radix snapshot reload).
+        self.last_seq = 0
+        self.gap = False
 
     def __aiter__(self):
         return self._iter()
@@ -123,6 +129,7 @@ class CoordinatorClient:
         self._reader_task: asyncio.Task | None = None
         self._reconnect_task: asyncio.Task | None = None
         self._closed = False
+        self._server_epoch: str | None = None  # seqs are per server life
         self.reconnects = 0
         # Async callbacks run after every successful reconnect, AFTER
         # watches/subs are re-registered — the place to re-grant leases and
@@ -232,19 +239,38 @@ class CoordinatorClient:
                     await self._request(
                         {"op": "watch", "prefix": prefix, "watch_id": wid})
                 for sid, subject in list(self._sub_subjects.items()):
-                    await self._request(
-                        {"op": "subscribe", "subject": subject, "sub_id": sid})
-                self.reconnects += 1
-                log.info("coordinator reconnected (%d watches, %d subs)",
-                         len(self._watch_prefixes), len(self._sub_subjects))
-                for cb in list(self.on_reconnected):
-                    try:
-                        await cb()
-                    except Exception:
-                        log.exception("on_reconnected callback failed")
+                    s = self._subs.get(sid)
+                    resp = await self._request(
+                        {"op": "subscribe", "subject": subject, "sub_id": sid,
+                         "from_seq": s.last_seq if s else 0,
+                         "epoch": self._server_epoch})
+                    if s is not None:
+                        if resp.get("gap"):
+                            s.gap = True
+                            # seqs are scoped to a server life: on a gap the
+                            # baseline restarts at the NEW server's seq
+                            s.last_seq = resp.get("seq", 0)
+                            log.warning("subscription %s lost messages "
+                                        "across the outage (replay gap)",
+                                        subject)
+                    self._server_epoch = resp.get("epoch", self._server_epoch)
+            except Exception:
+                # ANY rebuild failure (CoordinatorError, socket death mid-
+                # send, ...) → redial; never die with consumers un-poisoned
+                log.exception("coordinator session rebuild failed; redialing")
+                continue
+            self.reconnects += 1
+            log.info("coordinator reconnected (%d watches, %d subs)",
+                     len(self._watch_prefixes), len(self._sub_subjects))
+            for cb in list(self.on_reconnected):
+                try:
+                    await cb()
+                except Exception:
+                    log.exception("on_reconnected callback failed")
+            if self._connected:
                 return
-            except CoordinatorError:
-                continue  # connection died again mid-rebuild; redial
+            # the connection died DURING the callbacks and its reader saw
+            # this task still alive (no respawn): loop and redial ourselves
 
     def _dispatch_frame(self, msg: dict) -> None:
         t = msg.get("t")
@@ -267,6 +293,10 @@ class CoordinatorClient:
             s = self._subs.get(sid)
             if s is None:
                 s = self._subs[sid] = Subscription(self, sid)
+            seq = msg.get("seq", 0)
+            if seq and seq <= s.last_seq:
+                return  # duplicate (a live event raced the resume replay)
+            s.last_seq = max(s.last_seq, seq)
             s.queue.put_nowait((msg["subject"], msg["payload"]))
 
 
@@ -344,6 +374,10 @@ class CoordinatorClient:
         if s is None:
             s = Subscription(self, sid)
             self._subs[sid] = s
+        # baseline: resume-from excludes anything published before this
+        # subscription existed
+        s.last_seq = max(s.last_seq, resp.get("seq", 0))
+        self._server_epoch = resp.get("epoch", self._server_epoch)
         return s
 
     async def publish(self, subject: str, payload: bytes) -> int:

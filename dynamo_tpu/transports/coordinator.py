@@ -180,6 +180,17 @@ class CoordinatorServer:
         # watcher can never see a broadcast reordered before its own replay
         # of the same key (e.g. delete-then-stale-initial-put).
         self._watch_lock = asyncio.Lock()
+        # Bounded pub/sub replay ring (JetStream role): (seq, subject,
+        # payload). 16k messages cover minutes of KV-event traffic — well
+        # past any reconnect backoff window.
+        from collections import deque as _deque
+        from uuid import uuid4 as _uuid4
+
+        self._pub_seq = 0
+        self._pub_ring: "_deque[tuple[int, str, bytes]]" = _deque(maxlen=16384)
+        # Seq numbers are scoped to THIS server life; resumes from another
+        # epoch can never be silently satisfied by our (unrelated) seqs.
+        self._epoch = _uuid4().hex
 
     async def start(self) -> int:
         self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
@@ -245,12 +256,22 @@ class CoordinatorServer:
                             break
 
     async def _publish(self, subject: str, payload: bytes) -> int:
+        # Every message gets a global sequence number and lands in a bounded
+        # replay ring — the JetStream-durable-consumer role (reference:
+        # transports/nats.rs JetStream streams): a reconnecting subscriber
+        # resumes from its last seen seq instead of silently losing the
+        # outage window. The ring bounds memory; consumers that fall past
+        # its tail get a gap signal and resort to snapshots.
+        self._pub_seq += 1
+        seq = self._pub_seq
+        self._pub_ring.append((seq, subject, payload))
         n = 0
         for session in list(self._sessions):
             for sid, pattern in list(session.subscriptions.items()):
                 if fnmatch.fnmatchcase(subject, pattern):
                     if session.enqueue({"t": Frame.PUBSUB_MSG, "sub_id": sid,
-                                        "subject": subject, "payload": payload}):
+                                        "subject": subject, "payload": payload,
+                                        "seq": seq}):
                         n += 1
                     else:
                         self._drop_session(session, "pubsub outbox full")
@@ -331,7 +352,34 @@ class CoordinatorServer:
         if op == "subscribe":
             sid = msg.get("sub_id") or session.next_id()
             session.subscriptions[sid] = msg["subject"]
-            return {"sub_id": sid}
+            resp: dict = {"sub_id": sid, "seq": self._pub_seq,
+                          "epoch": self._epoch}
+            from_seq = msg.get("from_seq")
+            if from_seq is not None:
+                if msg.get("epoch") != self._epoch:
+                    # from_seq belongs to a PREVIOUS server life: our seqs
+                    # are unrelated — nothing is replayable regardless of
+                    # how the numbers happen to compare. Signal the gap; the
+                    # client resets its baseline from resp["seq"].
+                    resp["gap"] = True
+                else:
+                    # durable resume: replay buffered messages after
+                    # from_seq; a tail older than the ring's horizon is a
+                    # GAP the consumer must recover from out-of-band
+                    # (snapshots)
+                    ring = list(self._pub_ring)
+                    if ring and ring[0][0] > from_seq + 1:
+                        resp["gap"] = True
+                    elif not ring and self._pub_seq > from_seq:
+                        resp["gap"] = True  # evicted entirely
+                    for seq, subject, payload in ring:
+                        if seq > from_seq and fnmatch.fnmatchcase(
+                                subject, msg["subject"]):
+                            session.enqueue(
+                                {"t": Frame.PUBSUB_MSG, "sub_id": sid,
+                                 "subject": subject, "payload": payload,
+                                 "seq": seq, "replay": True})
+            return resp
         if op == "unsubscribe":
             session.subscriptions.pop(msg.get("sub_id"), None)
             return {}

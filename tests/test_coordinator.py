@@ -191,3 +191,87 @@ async def test_client_without_auto_reconnect_still_poisons():
         await asyncio.wait_for(drain(), 5)
     finally:
         await client.close()
+
+
+async def test_pubsub_durable_resume_replays_missed_messages():
+    """The JetStream role (reference: transports/nats.rs JetStream
+    streams): a subscriber that reconnects resumes from its last seq and
+    receives the messages published during the outage."""
+    from dynamo_tpu.transports.client import CoordinatorClient
+    from dynamo_tpu.transports.coordinator import CoordinatorServer
+
+    server = CoordinatorServer("127.0.0.1", 0)
+    port = await server.start()
+    url = f"tcp://127.0.0.1:{port}"
+    sub_client = await CoordinatorClient.connect(url, auto_reconnect=True)
+    pub_client = await CoordinatorClient.connect(url)
+    try:
+        sub = await sub_client.subscribe("events.*")
+        await pub_client.publish("events.a", b"m1")
+        await asyncio.sleep(0.1)
+        assert sub.queue.get_nowait() == ("events.a", b"m1")
+
+        # sever ONLY the subscriber's connection (server keeps running)
+        sub_client._conn.close()
+        await asyncio.sleep(0.2)
+        # messages published while the subscriber is away
+        await pub_client.publish("events.a", b"m2")
+        await pub_client.publish("other.subject", b"zz")  # not subscribed
+        await pub_client.publish("events.b", b"m3")
+
+        deadline = asyncio.get_running_loop().time() + 10
+        got = []
+        while len(got) < 2:
+            assert asyncio.get_running_loop().time() < deadline, got
+            try:
+                got.append(await asyncio.wait_for(sub.queue.get(), 5))
+            except asyncio.TimeoutError:
+                break
+        assert got == [("events.a", b"m2"), ("events.b", b"m3")]
+        assert not sub.gap
+        # live delivery continues without duplicates
+        await pub_client.publish("events.c", b"m4")
+        assert await asyncio.wait_for(sub.queue.get(), 5) == ("events.c", b"m4")
+        assert sub.queue.empty()
+    finally:
+        await sub_client.close()
+        await pub_client.close()
+        await server.stop()
+
+
+async def test_pubsub_gap_on_server_restart():
+    """A RESTARTED coordinator cannot replay the outage window — the
+    subscription must flag the gap so consumers recover via snapshots."""
+    from dynamo_tpu.transports.client import CoordinatorClient
+    from dynamo_tpu.transports.coordinator import CoordinatorServer
+
+    server = CoordinatorServer("127.0.0.1", 0)
+    port = await server.start()
+    url = f"tcp://127.0.0.1:{port}"
+    sub_client = await CoordinatorClient.connect(url, auto_reconnect=True)
+    try:
+        sub = await sub_client.subscribe("ev.*")
+        pub = await CoordinatorClient.connect(url)
+        await pub.publish("ev.x", b"1")
+        await asyncio.sleep(0.1)
+        assert sub.queue.get_nowait() == ("ev.x", b"1")
+        await pub.close()
+
+        await server.stop()
+        await asyncio.sleep(0.2)
+        server2 = CoordinatorServer("127.0.0.1", port)
+        await server2.start()
+        deadline = asyncio.get_running_loop().time() + 10
+        while sub_client.reconnects == 0:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.1)
+        assert sub.gap, "server restart must surface a replay gap"
+
+        # live delivery works against the new server (fresh seq space)
+        pub2 = await CoordinatorClient.connect(url)
+        await pub2.publish("ev.y", b"2")
+        assert await asyncio.wait_for(sub.queue.get(), 5) == ("ev.y", b"2")
+        await pub2.close()
+        await server2.stop()
+    finally:
+        await sub_client.close()

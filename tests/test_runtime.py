@@ -273,3 +273,44 @@ async def test_leader_worker_barrier():
         await w1.close()
         await w2.close()
         await server.stop()
+
+
+async def test_client_blip_reuses_lease_no_churn():
+    """A client-side-only connection blip (coordinator survives): the
+    runtime must REUSE its still-live primary lease — no key deletions are
+    broadcast, registrations stay intact, and the keepalive resumes (the
+    lease survives well past its TTL afterwards)."""
+    async with cluster(n_workers=1) as (server, cfg, runtimes):
+        rt = runtimes[0]
+        old_lease = rt.primary_lease.id
+        key = rt._served[next(iter(rt._served))].endpoint.instance_key(
+            rt.instance_id)
+
+        # independent observer watches for spurious deletes
+        from dynamo_tpu.transports.client import CoordinatorClient
+
+        obs = await CoordinatorClient.connect(cfg.coordinator_url)
+        watch = await obs.watch_prefix("dyn/instances/")
+        deletes: list = []
+
+        async def spy():
+            async for ev in watch:
+                if ev.op == "delete":
+                    deletes.append(ev.key)
+
+        spy_task = asyncio.create_task(spy())
+        try:
+            rt.client._conn.close()   # the blip
+            deadline = asyncio.get_running_loop().time() + 10
+            while rt.client.reconnects == 0:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.1)
+            assert rt.primary_lease.id == old_lease, "lease was replaced"
+            assert await obs.get(key) is not None, "registration lost"
+            # keepalive resumed: the lease outlives multiple TTLs
+            await asyncio.sleep(cfg.lease_ttl_s * 2.5)
+            assert await obs.get(key) is not None, "lease expired after blip"
+            assert deletes == [], f"spurious deletes broadcast: {deletes}"
+        finally:
+            spy_task.cancel()
+            await obs.close()
