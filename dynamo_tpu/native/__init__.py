@@ -29,7 +29,7 @@ log = get_logger("native")
 
 _DIR = Path(__file__).parent
 _SO = _DIR / "_build" / "libdynidx.so"
-_SRC = _DIR / "indexer.cc"
+_SOURCES = (_DIR / "indexer.cc", _DIR / "tokens.cc")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -49,12 +49,12 @@ def _build() -> bool:
         lock_path = _SO.parent / ".build.lock"
         with open(lock_path, "w") as lockf:
             fcntl.flock(lockf, fcntl.LOCK_EX)
-            if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+            if not _build_needed():
                 return True  # another process built it while we waited
             fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SO.parent)
             os.close(fd)
             cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                   str(_SRC), "-o", tmp]
+                   *[str(s) for s in _SOURCES], "-o", tmp]
             try:
                 out = subprocess.run(cmd, capture_output=True, text=True,
                                      timeout=120)
@@ -79,7 +79,9 @@ def _build() -> bool:
 
 
 def _build_needed() -> bool:
-    return not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime
+    if not _SO.exists():
+        return True
+    return _SO.stat().st_mtime < max(s.stat().st_mtime for s in _SOURCES)
 
 
 def load_library() -> ctypes.CDLL | None:
@@ -149,6 +151,12 @@ def load_library() -> ctypes.CDLL | None:
         lib.dyn_indexer_dump.argtypes = [
             ctypes.c_void_p, u64p, u64p, u64p, u8p, ctypes.c_size_t]
         lib.dyn_indexer_dump.restype = ctypes.c_size_t
+        lib.dyn_xxh3_64.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.dyn_xxh3_64.restype = ctypes.c_uint64
+        lib.dyn_token_seq_hashes.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+            ctypes.c_size_t, u64p, ctypes.c_size_t]
+        lib.dyn_token_seq_hashes.restype = ctypes.c_size_t
         _lib = lib
         log.info("native indexer loaded (%s)", _SO.name)
         return _lib

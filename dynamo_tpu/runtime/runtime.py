@@ -153,11 +153,15 @@ class DistributedRuntime:
             # expired): the lease AND every key bound to it are intact —
             # reuse it (revoking would broadcast deletes and churn every
             # frontend's pipelines for nothing). Just restart the keepalive,
-            # whose loop died with the old connection.
+            # whose loop died with the old connection. Gated on epoch
+            # continuity: a RESTARTED coordinator re-mints lease ids from 1,
+            # so a bare keepalive probe could "renew" a DIFFERENT client's
+            # lease and skip re-declaration entirely.
             try:
-                alive = (await self.client._request(
-                    {"op": "lease_keepalive",
-                     "lease_id": self.primary_lease.id})).get("alive")
+                alive = (not self.client.epoch_changed
+                         and (await self.client._request(
+                             {"op": "lease_keepalive",
+                              "lease_id": self.primary_lease.id})).get("alive"))
             except Exception:
                 alive = False
             if alive:

@@ -63,10 +63,39 @@ def compute_block_hashes_for_tokens(tokens: Sequence[int], block_size: int) -> l
 
     This is the router's request-time hash path
     (reference: lib/llm/src/kv_router/indexer.rs:125 compute_block_hash_for_seq).
+    Long prompts take the batched native path (native/tokens.cc: one C call
+    packs + hashes + chains every block); short ones stay in Python —
+    identical values either way (parity-fuzzed, tests/test_native_tokens.py).
     """
     n_full = len(tokens) // block_size
+    if n_full >= 64:  # ~1k tokens: below this marshalling eats the win
+        out = _native_seq_hashes(tokens, block_size, n_full)
+        if out is not None:
+            return out
     hashes = [compute_block_hash(tokens[i * block_size : (i + 1) * block_size]) for i in range(n_full)]
     return compute_seq_hashes(hashes)
+
+
+def _native_seq_hashes(tokens: Sequence[int], block_size: int,
+                       n_full: int) -> "list[SequenceHash] | None":
+    from dynamo_tpu.native import load_library
+
+    lib = load_library()
+    if lib is None:
+        return None
+    import array
+    import ctypes
+
+    n = n_full * block_size
+    # array('I') packs the list at C speed; from_buffer is zero-copy
+    # (building a ctypes array element-wise would cost more than the hash)
+    buf = array.array("I", tokens[:n] if len(tokens) != n else tokens)
+    arr = (ctypes.c_uint32 * n).from_buffer(buf)
+    out = (ctypes.c_uint64 * n_full)()
+    wrote = lib.dyn_token_seq_hashes(arr, n, block_size, out, n_full)
+    if wrote != n_full:  # defensive; cannot happen with max_out == n_full
+        return None
+    return list(out)
 
 
 @dataclass(frozen=True)

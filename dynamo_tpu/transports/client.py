@@ -130,6 +130,9 @@ class CoordinatorClient:
         self._reconnect_task: asyncio.Task | None = None
         self._closed = False
         self._server_epoch: str | None = None  # seqs are per server life
+        # True when the LAST reconnect crossed a server restart (epoch
+        # change) — lease/key state from before the outage is gone.
+        self.epoch_changed = False
         self.reconnects = 0
         # Async callbacks run after every successful reconnect, AFTER
         # watches/subs are re-registered — the place to re-grant leases and
@@ -143,6 +146,11 @@ class CoordinatorClient:
                       auto_reconnect: bool = False) -> "CoordinatorClient":
         client = cls(url, auto_reconnect=auto_reconnect)
         await client._dial(retries=retries, delay=delay)
+        try:
+            client._server_epoch = (
+                await client._request({"op": "epoch"})).get("epoch")
+        except CoordinatorError:
+            pass  # old server without the op: epoch tracking degrades
         return client
 
     async def _dial(self, retries: int = 30, delay: float = 0.2) -> None:
@@ -231,6 +239,8 @@ class CoordinatorClient:
                 delay = min(delay * 1.7, 5.0)
                 await asyncio.sleep(delay)
                 continue
+            prev_epoch = self._server_epoch
+            new_epoch = prev_epoch
             try:
                 for wid, prefix in list(self._watch_prefixes.items()):
                     w = self._watches.get(wid)
@@ -240,10 +250,13 @@ class CoordinatorClient:
                         {"op": "watch", "prefix": prefix, "watch_id": wid})
                 for sid, subject in list(self._sub_subjects.items()):
                     s = self._subs.get(sid)
+                    # every sub presents the PRE-outage epoch — updating it
+                    # mid-loop would let later subs resume against the new
+                    # epoch with stale seqs (silent loss, no gap)
                     resp = await self._request(
                         {"op": "subscribe", "subject": subject, "sub_id": sid,
                          "from_seq": s.last_seq if s else 0,
-                         "epoch": self._server_epoch})
+                         "epoch": prev_epoch})
                     if s is not None:
                         if resp.get("gap"):
                             s.gap = True
@@ -253,15 +266,24 @@ class CoordinatorClient:
                             log.warning("subscription %s lost messages "
                                         "across the outage (replay gap)",
                                         subject)
-                    self._server_epoch = resp.get("epoch", self._server_epoch)
+                    new_epoch = resp.get("epoch", new_epoch)
+                if not self._sub_subjects:
+                    # no subscription to learn the epoch from: ask directly
+                    # (lease-reuse decisions key on epoch continuity)
+                    new_epoch = (await self._request({"op": "epoch"})).get(
+                        "epoch", new_epoch)
             except Exception:
                 # ANY rebuild failure (CoordinatorError, socket death mid-
                 # send, ...) → redial; never die with consumers un-poisoned
                 log.exception("coordinator session rebuild failed; redialing")
                 continue
+            self._server_epoch = new_epoch
+            self.epoch_changed = (prev_epoch is not None
+                                  and new_epoch != prev_epoch)
             self.reconnects += 1
-            log.info("coordinator reconnected (%d watches, %d subs)",
-                     len(self._watch_prefixes), len(self._sub_subjects))
+            log.info("coordinator reconnected (%d watches, %d subs%s)",
+                     len(self._watch_prefixes), len(self._sub_subjects),
+                     ", NEW EPOCH" if self.epoch_changed else "")
             for cb in list(self.on_reconnected):
                 try:
                     await cb()

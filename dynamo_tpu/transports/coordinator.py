@@ -341,6 +341,8 @@ class CoordinatorServer:
         if op == "unwatch":
             session.watches.pop(msg.get("watch_id"), None)
             return {}
+        if op == "epoch":
+            return {"epoch": self._epoch}
         if op == "lease_grant":
             return {"lease_id": st.lease_grant(msg.get("ttl", 10.0), now)}
         if op == "lease_keepalive":
@@ -375,10 +377,14 @@ class CoordinatorServer:
                     for seq, subject, payload in ring:
                         if seq > from_seq and fnmatch.fnmatchcase(
                                 subject, msg["subject"]):
-                            session.enqueue(
-                                {"t": Frame.PUBSUB_MSG, "sub_id": sid,
-                                 "subject": subject, "payload": payload,
-                                 "seq": seq, "replay": True})
+                            if not session.enqueue(
+                                    {"t": Frame.PUBSUB_MSG, "sub_id": sid,
+                                     "subject": subject, "payload": payload,
+                                     "seq": seq, "replay": True}):
+                                # outbox overflow mid-replay: the tail is
+                                # lost — say so, never fake a full recovery
+                                resp["gap"] = True
+                                break
             return resp
         if op == "unsubscribe":
             session.subscriptions.pop(msg.get("sub_id"), None)
