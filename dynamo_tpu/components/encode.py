@@ -1,0 +1,86 @@
+"""Multimodal encode worker: images → embedding tokens over the runtime.
+
+Fills the role of the reference's encode workers (reference:
+components/src/dynamo/sglang multimodal encode/processor workers,
+trtllm/encode_helper.py): a dedicated process owning the vision encoder,
+serving ``dyn://{ns}.encoder.encode``. Frontends ship image bytes in the
+request and receive embedding tensors in the response — the tensors ride
+the SAME framed data plane as everything else, which is this framework's
+``nixl_connect`` analog (reference: dynamo.nixl_connect RDMA transfer;
+on TPU hosts the DCN-path framed stream is the transport).
+
+    python -m dynamo_tpu.components.encode --coordinator tcp://... \
+        --image-tokens 8 --lm-hidden 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.utils.config import RuntimeConfig
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+log = get_logger("encode")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("dynamo-encode-worker")
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="encoder")
+    p.add_argument("--endpoint", default="encode")
+    p.add_argument("--image-tokens", type=int, default=8)
+    p.add_argument("--lm-hidden", type=int, default=64,
+                   help="target LM hidden size (must match the served model)")
+    p.add_argument("--image-size", type=int, default=64)
+    return p.parse_args(argv)
+
+
+async def amain(ns: argparse.Namespace) -> None:
+    from dynamo_tpu.models.vision import VisionConfig, VisionEncoder
+
+    encoder = VisionEncoder(VisionConfig(
+        num_image_tokens=ns.image_tokens, lm_hidden_size=ns.lm_hidden,
+        image_size=ns.image_size))
+
+    rt = await DistributedRuntime.create(
+        RuntimeConfig.from_settings(coordinator_url=ns.coordinator))
+    loop = asyncio.get_running_loop()
+
+    async def handler(payload: dict, ctx):
+        images = payload.get("images", [])
+        if not images:
+            yield {"embeddings": []}
+            return
+        # jit-compiled encode off-loop; batched over the request's images
+        arr = await loop.run_in_executor(None, encoder.encode, list(images))
+        yield {"embeddings": [
+            {"data": arr[i].astype("float32").tobytes(),
+             "shape": list(arr[i].shape), "dtype": "float32"}
+            for i in range(len(images))]}
+
+    ep = rt.namespace(ns.namespace).component(ns.component).endpoint(ns.endpoint)
+    await ep.serve(handler)
+    if rt.status_server is not None:
+        rt.status_server.ready = True
+    log.info("encode worker ready: %d tokens/image -> lm_hidden=%d",
+             ns.image_tokens, ns.lm_hidden)
+    print(f"ENCODE_READY instance={rt.instance_id:016x}", flush=True)
+
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await rt.shutdown()
+
+
+def main() -> None:
+    configure_logging()
+    asyncio.run(amain(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

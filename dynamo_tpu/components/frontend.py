@@ -47,6 +47,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--tls-cert", default=None,
                    help="PEM certificate chain; serves HTTPS (and TLS gRPC)")
     p.add_argument("--tls-key", default=None, help="PEM private key")
+    p.add_argument("--encoder-endpoint", default=None,
+                   help="dyn://ns.encoder.encode — enables multimodal chat "
+                        "via a remote encode worker (components/encode.py)")
     return p.parse_args(argv)
 
 
@@ -57,6 +60,7 @@ class ModelWatcher:
         self.rt = rt
         self.models = models
         self.args = ns
+        self.image_encoder = None  # set by amain when --encoder-endpoint
         self._instances: dict[str, set[str]] = {}   # model -> instance keys
         self._pipelines: dict[str, tuple] = {}       # model -> (client, router)
         self._task: asyncio.Task | None = None
@@ -178,6 +182,7 @@ class ModelWatcher:
             stats=stats_fn,
             tool_parser=tool_parser,
             reasoning_parser=reasoning_parser,
+            image_encoder=self.image_encoder,
         )
         self._pipelines[name] = (client, router)
         log.info("model added: %s via %s (router=%s)", name, endpoint, mode)
@@ -209,6 +214,30 @@ async def amain(ns: argparse.Namespace) -> None:
     rt = await DistributedRuntime.create(cfg)
     models = ModelManager()
     watcher = ModelWatcher(rt, models, ns)
+    if ns.encoder_endpoint:
+        # Multimodal: images route to the encode worker pool; embedding
+        # tensors come back over the data plane (the nixl_connect role).
+        import uuid as _uuid
+
+        import numpy as _np
+
+        enc_client = await EndpointClient.create(
+            rt, EndpointId.parse(ns.encoder_endpoint))
+        enc_push = PushRouter(client=enc_client, mode=RouterMode("round_robin"))
+
+        async def image_encoder(imgs: list[bytes]):
+            async for item in enc_push.generate(
+                    {"images": list(imgs)}, _uuid.uuid4().hex):
+                embs = item.get("embeddings")
+                if embs is None:
+                    raise RuntimeError(f"bad encoder response: {item}")
+                return [
+                    _np.frombuffer(e["data"], _np.dtype(e.get("dtype", "float32"))
+                                   ).reshape(e["shape"]).astype(_np.float32)
+                    for e in embs]
+            raise RuntimeError("encoder returned no response")
+
+        watcher.image_encoder = image_encoder
     await watcher.start()
     svc = HttpService(models)
     port = await svc.start(ns.host, ns.port,

@@ -231,7 +231,7 @@ class ModelRunner:
 
     # ------------------------------------------------------------------
     def _build_step_fn(self, b: int, t: int, nblk: int, sp_prefill: bool = False,
-                       fast_greedy: bool = False):
+                       fast_greedy: bool = False, mm: bool = False):
         cfg = self.cfg
         trash_row = self.engine_cfg.max_batch_size
 
@@ -240,16 +240,21 @@ class ModelRunner:
         mesh = self.mesh
 
         def step(params, ck, cv, counts, keys, slot_toks, tokens, q_start, q_len,
-                 bt, slots, temp, top_k, top_p, fp, pp, rp, do_sample, from_slot):
+                 bt, slots, temp, top_k, top_p, fp, pp, rp, do_sample, from_slot,
+                 *mm_args):
             # Device-fed decode input: rows whose previous token was sampled
             # by an in-flight step read it from slot_toks instead of the host
             # tokens array (which holds 0 for them) — XLA's execution order
             # guarantees the producing step has run.
             first = jnp.where(from_slot, slot_toks[slots], tokens[:, 0])
             tokens = tokens.at[:, 0].set(first)
+            emb_override = mm_args[0] if mm else None
+            emb_mask = mm_args[1] if mm else None
             hidden, ck, cv = llama.forward(params, cfg, tokens, q_start, q_len, bt, ck, cv,
                                            attn_impl=attn_impl, moe_impl=moe_impl,
-                                           mesh=mesh, sp_prefill=sp_prefill)
+                                           mesh=mesh, sp_prefill=sp_prefill,
+                                           embed_override=emb_override,
+                                           embed_mask=emb_mask)
             logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
             write_slots = jnp.where(do_sample, slots, trash_row)
             if fast_greedy:
@@ -346,17 +351,18 @@ class ModelRunner:
                        **self._jit_shardings())
 
     def step_fn(self, b: int, t: int, nblk: int, sp_prefill: bool = False,
-                window: int = 1, fast_greedy: bool = False):
-        key = (b, t, nblk, sp_prefill, window, fast_greedy)
+                window: int = 1, fast_greedy: bool = False, mm: bool = False):
+        key = (b, t, nblk, sp_prefill, window, fast_greedy, mm)
         if key not in self._step_fns:
             log.info("compiling step fn B=%d T=%d NBLK=%d sp_prefill=%s W=%d "
-                     "greedy=%s", b, t, nblk, sp_prefill, window, fast_greedy)
+                     "greedy=%s mm=%s", b, t, nblk, sp_prefill, window,
+                     fast_greedy, mm)
             if window > 1:
                 self._step_fns[key] = self._build_window_fn(
                     b, nblk, window, fast_greedy)
             else:
                 self._step_fns[key] = self._build_step_fn(
-                    b, t, nblk, sp_prefill, fast_greedy)
+                    b, t, nblk, sp_prefill, fast_greedy, mm)
         return self._step_fns[key]
 
     def reset_slot(self, slot: int, seed: int | None) -> None:
@@ -439,8 +445,32 @@ class ModelRunner:
             if temp[i] > 0.0 or fp[i] != 0.0 or pp[i] != 0.0 or rp[i] != 1.0:
                 fast_greedy = False
 
-        fn = self.step_fn(b, t, nblk, sp_prefill, window, fast_greedy)
+        # Multimodal: chunks intersecting an embedding span carry the
+        # encoder outputs for those positions. NOT gated on t>1 — a
+        # length-1 prefill tail (chunk budget, prefix-cache hit leaving one
+        # token) can land inside a span, and serving the placeholder
+        # embedding there would poison the digest-keyed prefix cache.
+        # Decode/window rows start at/after the prompt end, so they never
+        # intersect and mm stays False for them naturally.
+        emb_override = None
+        for i, (seq, start, length) in enumerate(rows):
+            for pos, emb in getattr(seq, "mm_spans", ()):
+                lo = max(pos, start)
+                hi = min(pos + emb.shape[0], start + length)
+                if lo >= hi:
+                    continue
+                if emb_override is None:
+                    emb_override = np.zeros(
+                        (b, t, self.cfg.hidden_size), np.float32)
+                    emb_mask = np.zeros((b, t), bool)
+                emb_override[i, lo - start:hi - start] = \
+                    emb[lo - pos:hi - pos]
+                emb_mask[i, lo - start:hi - start] = True
+        mm = emb_override is not None
+
+        fn = self.step_fn(b, t, nblk, sp_prefill, window, fast_greedy, mm)
         place = self._place
+        extra = ((place(emb_override), place(emb_mask)) if mm else ())
         (self.cache_k, self.cache_v, self.counts, self.keys, self.slot_toks,
          toks, lps) = fn(
             self.params, self.cache_k, self.cache_v, self.counts, self.keys,
@@ -449,7 +479,7 @@ class ModelRunner:
             place(bt), place(slots), place(temp),
             place(top_k), place(top_p), place(fp),
             place(pp), place(rp), place(do_sample),
-            place(from_slot),
+            place(from_slot), *extra,
         )
         return toks, lps
 
@@ -734,6 +764,32 @@ class EngineCore:
                 finish_reason=FinishReason.ERROR, error="empty prompt (no token_ids)"
             )
         seq = Seq(req=req, block_size=self.engine_cfg.block_size)
+        if req.mm_embeddings:
+            if self.engine_cfg.sp > 1 or self.engine_cfg.pp > 1:
+                return LLMEngineOutput(
+                    finish_reason=FinishReason.ERROR,
+                    error="multimodal requests require sp=1 and pp=1 "
+                          "(the ring/pipeline prefill paths have no "
+                          "embedding-override input yet)")
+            try:
+                seq.mm_spans = [
+                    (int(s["pos"]), np.frombuffer(
+                        s["data"], np.dtype(s.get("dtype", "float32"))
+                    ).reshape(s["shape"]).astype(np.float32))
+                    for s in req.mm_embeddings]
+            except Exception as exc:  # noqa: BLE001 - malformed client input
+                return LLMEngineOutput(
+                    finish_reason=FinishReason.ERROR,
+                    error=f"bad mm_embeddings payload: {exc}")
+            H = self.model_cfg.hidden_size
+            for pos, emb in seq.mm_spans:
+                if (emb.ndim != 2 or emb.shape[1] != H or pos < 0
+                        or pos + emb.shape[0] > len(req.token_ids)):
+                    return LLMEngineOutput(
+                        finish_reason=FinishReason.ERROR,
+                        error=f"mm span (pos={pos}, shape={emb.shape}) out of "
+                              f"range for prompt len {len(req.token_ids)} / "
+                              f"hidden {H}")
         self.sched.add(seq)
         if seq.phase is Phase.FINISHED:  # rejected (too long for model or pool)
             return LLMEngineOutput(

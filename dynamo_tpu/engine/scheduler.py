@@ -60,6 +60,9 @@ class Seq:
     # A speculative verify step is in flight: the scheduler must not plan
     # this seq again until finalize accepts/rolls back (engine/spec.py).
     verify_inflight: bool = False
+    # Multimodal embedding spans [(pos, np.ndarray[K, H])]: encoder outputs
+    # injected at prompt positions during prefill (engine dispatch).
+    mm_spans: list = field(default_factory=list)
 
     def __post_init__(self) -> None:
         self.tokens = list(self.req.token_ids)

@@ -37,6 +37,9 @@ class ModelEntry:
     reasoning_parser: str | None = None
     # async callable: list[list[int]] -> [N, H] array (None = unsupported)
     embed: "Callable | None" = None
+    # async callable: list[bytes] (image files) -> list of [K, H] float32
+    # embeddings (None = multimodal unsupported for this model)
+    image_encoder: "Callable | None" = None
 
 
 class ModelManager:
@@ -54,6 +57,7 @@ class ModelManager:
         tool_parser: str | None = None,
         reasoning_parser: str | None = None,
         embed: Callable | None = None,
+        image_encoder: Callable | None = None,
     ) -> ModelEntry:
         # Fail fast on bad parser names — a typo'd --tool-call-parser must
         # surface at registration, not mid-SSE-stream on the first request.
@@ -77,6 +81,7 @@ class ModelManager:
             tool_parser=tool_parser,
             reasoning_parser=reasoning_parser,
             embed=embed,
+            image_encoder=image_encoder,
         )
         self._models[name] = entry
         return entry

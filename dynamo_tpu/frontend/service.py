@@ -52,6 +52,33 @@ def _error(status: int, message: str) -> web.Response:
 
 
 
+def _extract_image_bytes(messages) -> list[bytes]:
+    """Image bytes from OpenAI list-content messages, in reading order.
+    Only base64 data URLs are accepted (this serving tier has no business
+    fetching remote URLs — zero-egress deployments are the TPU norm)."""
+    import base64
+
+    out: list[bytes] = []
+    for m in messages:
+        content = getattr(m, "content", None)
+        if not isinstance(content, list):
+            continue
+        for part in content:
+            if not isinstance(part, dict) or part.get("type") != "image_url":
+                continue
+            url = (part.get("image_url") or {}).get("url", "")
+            if not url.startswith("data:"):
+                raise ValueError(
+                    "only data: URLs are supported for image_url content "
+                    "(remote fetch is not performed by the server)")
+            _, _, payload = url.partition(",")
+            try:
+                out.append(base64.b64decode(payload, validate=True))
+            except Exception as exc:
+                raise ValueError(f"invalid image data URL: {exc}") from None
+    return out
+
+
 def _wants_logprobs(req, chat: bool) -> bool:
     """THE chat-vs-completions logprob acceptance rule, in one place:
     chat uses a boolean flag; completions uses an int where 0 still means
@@ -360,9 +387,32 @@ class HttpService:
             return _error(404, f"model '{req.model}' not found (have: {self.models.names()})")
 
         request_id = request.headers.get("x-request-id") or uuid.uuid4().hex
+        images = None
+        if chat:
+            try:
+                img_bytes = _extract_image_bytes(req.messages)
+            except ValueError as exc:
+                self._requests.inc(route=route, status="400")
+                return _error(400, str(exc))
+            if img_bytes:
+                if entry.image_encoder is None:
+                    self._requests.inc(route=route, status="501")
+                    return _error(501, f"model '{req.model}' has no image "
+                                       "encoder configured")
+                try:
+                    images = await entry.image_encoder(img_bytes)
+                except RuntimeError as exc:
+                    # infrastructure failure (encode worker pool down /
+                    # no response) — the CLIENT's request is fine: 502
+                    self._requests.inc(route=route, status="502")
+                    return _error(502, f"image encoder unavailable: {exc}")
+                except Exception as exc:  # noqa: BLE001 - bad image payload
+                    self._requests.inc(route=route, status="400")
+                    return _error(400, f"image encoding failed: {exc}")
         try:
             if chat:
-                pre = entry.preprocessor.preprocess_chat(req, request_id)
+                pre = entry.preprocessor.preprocess_chat(req, request_id,
+                                                         images=images)
             else:
                 pre = entry.preprocessor.preprocess_completion(req, request_id)
         except Exception as exc:

@@ -74,6 +74,10 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                    help="tool-call parser name (hermes, mistral, llama3_json, ...)")
     p.add_argument("--reasoning-parser", default=None,
                    help="reasoning parser name (basic, deepseek_r1, ...)")
+    p.add_argument("--mm-image-tokens", type=int, default=0,
+                   help="enable multimodal chat: run an in-process vision "
+                        "encoder producing this many embedding tokens per "
+                        "image (0 = multimodal off)")
     ns = p.parse_args(rest)
     ns.in_mode, ns.out_mode = in_mode, out_mode
     return ns
@@ -111,6 +115,20 @@ def build_local_engine(ns: argparse.Namespace) -> tuple[AsyncJaxEngine, EngineCo
 async def run_http(ns: argparse.Namespace) -> None:
     engine, cfg = build_local_engine(ns)
     tok = load_tokenizer(ns.tokenizer or ns.model)
+    image_encoder = None
+    if ns.mm_image_tokens > 0:
+        from dynamo_tpu.models.config import resolve_model_config
+        from dynamo_tpu.models.vision import VisionConfig, VisionEncoder
+
+        venc = VisionEncoder(VisionConfig(
+            num_image_tokens=ns.mm_image_tokens,
+            lm_hidden_size=resolve_model_config(cfg.model).hidden_size))
+        loop = asyncio.get_event_loop()
+
+        async def image_encoder(imgs: list[bytes]):
+            out = await loop.run_in_executor(None, venc.encode, imgs)
+            return [out[i] for i in range(len(imgs))]
+
     models = ModelManager()
     models.register(
         ns.model, tok, engine.generate,
@@ -119,6 +137,7 @@ async def run_http(ns: argparse.Namespace) -> None:
         tool_parser=ns.tool_call_parser,
         reasoning_parser=ns.reasoning_parser,
         embed=engine.embed,
+        image_encoder=image_encoder,
     )
     svc = HttpService(models)
     await svc.start(ns.host, ns.port)

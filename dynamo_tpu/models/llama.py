@@ -252,6 +252,8 @@ def forward(
     mesh=None,
     sp_prefill: bool = False,
     return_all_hidden: bool = False,
+    embed_override: jax.Array | None = None,  # [B, T, H] multimodal embeds
+    embed_mask: jax.Array | None = None,      # [B, T] True → use override
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One engine step. Returns (last_hidden [B,H], cache_k, cache_v) —
     or (hidden [B,T,H], ...) with ``return_all_hidden`` (the speculative
@@ -309,6 +311,11 @@ def forward(
     slot = jnp.where(valid, blk * bs + positions % bs, 0)
 
     h = params["embed"][token_ids].astype(_dtype(cfg))             # [B, T, H]
+    if embed_override is not None:
+        # Multimodal positions carry encoder outputs instead of token
+        # embeddings (their placeholder ids exist only for position/hash
+        # bookkeeping — see preprocessor digest-salted placeholders).
+        h = jnp.where(embed_mask[..., None], embed_override.astype(h.dtype), h)
 
     def layer_fn(carry, xs):
         hid = carry

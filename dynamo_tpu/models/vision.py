@@ -1,0 +1,139 @@
+"""Vision encoder: images → a fixed number of LM-space embedding tokens.
+
+Fills the multimodal-encode role of the reference's encode workers
+(reference: components/src/dynamo/sglang multimodal processor/encode
+workers; trtllm/encode_helper.py) — the model itself is TPU-first: a
+small ViT expressed as plain jitted JAX (patchify → linear → pre-norm
+transformer blocks → learned query pooling to ``num_image_tokens``
+LM-hidden-size vectors), MXU-friendly batched matmuls throughout, no
+dynamic shapes (images are resized to a fixed grid on the host).
+
+Like ``tiny-llama``, weights are seed-deterministic random unless a
+checkpoint is provided — the wiring (encode worker → data-plane embedding
+transfer → prefill injection) is the framework capability under test;
+swapping in real CLIP/SigLIP weights is a loader exercise.
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 64           # input resized to image_size x image_size
+    patch_size: int = 16
+    hidden_size: int = 128         # ViT width
+    num_layers: int = 2
+    num_heads: int = 4
+    num_image_tokens: int = 8      # pooled output tokens
+    lm_hidden_size: int = 64       # target LM hidden (tiny-llama default)
+    seed: int = 7
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * 3
+
+
+def init_vision_params(cfg: VisionConfig) -> dict:
+    k = iter(jax.random.split(jax.random.key(cfg.seed), 32))
+    h = cfg.hidden_size
+
+    def dense(shape, fan_in):
+        return jax.random.normal(next(k), shape, jnp.float32) * (fan_in ** -0.5)
+
+    layers = []
+    for _ in range(cfg.num_layers):
+        layers.append({
+            "wq": dense((h, h), h), "wk": dense((h, h), h),
+            "wv": dense((h, h), h), "wo": dense((h, h), h),
+            "w1": dense((h, 4 * h), h), "w2": dense((4 * h, h), 4 * h),
+            "ln1": jnp.ones((h,)), "ln2": jnp.ones((h,)),
+        })
+    return {
+        "patch_proj": dense((cfg.patch_dim, h), cfg.patch_dim),
+        "pos": dense((cfg.num_patches, h), h),
+        "queries": dense((cfg.num_image_tokens, h), h),
+        "out_proj": dense((h, cfg.lm_hidden_size), h),
+        "final_ln": jnp.ones((h,)),
+        "layers": layers,
+    }
+
+
+def _ln(x, g, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g
+
+
+def _attn(x, q_in, wq, wk, wv, wo, num_heads):
+    """Cross(or self)-attention: queries q_in attend over x."""
+    B, N, H = x.shape
+    M = q_in.shape[1]
+    d = H // num_heads
+    q = (q_in @ wq).reshape(B, M, num_heads, d).transpose(0, 2, 1, 3)
+    kk = (x @ wk).reshape(B, N, num_heads, d).transpose(0, 2, 1, 3)
+    v = (x @ wv).reshape(B, N, num_heads, d).transpose(0, 2, 1, 3)
+    a = jax.nn.softmax((q @ kk.transpose(0, 1, 3, 2)) * (d ** -0.5), axis=-1)
+    o = (a @ v).transpose(0, 2, 1, 3).reshape(B, M, H)
+    return o @ wo
+
+
+def encode_patches(params: dict, cfg: VisionConfig,
+                   patches: jax.Array) -> jax.Array:
+    """[B, num_patches, patch_dim] float32 → [B, num_image_tokens, lm_H]."""
+    x = patches @ params["patch_proj"] + params["pos"][None]
+    for lp in params["layers"]:
+        xn = _ln(x, lp["ln1"])
+        x = x + _attn(xn, xn, lp["wq"], lp["wk"], lp["wv"], lp["wo"],
+                      cfg.num_heads)
+        xn = _ln(x, lp["ln2"])
+        x = x + jax.nn.gelu(xn @ lp["w1"]) @ lp["w2"]
+    x = _ln(x, params["final_ln"])
+    # learned-query pooling to a fixed token count
+    q = jnp.broadcast_to(params["queries"][None],
+                         (x.shape[0], cfg.num_image_tokens, cfg.hidden_size))
+    lp0 = params["layers"][0]
+    pooled = _attn(x, q, lp0["wq"], lp0["wk"], lp0["wv"], lp0["wo"],
+                   cfg.num_heads)
+    return pooled @ params["out_proj"]
+
+
+class VisionEncoder:
+    """Host-facing encoder: decodes/preps images, runs the jitted model."""
+
+    def __init__(self, cfg: VisionConfig | None = None):
+        self.cfg = cfg or VisionConfig()
+        self.params = init_vision_params(self.cfg)
+        self._fn = jax.jit(lambda p, x: encode_patches(p, self.cfg, x))
+
+    def _to_patches(self, img: "np.ndarray") -> np.ndarray:
+        c = self.cfg
+        P, G = c.patch_size, c.image_size // c.patch_size
+        x = img.astype(np.float32) / 255.0
+        x = x.reshape(G, P, G, P, 3).transpose(0, 2, 1, 3, 4)
+        return x.reshape(c.num_patches, c.patch_dim)
+
+    def decode_image(self, data: bytes) -> np.ndarray:
+        """PNG/JPEG bytes → fixed-size RGB array (host-side resize keeps
+        the jitted model's shapes static)."""
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(data)).convert("RGB")
+        img = img.resize((self.cfg.image_size, self.cfg.image_size))
+        return np.asarray(img)
+
+    def encode(self, images: list[bytes]) -> np.ndarray:
+        """Image bytes → [N, num_image_tokens, lm_hidden] float32."""
+        patches = np.stack([self._to_patches(self.decode_image(b))
+                            for b in images])
+        return np.asarray(self._fn(self.params, patches), np.float32)

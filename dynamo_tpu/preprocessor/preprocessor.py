@@ -10,6 +10,8 @@ from backend output (see frontend/delta.py).
 from __future__ import annotations
 
 from dataclasses import dataclass
+
+import numpy as np
 from typing import Any
 
 from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
@@ -64,15 +66,43 @@ class OpenAIPreprocessor:
         )
 
     # ------------------------------------------------------------------
-    def preprocess_chat(self, req: ChatCompletionRequest, request_id: str | None = None) -> PreprocessedRequest:
+    # Sentinel survives any chat template verbatim; replaced token-wise.
+    MM_SENTINEL = "␟IMG␟"
+
+    def preprocess_chat(self, req: ChatCompletionRequest, request_id: str | None = None,
+                        images: "list[np.ndarray] | None" = None) -> PreprocessedRequest:
+        """``images``: pre-encoded embeddings ([K, H] float32 per image, in
+        reading order) matching the request's image content parts — the
+        caller runs the vision encoder (in-process or the encode worker);
+        this stage owns PLACEMENT: image parts become sentinel text, the
+        rendered prompt is tokenized piecewise around the sentinels, and
+        each image's span gets digest-salted placeholder ids (same image →
+        same ids → the prefix cache reuses image prefixes; different image
+        → different hash chain, never aliased). Reference role: the
+        multimodal processors of components/src/dynamo/sglang + the
+        encode→PD embedding handoff of dynamo.nixl_connect."""
         use_raw = bool(req.nvext and req.nvext.use_raw_prompt)
         messages = [m.model_dump(exclude_none=True) for m in req.messages]
+        n_image_parts = self._flatten_image_parts(messages)
+        if images is None:
+            images = []
+        if n_image_parts != len(images):
+            raise ValueError(
+                f"request has {n_image_parts} image part(s) but "
+                f"{len(images)} encoded image(s) were supplied")
+        if use_raw and images:
+            raise ValueError("use_raw_prompt does not support image content")
         if use_raw and messages and isinstance(messages[-1].get("content"), str):
             prompt = messages[-1]["content"]
         else:
             prompt = self.tokenizer.apply_chat_template(
                 messages, add_generation_prompt=True, tools=req.tools)
-        token_ids = self.tokenizer.encode(prompt, add_bos=True)
+
+        mm_embeddings: list[dict] | None = None
+        if images:
+            token_ids, mm_embeddings = self._tokenize_with_images(prompt, images)
+        else:
+            token_ids = self.tokenizer.encode(prompt, add_bos=True)
         out = PreprocessedRequest(
             token_ids=token_ids,
             model=req.model,
@@ -80,10 +110,69 @@ class OpenAIPreprocessor:
             sampling_options=self._sampling(req),
             eos_token_ids=list(self.defaults.eos_token_ids or []),
             annotations={"formatted_prompt": prompt} if (req.nvext and req.nvext.annotations) else {},
+            mm_embeddings=mm_embeddings,
         )
         if request_id:
             out.request_id = request_id
         return out
+
+    def _flatten_image_parts(self, messages: list[dict]) -> int:
+        """Returns the image-part count. ONLY when images are present are
+        list-content messages flattened (text parts concatenate, image
+        parts become sentinels; user text is scrubbed of the sentinel so
+        adversarial content can't relocate embeddings or truncate the
+        prompt) — text-only requests keep their original content shape for
+        the chat template."""
+        n = sum(1 for m in messages if isinstance(m.get("content"), list)
+                for part in m["content"]
+                if isinstance(part, dict) and part.get("type") == "image_url")
+        if n == 0:
+            return 0
+        for m in messages:
+            content = m.get("content")
+            if isinstance(content, str):
+                m["content"] = content.replace(self.MM_SENTINEL, "")
+                continue
+            if not isinstance(content, list):
+                continue
+            pieces: list[str] = []
+            for part in content:
+                ptype = part.get("type")
+                if ptype == "text":
+                    pieces.append(
+                        part.get("text", "").replace(self.MM_SENTINEL, ""))
+                elif ptype == "image_url":
+                    pieces.append(self.MM_SENTINEL)
+            m["content"] = "".join(pieces)
+        return n
+
+    def _tokenize_with_images(self, prompt: str, images: "list[np.ndarray]"
+                              ) -> tuple[list[int], list[dict]]:
+        import xxhash
+
+        pieces = prompt.split(self.MM_SENTINEL)
+        if len(pieces) - 1 != len(images):
+            # belt: _flatten_image_parts scrubs user sentinels, so any
+            # mismatch here is a template mangling the sentinel
+            raise ValueError(
+                f"prompt rendered {len(pieces) - 1} image slot(s) for "
+                f"{len(images)} image(s)")
+        token_ids = self.tokenizer.encode(pieces[0], add_bos=True)
+        spans: list[dict] = []
+        vocab = getattr(self.tokenizer, "vocab_size", None) or 1 << 20
+        for img, piece in zip(images, pieces[1:]):
+            emb = np.ascontiguousarray(img, np.float32)
+            k = emb.shape[0]
+            digest = xxhash.xxh3_64_intdigest(emb.tobytes())
+            # digest-salted placeholders: position/hash bookkeeping only —
+            # the forward overrides these positions with the embeddings
+            placeholders = [(digest + j) % max(vocab - 1, 1) for j in range(k)]
+            spans.append({"pos": len(token_ids), "data": emb.tobytes(),
+                          "shape": list(emb.shape), "dtype": "float32"})
+            token_ids.extend(placeholders)
+            if piece:
+                token_ids.extend(self.tokenizer.encode(piece, add_bos=False))
+        return token_ids, spans
 
     def preprocess_completion(self, req: CompletionRequest, request_id: str | None = None) -> PreprocessedRequest:
         prompt = req.prompt

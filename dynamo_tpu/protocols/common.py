@@ -92,6 +92,13 @@ class PreprocessedRequest:
     kv_transfer_params: dict[str, Any] | None = None
     # router hint: precomputed block hashes (filled by KV router when available)
     estimated_prefix_hit_blocks: int = 0
+    # Multimodal embedding spans: [{"pos": int, "data": bytes,
+    # "shape": [K, H], "dtype": "float32"}] — encoder outputs injected at
+    # prompt positions pos..pos+K-1 (their token ids are digest-salted
+    # placeholders). msgpack-friendly, so the spans ride the SAME data
+    # plane as the request — the nixl_connect tensor-transfer role
+    # (reference: lib/bindings/python/src/dynamo/nixl_connect).
+    mm_embeddings: list[dict] | None = None
 
     def to_dict(self) -> dict:
         return {
@@ -104,6 +111,7 @@ class PreprocessedRequest:
             "annotations": self.annotations,
             "kv_transfer_params": self.kv_transfer_params,
             "estimated_prefix_hit_blocks": self.estimated_prefix_hit_blocks,
+            "mm_embeddings": self.mm_embeddings,
         }
 
     @classmethod
@@ -118,6 +126,7 @@ class PreprocessedRequest:
             annotations=dict(d.get("annotations") or {}),
             kv_transfer_params=d.get("kv_transfer_params"),
             estimated_prefix_hit_blocks=d.get("estimated_prefix_hit_blocks", 0),
+            mm_embeddings=d.get("mm_embeddings"),
         )
 
 

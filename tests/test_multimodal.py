@@ -1,0 +1,337 @@
+"""Multimodal: vision encoder → embedding injection → serving (reference:
+components/src/dynamo/sglang multimodal encode workers + the
+dynamo.nixl_connect encode→PD embedding transfer): encoder determinism,
+engine-level embedding-override correctness, digest-salted prefix-cache
+behavior, the HTTP surface (data-URL images, in-process encoder), and the
+distributed encode-worker path over the data plane.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.engine import EngineCore
+from dynamo_tpu.models.vision import VisionConfig, VisionEncoder
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+from tests.test_engine import run_to_completion, tiny_config
+from tests.utils_process import ManagedProcess, free_port
+
+
+def png_bytes(seed: int, size: int = 48) -> bytes:
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    img = Image.fromarray(rng.integers(0, 255, (size, size, 3), np.uint8))
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def encoder():
+    return VisionEncoder(VisionConfig(num_image_tokens=4, lm_hidden_size=64))
+
+
+def mm_req(emb: np.ndarray, rid: str, prefix=(5, 6, 7), suffix=(9, 10),
+           max_tokens=8) -> PreprocessedRequest:
+    """prompt = prefix + K placeholders + suffix, embeddings at the span."""
+    import xxhash
+
+    k = emb.shape[0]
+    digest = xxhash.xxh3_64_intdigest(np.ascontiguousarray(emb).tobytes())
+    placeholders = [(digest + j) % 500 for j in range(k)]
+    toks = [*prefix, *placeholders, *suffix]
+    r = PreprocessedRequest(
+        token_ids=toks,
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0),
+        mm_embeddings=[{"pos": len(prefix), "data": emb.astype(np.float32).tobytes(),
+                        "shape": list(emb.shape), "dtype": "float32"}],
+    )
+    r.request_id = rid
+    return r
+
+
+def test_encoder_deterministic_and_shaped(encoder):
+    a1 = encoder.encode([png_bytes(1)])
+    a2 = encoder.encode([png_bytes(1)])
+    b = encoder.encode([png_bytes(2)])
+    assert a1.shape == (1, 4, 64)
+    np.testing.assert_array_equal(a1, a2)
+    assert np.abs(a1 - b).max() > 0  # different image → different embedding
+    assert np.isfinite(a1).all()
+
+
+def test_engine_injects_embeddings(encoder):
+    """Same prompt tokens, different embeddings → different greedy streams;
+    same embeddings → identical streams (the injection is real and
+    deterministic)."""
+    emb_a = encoder.encode([png_bytes(1)])[0]
+    emb_b = encoder.encode([png_bytes(2)])[0]
+
+    def run(emb, rid):
+        core = EngineCore(tiny_config())
+        out, _ = run_to_completion(core, [mm_req(emb, rid)])
+        return out[rid]
+
+    s_a1 = run(emb_a, "a1")
+    s_a2 = run(emb_a, "a2")
+    s_b = run(emb_b, "b")
+    assert s_a1 == s_a2
+    assert s_a1 != s_b, "embeddings had no effect on the forward pass"
+
+
+def test_mm_prefix_cache_digest_salting(encoder):
+    """Same image+text re-served → prefix hit; a different image shares NO
+    prefix (digest-salted placeholder ids split the hash chains)."""
+    emb_a = encoder.encode([png_bytes(1)])[0]
+    emb_b = encoder.encode([png_bytes(2)])[0]
+    core = EngineCore(tiny_config(num_blocks=64))
+    first, _ = run_to_completion(core, [mm_req(emb_a, "x1", max_tokens=4)])
+    hits0 = core.metrics.prefix_hit_blocks
+    second, _ = run_to_completion(core, [mm_req(emb_a, "x2", max_tokens=4)])
+    assert core.metrics.prefix_hit_blocks > hits0, "no reuse for same image"
+    assert second["x2"] == first["x1"]
+    hits1 = core.metrics.prefix_hit_blocks
+    run_to_completion(core, [mm_req(emb_b, "y", max_tokens=4)])
+    assert core.metrics.prefix_hit_blocks == hits1, \
+        "different image aliased the cached prefix"
+
+
+def test_mm_validation_errors(encoder):
+    core = EngineCore(tiny_config())
+    emb = encoder.encode([png_bytes(3)])[0]
+    # span past the prompt end
+    bad = mm_req(emb, "bad", prefix=(5,), suffix=())
+    bad.mm_embeddings[0]["pos"] = 5
+    out = core.add_request(bad)
+    assert out is not None and "out of range" in out.error
+    # wrong hidden size
+    bad2 = mm_req(np.zeros((4, 32), np.float32), "bad2")
+    out2 = core.add_request(bad2)
+    assert out2 is not None and "out of range" in out2.error
+
+
+async def test_http_multimodal_chat_in_process():
+    """launch-style single process: data-URL image through the real HTTP
+    service with the in-process encoder; deterministic, image-sensitive."""
+    import aiohttp
+
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.frontend.model_manager import ModelManager
+    from dynamo_tpu.frontend.service import HttpService
+    from dynamo_tpu.preprocessor.preprocessor import ModelDefaults
+    from dynamo_tpu.tokenizer import ByteTokenizer
+
+    engine = AsyncJaxEngine(EngineCore(tiny_config()))
+    venc = VisionEncoder(VisionConfig(num_image_tokens=4, lm_hidden_size=64))
+
+    async def image_encoder(imgs):
+        out = venc.encode(list(imgs))
+        return [out[i] for i in range(len(imgs))]
+
+    models = ModelManager()
+    models.register("mm", ByteTokenizer(), engine.generate,
+                    defaults=ModelDefaults(), image_encoder=image_encoder)
+    svc = HttpService(models)
+    port = await svc.start(port=0)
+    base = f"http://127.0.0.1:{port}"
+
+    def body(seed):
+        url = "data:image/png;base64," + base64.b64encode(
+            png_bytes(seed)).decode()
+        # logprobs expose the raw per-token evidence — detokenized text of
+        # different token ids can collide on replacement characters
+        return {"model": "mm", "max_tokens": 6, "temperature": 0,
+                "logprobs": True,
+                "messages": [{"role": "user", "content": [
+                    {"type": "text", "text": "describe "},
+                    {"type": "image_url", "image_url": {"url": url}},
+                ]}]}
+
+    def lps(resp):
+        return [e["logprob"]
+                for e in resp["choices"][0]["logprobs"]["content"]]
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            r1 = await (await s.post(f"{base}/v1/chat/completions",
+                                     json=body(1))).json()
+            r2 = await (await s.post(f"{base}/v1/chat/completions",
+                                     json=body(1))).json()
+            r3 = await (await s.post(f"{base}/v1/chat/completions",
+                                     json=body(2))).json()
+            assert r1["choices"][0]["finish_reason"] == "length"
+            assert lps(r1) == lps(r2)
+            assert lps(r1) != lps(r3), "image had no effect on the output"
+
+            # remote URLs are refused; model without encoder → 501
+            bad = body(1)
+            bad["messages"][0]["content"][1]["image_url"]["url"] = \
+                "https://example.com/x.png"
+            r = await s.post(f"{base}/v1/chat/completions", json=bad)
+            assert r.status == 400
+            models.register("textonly", ByteTokenizer(), engine.generate,
+                            defaults=ModelDefaults())
+            b2 = body(1)
+            b2["model"] = "textonly"
+            r = await s.post(f"{base}/v1/chat/completions", json=b2)
+            assert r.status == 501
+    finally:
+        await svc.stop()
+        await engine.shutdown()
+
+
+@pytest.mark.slow
+def test_distributed_encode_worker_e2e():
+    """Full multimodal topology: encode worker + jax worker + frontend —
+    image embeddings cross the data plane to the frontend, ride the
+    request to the engine worker, and shape the output."""
+    import json
+    import urllib.request
+
+    coord_port, http_port = free_port(), free_port()
+    coordinator = ManagedProcess(
+        ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
+         "--port", str(coord_port)], name="coordinator").start()
+    url = f"tcp://127.0.0.1:{coord_port}"
+    time.sleep(1.0)
+    worker = ManagedProcess(
+        ["-m", "dynamo_tpu.components.worker", "--engine", "jax",
+         "--coordinator", url, "--model", "tiny-llama", "--block-size", "4",
+         "--num-blocks", "128", "--max-model-len", "256",
+         "--max-batch-size", "4"], name="worker").start()
+    encode = ManagedProcess(
+        ["-m", "dynamo_tpu.components.encode", "--coordinator", url,
+         "--image-tokens", "4", "--lm-hidden", "64"], name="encode").start()
+    frontend = None
+    try:
+        worker.wait_for_line("WORKER_READY", 120)
+        encode.wait_for_line("ENCODE_READY", 60)
+        frontend = ManagedProcess(
+            ["-m", "dynamo_tpu.components.frontend", "--coordinator", url,
+             "--host", "127.0.0.1", "--port", str(http_port),
+             "--encoder-endpoint", "dyn://dynamo.encoder.encode"],
+            name="frontend").start()
+        frontend.wait_for_line("FRONTEND_READY", 30)
+        base = f"http://127.0.0.1:{http_port}"
+
+        def post(payload, timeout=60):
+            req = urllib.request.Request(
+                base + "/v1/chat/completions",
+                data=json.dumps(payload).encode(),
+                headers={"content-type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read())
+
+        img = "data:image/png;base64," + base64.b64encode(
+            png_bytes(5)).decode()
+        payload = {"model": "tiny-llama", "max_tokens": 5, "temperature": 0,
+                   "logprobs": True,
+                   "messages": [{"role": "user", "content": [
+                       {"type": "text", "text": "look: "},
+                       {"type": "image_url", "image_url": {"url": img}}]}]}
+        deadline = time.time() + 60
+        resp = None
+        while time.time() < deadline:
+            try:
+                resp = post(payload)
+                break
+            except Exception:
+                time.sleep(1.0)
+        assert resp is not None, "multimodal request never served"
+        assert resp["choices"][0]["finish_reason"] == "length"
+
+        def lps(r):
+            return [e["logprob"]
+                    for e in r["choices"][0]["logprobs"]["content"]]
+
+        # deterministic across repeats, sensitive to the image
+        again = post(payload)
+        assert lps(again) == lps(resp)
+        payload2 = json.loads(json.dumps(payload))
+        payload2["messages"][0]["content"][1]["image_url"]["url"] = (
+            "data:image/png;base64," + base64.b64encode(png_bytes(6)).decode())
+        other = post(payload2)
+        assert lps(other) != lps(resp), "image had no effect on the output"
+    finally:
+        if frontend:
+            frontend.stop()
+        encode.stop()
+        worker.stop()
+        coordinator.stop()
+
+
+def test_sentinel_injection_is_scrubbed(encoder):
+    """Adversarial user text containing the internal sentinel must neither
+    relocate embeddings nor truncate the prompt."""
+    from dynamo_tpu.frontend.model_manager import ModelManager
+    from dynamo_tpu.preprocessor.preprocessor import ModelDefaults, OpenAIPreprocessor
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.tokenizer import ByteTokenizer
+
+    pre = OpenAIPreprocessor("m", ByteTokenizer(), ModelDefaults())
+    emb = encoder.encode([png_bytes(9)])[0]
+    req = ChatCompletionRequest(model="m", messages=[{
+        "role": "user", "content": [
+            {"type": "text", "text": f"A{pre.MM_SENTINEL}B "},
+            {"type": "image_url", "image_url": {"url": "data:,x"}},
+            {"type": "text", "text": " tail"},
+        ]}])
+    out = pre.preprocess_chat(req, "r1", images=[emb])
+    assert out.mm_embeddings is not None and len(out.mm_embeddings) == 1
+    # the span sits where the IMAGE part was; tail text survived
+    span = out.mm_embeddings[0]
+    k = span["shape"][0]
+    assert span["pos"] + k < len(out.token_ids)  # tail tokens follow the span
+    text = ByteTokenizer().decode([t for t in out.token_ids])
+    assert "tail" in text and "AB" in text.replace("\x01", "")
+
+
+def test_text_only_list_content_not_flattened():
+    """Without images, list-content messages keep their structure for the
+    chat template (no silent flattening for existing clients)."""
+    from dynamo_tpu.preprocessor.preprocessor import ModelDefaults, OpenAIPreprocessor
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.tokenizer import ByteTokenizer
+
+    class SpyTok(ByteTokenizer):
+        def apply_chat_template(self, messages, add_generation_prompt=True,
+                                tools=None):
+            self.seen = [m.get("content") for m in messages]
+            return super().apply_chat_template(messages,
+                                               add_generation_prompt, tools)
+
+    tok = SpyTok()
+    pre = OpenAIPreprocessor("m", tok, ModelDefaults())
+    req = ChatCompletionRequest(model="m", messages=[{
+        "role": "user", "content": [{"type": "text", "text": "hello"}]}])
+    pre.preprocess_chat(req, "r2")
+    assert isinstance(tok.seen[0], list), "text-only list content was flattened"
+
+
+def test_use_raw_prompt_rejects_images(encoder):
+    from dynamo_tpu.preprocessor.preprocessor import ModelDefaults, OpenAIPreprocessor
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.tokenizer import ByteTokenizer
+
+    pre = OpenAIPreprocessor("m", ByteTokenizer(), ModelDefaults())
+    emb = encoder.encode([png_bytes(9)])[0]
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[{"role": "user", "content": [
+            {"type": "image_url", "image_url": {"url": "data:,x"}},
+            {"type": "text", "text": "hi"}]}],
+        nvext={"use_raw_prompt": True})
+    with pytest.raises(ValueError, match="use_raw_prompt"):
+        pre.preprocess_chat(req, "r3", images=[emb])
