@@ -51,16 +51,22 @@ async def amain(ns: argparse.Namespace) -> None:
     loop = asyncio.get_running_loop()
 
     async def handler(payload: dict, ctx):
+        from dynamo_tpu.protocols.common import tensor_to_wire
+
         images = payload.get("images", [])
         if not images:
             yield {"embeddings": []}
             return
-        # jit-compiled encode off-loop; batched over the request's images
-        arr = await loop.run_in_executor(None, encoder.encode, list(images))
-        yield {"embeddings": [
-            {"data": arr[i].astype("float32").tobytes(),
-             "shape": list(arr[i].shape), "dtype": "float32"}
-            for i in range(len(images))]}
+        try:
+            # jit-compiled encode off-loop; batched over the request's images
+            arr = await loop.run_in_executor(None, encoder.encode, list(images))
+        except Exception as exc:  # noqa: BLE001 - bad image bytes (PIL)
+            # a structured client error — the frontend maps it to 400, not
+            # to a 502 "encoder unavailable"
+            yield {"error": f"bad image: {exc}"}
+            return
+        yield {"embeddings": [tensor_to_wire(arr[i])
+                              for i in range(len(images))]}
 
     ep = rt.namespace(ns.namespace).component(ns.component).endpoint(ns.endpoint)
     await ep.serve(handler)

@@ -219,7 +219,7 @@ async def amain(ns: argparse.Namespace) -> None:
         # tensors come back over the data plane (the nixl_connect role).
         import uuid as _uuid
 
-        import numpy as _np
+        from dynamo_tpu.protocols.common import tensor_from_wire
 
         enc_client = await EndpointClient.create(
             rt, EndpointId.parse(ns.encoder_endpoint))
@@ -228,13 +228,14 @@ async def amain(ns: argparse.Namespace) -> None:
         async def image_encoder(imgs: list[bytes]):
             async for item in enc_push.generate(
                     {"images": list(imgs)}, _uuid.uuid4().hex):
+                if item.get("error"):
+                    # worker-side client error (bad image bytes) → the
+                    # HTTP layer maps ValueError to 400, not 502
+                    raise ValueError(item["error"])
                 embs = item.get("embeddings")
                 if embs is None:
                     raise RuntimeError(f"bad encoder response: {item}")
-                return [
-                    _np.frombuffer(e["data"], _np.dtype(e.get("dtype", "float32"))
-                                   ).reshape(e["shape"]).astype(_np.float32)
-                    for e in embs]
+                return [tensor_from_wire(e) for e in embs]
             raise RuntimeError("encoder returned no response")
 
         watcher.image_encoder = image_encoder

@@ -454,7 +454,9 @@ class ModelRunner:
         # intersect and mm stays False for them naturally.
         emb_override = None
         for i, (seq, start, length) in enumerate(rows):
-            for pos, emb in getattr(seq, "mm_spans", ()):
+            if not seq.mm_spans or start >= seq.mm_end:
+                continue  # decode rows skip the span scan with one compare
+            for pos, emb in seq.mm_spans:
                 lo = max(pos, start)
                 hi = min(pos + emb.shape[0], start + length)
                 if lo >= hi:
@@ -771,12 +773,11 @@ class EngineCore:
                     error="multimodal requests require sp=1 and pp=1 "
                           "(the ring/pipeline prefill paths have no "
                           "embedding-override input yet)")
+            from dynamo_tpu.protocols.common import tensor_from_wire
+
             try:
-                seq.mm_spans = [
-                    (int(s["pos"]), np.frombuffer(
-                        s["data"], np.dtype(s.get("dtype", "float32"))
-                    ).reshape(s["shape"]).astype(np.float32))
-                    for s in req.mm_embeddings]
+                seq.mm_spans = [(int(s["pos"]), tensor_from_wire(s))
+                                for s in req.mm_embeddings]
             except Exception as exc:  # noqa: BLE001 - malformed client input
                 return LLMEngineOutput(
                     finish_reason=FinishReason.ERROR,
@@ -790,6 +791,7 @@ class EngineCore:
                         error=f"mm span (pos={pos}, shape={emb.shape}) out of "
                               f"range for prompt len {len(req.token_ids)} / "
                               f"hidden {H}")
+            seq.mm_end = max(pos + emb.shape[0] for pos, emb in seq.mm_spans)
         self.sched.add(seq)
         if seq.phase is Phase.FINISHED:  # rejected (too long for model or pool)
             return LLMEngineOutput(

@@ -61,8 +61,12 @@ class Seq:
     # this seq again until finalize accepts/rolls back (engine/spec.py).
     verify_inflight: bool = False
     # Multimodal embedding spans [(pos, np.ndarray[K, H])]: encoder outputs
-    # injected at prompt positions during prefill (engine dispatch).
+    # injected at prompt positions during prefill (engine dispatch). Spans
+    # are retained for the seq's whole life — preemption recomputes the
+    # prefill from position 0 and needs them again. mm_end (max span end)
+    # lets decode dispatches skip the span scan with one comparison.
     mm_spans: list = field(default_factory=list)
+    mm_end: int = 0
 
     def __post_init__(self) -> None:
         self.tokens = list(self.req.token_ids)

@@ -121,6 +121,17 @@ def build_plan(doc: dict, engine_override: str | None = None,
                   "--port", str(ks.get("port", 0))],
             ready_line="KV_STORE_READY"))
 
+    if "encoder" in spec:
+        enc = spec["encoder"] or {}
+        plan.processes.append(Process(
+            name="encoder", module="dynamo_tpu.components.encode",
+            args=["--coordinator", url,
+                  "--image-tokens", str(enc.get("imageTokens", 8)),
+                  "--lm-hidden", str(enc.get("lmHidden", 64)),
+                  "--image-size", str(enc.get("imageSize", 64))],
+            replicas=int(enc.get("replicas", 1)),
+            ready_line="ENCODE_READY"))
+
     model = spec["model"]
     for w in spec.get("workers", []):
         args = ["--coordinator", url, "--model", model,
@@ -172,6 +183,8 @@ def build_plan(doc: dict, engine_override: str | None = None,
     fe_args = ["--coordinator", url,
                "--port", str(fe.get("port", 8080)),
                "--router-mode", fe.get("routerMode", "kv")]
+    if "encoder" in spec:
+        fe_args += ["--encoder-endpoint", "dyn://dynamo.encoder.encode"]
     if "grpcPort" in fe:
         fe_args += ["--grpc-port", str(fe["grpcPort"])]
     if "migrationLimit" in fe:

@@ -45,7 +45,8 @@ class VisionConfig:
 
 
 def init_vision_params(cfg: VisionConfig) -> dict:
-    k = iter(jax.random.split(jax.random.key(cfg.seed), 32))
+    n_keys = 6 * cfg.num_layers + 5  # 6 denses/layer + 4 top-level + slack
+    k = iter(jax.random.split(jax.random.key(cfg.seed), n_keys))
     h = cfg.hidden_size
 
     def dense(shape, fan_in):

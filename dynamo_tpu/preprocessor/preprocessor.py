@@ -160,15 +160,26 @@ class OpenAIPreprocessor:
         token_ids = self.tokenizer.encode(pieces[0], add_bos=True)
         spans: list[dict] = []
         vocab = getattr(self.tokenizer, "vocab_size", None) or 1 << 20
+        import struct as _struct
+
         for img, piece in zip(images, pieces[1:]):
             emb = np.ascontiguousarray(img, np.float32)
             k = emb.shape[0]
             digest = xxhash.xxh3_64_intdigest(emb.tobytes())
             # digest-salted placeholders: position/hash bookkeeping only —
-            # the forward overrides these positions with the embeddings
-            placeholders = [(digest + j) % max(vocab - 1, 1) for j in range(k)]
-            spans.append({"pos": len(token_ids), "data": emb.tobytes(),
-                          "shape": list(emb.shape), "dtype": "float32"})
+            # the forward overrides these positions with the embeddings.
+            # Each position gets an INDEPENDENT mix of (digest, j): a
+            # single `(digest + j) % vocab` chain would collapse the whole
+            # span to log2(vocab) bits and alias different images at
+            # ~1/vocab probability; K independent draws give K*log2(vocab)
+            # bits — cache collisions between images become negligible.
+            m = max(vocab - 1, 1)
+            placeholders = [
+                xxhash.xxh3_64_intdigest(_struct.pack("<QQ", digest, j)) % m
+                for j in range(k)]
+            from dynamo_tpu.protocols.common import tensor_to_wire
+
+            spans.append({"pos": len(token_ids), **tensor_to_wire(emb)})
             token_ids.extend(placeholders)
             if piece:
                 token_ids.extend(self.tokenizer.encode(piece, add_bos=False))

@@ -147,3 +147,15 @@ def test_mocker_override_collapses_multihost():
         assert "--num-nodes" not in p.args, p.name
     names = [p.name for p in plan.processes]
     assert "prefill" in names and "decode" in names
+
+
+def test_multimodal_recipe_plans_encoder():
+    plan = build_plan(load_spec(
+        Path(__file__).parent.parent / "recipes/llama-3-8b/multimodal.yaml"))
+    by_name = {p.name: p for p in plan.processes}
+    enc = by_name["encoder"]
+    assert enc.replicas == 2
+    assert enc.args[enc.args.index("--image-tokens") + 1] == "64"
+    assert enc.args[enc.args.index("--lm-hidden") + 1] == "4096"
+    fe = by_name["frontend"]
+    assert "--encoder-endpoint" in fe.args
