@@ -206,6 +206,9 @@ def run_bench(deadline_at: float) -> dict:
         "decode_window": WINDOW,
         "decode_steps_timed": measured // BATCH,
         "roofline_tok_s": round(roofline, 1),
+        # provenance: the all-greedy batch rides the argmax-only step
+        # variant (bit-identical streams; engine/engine.py fast_greedy)
+        "fast_greedy": core.runner.used_fast_greedy(),
     }
 
 

@@ -235,7 +235,13 @@ async def amain(ns: argparse.Namespace) -> None:
                 embs = item.get("embeddings")
                 if embs is None:
                     raise RuntimeError(f"bad encoder response: {item}")
-                return [tensor_from_wire(e) for e in embs]
+                try:
+                    return [tensor_from_wire(e) for e in embs]
+                except Exception as exc:  # noqa: BLE001 - replica bug/skew
+                    # malformed tensor envelopes are an INFRA fault (502),
+                    # never the client's image
+                    raise RuntimeError(
+                        f"undecodable encoder payload: {exc}") from exc
             raise RuntimeError("encoder returned no response")
 
         watcher.image_encoder = image_encoder

@@ -365,6 +365,14 @@ class ModelRunner:
                     b, t, nblk, sp_prefill, fast_greedy, mm)
         return self._step_fns[key]
 
+    def used_fast_greedy(self) -> bool:
+        """Whether any compiled step so far took the argmax-only greedy
+        variant — THE accessor for the compile-cache key layout (step keys
+        are (b, t, nblk, sp, window, fast_greedy, mm); 'verify'/'embed'
+        entries are string-prefixed and excluded)."""
+        return any(not isinstance(k[0], str) and k[5]
+                   for k in self._step_fns)
+
     def reset_slot(self, slot: int, seed: int | None) -> None:
         self.counts = self.counts.at[slot].set(0)
         if seed is not None:

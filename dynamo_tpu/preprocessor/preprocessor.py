@@ -9,10 +9,12 @@ from backend output (see frontend/delta.py).
 
 from __future__ import annotations
 
+import struct
 from dataclasses import dataclass
+from typing import Any
 
 import numpy as np
-from typing import Any
+import xxhash
 
 from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
 from dynamo_tpu.protocols.openai import ChatCompletionRequest, CompletionRequest
@@ -148,7 +150,7 @@ class OpenAIPreprocessor:
 
     def _tokenize_with_images(self, prompt: str, images: "list[np.ndarray]"
                               ) -> tuple[list[int], list[dict]]:
-        import xxhash
+        from dynamo_tpu.protocols.common import tensor_to_wire
 
         pieces = prompt.split(self.MM_SENTINEL)
         if len(pieces) - 1 != len(images):
@@ -160,8 +162,6 @@ class OpenAIPreprocessor:
         token_ids = self.tokenizer.encode(pieces[0], add_bos=True)
         spans: list[dict] = []
         vocab = getattr(self.tokenizer, "vocab_size", None) or 1 << 20
-        import struct as _struct
-
         for img, piece in zip(images, pieces[1:]):
             emb = np.ascontiguousarray(img, np.float32)
             k = emb.shape[0]
@@ -175,10 +175,8 @@ class OpenAIPreprocessor:
             # bits — cache collisions between images become negligible.
             m = max(vocab - 1, 1)
             placeholders = [
-                xxhash.xxh3_64_intdigest(_struct.pack("<QQ", digest, j)) % m
+                xxhash.xxh3_64_intdigest(struct.pack("<QQ", digest, j)) % m
                 for j in range(k)]
-            from dynamo_tpu.protocols.common import tensor_to_wire
-
             spans.append({"pos": len(token_ids), **tensor_to_wire(emb)})
             token_ids.extend(placeholders)
             if piece:

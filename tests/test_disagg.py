@@ -222,3 +222,45 @@ async def test_transfer_ttl_expiry_unpins():
 
     await setup()
     await engine.shutdown()
+
+
+async def test_decode_first_flow_with_spec_decoding():
+    """Disagg decode with n-gram speculative decoding enabled: the imported
+    prefill KV + verify steps still emit EXACTLY the aggregated baseline
+    stream (spec proposals run on the decode engine over imported blocks)."""
+    # repetitive prompt so the proposer actually fires on the decode side
+    prompt = [60, 61, 62, 63] * 6  # 24 tokens = 6 full blocks of 4
+    expected = baseline_tokens(prompt, max_tokens=10)
+
+    p_engine = AsyncJaxEngine(EngineCore(tiny_config()))
+    d_engine = AsyncJaxEngine(EngineCore(tiny_config(spec_ngram=2, spec_k=4)))
+    source = KvTransferSource(p_engine)
+    from dynamo_tpu.disagg import handlers as h
+
+    async def fake_pull(engine, params):
+        xfer = source._transfers[params["xfer_id"]]
+        plan = await p_engine.run_in_core(lambda c: c.export_blocks(xfer.seq_hashes))
+        await source._release(params["xfer_id"])
+        return await engine.run_in_core(lambda c: c.import_blocks(plan))
+
+    prefill = PrefillHandler(p_engine, source, "127.0.0.1:0", "ns.prefill.kv_pull", 4)
+
+    async def prefill_call(payload, request_id):
+        async for item in prefill.generate(payload, _Ctx()):
+            yield item
+
+    decode = DisaggDecodeHandler(d_engine, prefill_call, block_size=4)
+    orig = h.pull_and_import
+    h.pull_and_import = fake_pull
+    try:
+        outs = await drain(decode.generate(
+            make_req(prompt=prompt, max_tokens=10).to_dict(), _Ctx()))
+    finally:
+        h.pull_and_import = orig
+    tokens = [t for o in outs for t in o.get("token_ids", [])]
+    assert tokens == expected
+    assert decode.remote_prefills == 1
+    spec = await d_engine.run_in_core(lambda c: c.metrics.spec_proposed)
+    assert spec > 0, "spec never proposed on the disagg decode side"
+    await p_engine.shutdown()
+    await d_engine.shutdown()

@@ -399,8 +399,8 @@ def test_fast_greedy_path_matches_general():
     fast, _ = run_to_completion(fast_core, [
         make_req(prompt=p, max_tokens=7, rid=f"g{i}")
         for i, p in enumerate(prompts)])
-    keys = list(fast_core.runner._step_fns)
-    assert any(k[5] for k in keys), f"fast_greedy variant unused: {keys}"
+    assert fast_core.runner.used_fast_greedy(), \
+        f"fast_greedy variant unused: {list(fast_core.runner._step_fns)}"
 
     gen_core = EngineCore(tiny_config(decode_window=2))
     general, _ = run_to_completion(gen_core, [
@@ -409,7 +409,7 @@ def test_fast_greedy_path_matches_general():
         make_req(prompt=[7, 8, 9, 11], max_tokens=7, rid="sampled",
                  temperature=0.8, seed=3),
     ])
-    assert all(not k[5] for k in gen_core.runner._step_fns), \
+    assert not gen_core.runner.used_fast_greedy(), \
         "general core unexpectedly used the fast path"
     for i in range(2):
         assert fast[f"g{i}"] == general[f"g{i}"], (fast, general)

@@ -110,14 +110,14 @@ def main() -> None:
         jax.profiler.stop_trace()
     measured = core.metrics.num_decode_tokens - base
     steps = measured // B
-    fast_keys = [k for k in core.runner._step_fns if k[5]]
+    fast = core.runner.used_fast_greedy()
     emit({
         "mode": MODE, "batch": B, "window": window,
         "attn_impl": core.runner.attn_impl,
         "tok_s": round(measured / dt, 1) if dt > 0 else None,
         "ms_per_step": round(dt / steps * 1e3, 2) if steps else None,
         "steps": steps,
-        "fast_greedy_used": bool(fast_keys),
+        "fast_greedy_used": fast,
         "device": getattr(jax.devices()[0], "device_kind", "?"),
         "trace": "/tmp/tpu_trace" if tracing else None,
     })
