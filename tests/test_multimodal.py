@@ -335,3 +335,21 @@ def test_use_raw_prompt_rejects_images(encoder):
         nvext={"use_raw_prompt": True})
     with pytest.raises(ValueError, match="use_raw_prompt"):
         pre.preprocess_chat(req, "r3", images=[emb])
+
+
+def test_tensor_wire_roundtrip():
+    """THE tensor envelope (protocols/common): exact float32 roundtrip,
+    shared by encoder/frontend/preprocessor/engine."""
+    from dynamo_tpu.protocols.common import tensor_from_wire, tensor_to_wire
+
+    rng = np.random.default_rng(0)
+    for shape in ((4, 64), (1, 8), (64, 4096)):
+        arr = rng.standard_normal(shape).astype(np.float32)
+        d = tensor_to_wire(arr)
+        assert set(d) == {"data", "shape", "dtype"}
+        back = tensor_from_wire(d)
+        np.testing.assert_array_equal(back, arr)
+    # float64 input converts on the way IN (wire stays float32)
+    d = tensor_to_wire(np.ones((2, 3), np.float64))
+    assert d["dtype"] == "float32"
+    assert tensor_from_wire(d).dtype == np.float32
