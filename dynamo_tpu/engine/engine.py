@@ -1061,11 +1061,21 @@ class EngineCore:
             # Untouched-state check: a preemption while in flight reset
             # num_computed — leave its bookkeeping alone, discard the step.
             in_flight_intact = seq.num_computed == start + length
+            if in_flight_intact:
+                # Roll back to the KV-valid bound BEFORE the commit inside
+                # _emit_and_finish: KV at position start+j was computed from
+                # input chunk[j], which is a true token only for
+                # j < len(emitted_all). With the optimistic start+length
+                # still in place, a rejection landing on a block boundary
+                # would commit a block whose last slot holds KV from the
+                # rejected proposal token — poisoning the shared prefix pool
+                # for every later request (and G2+ offloads) with that chain.
+                # (A stop firing mid-candidates finishes the seq inside
+                # _emit_and_finish, so no tighter post-call restore is
+                # needed: a live seq always emits all of emitted_all.)
+                seq.num_computed = start + len(emitted_all)
             n_emitted = self._emit_and_finish(
                 seq, emitted_all, lps[i], outputs, count_decode=True)
-            if in_flight_intact:
-                # keep KV only for positions whose inputs were true tokens
-                seq.num_computed = start + n_emitted
             self.metrics.spec_accepted += max(n_emitted - 1, 0)
 
     def step(self) -> dict[str, LLMEngineOutput]:

@@ -121,6 +121,52 @@ def test_spec_max_tokens_exact_mid_acceptance():
     assert len(out["r"]) == 7
 
 
+def test_spec_rejection_at_block_boundary_cannot_poison_prefix_pool(monkeypatch):
+    """A rejected proposal landing on a block-boundary slot, with the request
+    finishing on its last accepted token, must NOT commit that block into the
+    shared prefix pool: its last slot's KV was computed from the rejected
+    proposal token, and a later request sharing the prefix would silently
+    reuse the poisoned KV (advisor round-4 high finding).
+
+    Geometry (block_size=4, prompt_len=6): prefill emits token index 6;
+    the verify step runs chunk [t6, WRONG] over positions 6-7, the proposal
+    is rejected, and max_tokens=2 finishes the request at the accepted token
+    (index 7) — position 7 is the last slot of block 1, whose KV input was
+    WRONG. A same-core re-send of the true 8-token prefix must continue
+    bit-identically to a fresh spec-free engine."""
+    prompt = [10, 11, 12, 13, 14, 15]
+
+    # True greedy stream from a spec-free engine (fresh pool each time).
+    plain, _ = run_to_completion(EngineCore(tiny_config()), [
+        make_req(prompt=prompt, max_tokens=2, rid="t")])
+    t = plain["t"]
+    wrong = t[1] + 1 if t[1] + 1 < 512 else t[1] - 1
+
+    from dynamo_tpu.engine import spec as spec_mod
+    real_propose = spec_mod.propose
+    monkeypatch.setattr(
+        spec_mod, "propose",
+        lambda tokens, n, k: [wrong] if len(tokens) == len(prompt) + 1
+        else real_propose(tokens, n, k))
+
+    core = EngineCore(spec_config())
+    out, _ = run_to_completion(core, [
+        make_req(prompt=prompt, max_tokens=2, rid="a")])
+    assert out["a"] == t                      # stream itself is greedy-exact
+    assert core.metrics.spec_proposed > 0     # the verify path actually ran
+
+    # Re-send a prompt extending past the boundary ON THE SAME CORE (prefix
+    # caching on by default): the scheduler matches at most
+    # (prompt_len-1)//block_size cached blocks, so the 9-token prompt is what
+    # makes block 1 (positions 4-7, poisoned last slot) actually reused.
+    shared = prompt + t + [42]
+    cached, _ = run_to_completion(core, [
+        make_req(prompt=shared, max_tokens=8, rid="b")])
+    fresh, _ = run_to_completion(EngineCore(tiny_config()), [
+        make_req(prompt=shared, max_tokens=8, rid="b")])
+    assert cached["b"] == fresh["b"]
+
+
 async def test_spec_pipelined_engine_matches_sync():
     """The production AsyncJaxEngine loop (overlapped step_begin/finalize)
     over a spec engine emits the sync engine's exact streams."""
