@@ -41,6 +41,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline stages (layer blocks sharded over 'pipe')")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel replicas within ONE engine ('data' axis)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel shards ('expert' axis; MoE models)")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel shards ('seq' axis; ring attention)")
     p.add_argument("--allow-random-weights", action="store_true",
                    help="serve RANDOM weights when the model path has no "
                         "loadable safetensors (tests/benches only)")
@@ -230,6 +236,9 @@ async def amain(ns: argparse.Namespace) -> None:
             max_model_len=ns.max_model_len,
             tp=ns.tp,
             pp=ns.pp,
+            dp=ns.dp,
+            ep=ns.ep,
+            sp=ns.sp,
             decode_window=ns.decode_window,
             spec_ngram=ns.spec_ngram,
             spec_k=ns.spec_k,

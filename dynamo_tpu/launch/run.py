@@ -54,6 +54,12 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline stages (layer blocks sharded over 'pipe')")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel replicas within the engine ('data' axis)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel shards ('expert' axis; MoE models)")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel shards ('seq' axis; ring attention)")
     p.add_argument("--max-tokens", type=int, default=256, help="default max output tokens")
     p.add_argument("--input-jsonl", default=None)
     p.add_argument("--allow-random-weights", action="store_true",
@@ -99,6 +105,9 @@ def build_local_engine(ns: argparse.Namespace) -> tuple[AsyncJaxEngine, EngineCo
         num_blocks=ns.num_blocks,
         tp=ns.tp,
         pp=ns.pp,
+        dp=ns.dp,
+        ep=ns.ep,
+        sp=ns.sp,
         decode_window=ns.decode_window,
         spec_ngram=ns.spec_ngram,
         spec_k=ns.spec_k,
