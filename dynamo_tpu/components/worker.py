@@ -131,8 +131,6 @@ async def amain(ns: argparse.Namespace) -> None:
     if ns.num_nodes > 1:
         if ns.engine != "jax":
             raise SystemExit("--num-nodes > 1 requires --engine jax")
-        if ns.disagg != "none":
-            raise SystemExit("multi-host engines do not yet support disagg")
         from dynamo_tpu.parallel import multihost as mh
 
         # Distinct multi-host replicas of one component must rendezvous in
@@ -203,6 +201,7 @@ async def amain(ns: argparse.Namespace) -> None:
         publisher.start()
     sink = publisher.sink if publisher else None
 
+    follower_shards: list[dict] = []
     if ns.engine == "mocker":
         from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
 
@@ -258,8 +257,16 @@ async def amain(ns: argparse.Namespace) -> None:
 
             resolved = _dc.replace(engine.core.engine_cfg,
                                    num_blocks=engine.core.runner.spec.num_blocks)
-            op_channel.broadcast(mh.leader_hello(resolved))
-            await loop.run_in_executor(None, op_channel.wait_ready)
+            hello = mh.leader_hello(resolved)
+            # Prefill ranks each serve their cache shard of staged KV
+            # transfers; the role rides the hello so followers bind their
+            # shard servers and ack the addresses back (follower_loop).
+            hello["disagg_role"] = ns.disagg
+            op_channel.broadcast(hello)
+            infos = await loop.run_in_executor(None, op_channel.wait_ready)
+            follower_shards = [
+                {"addr": i["shard_addr"], "box": i["shard_box"]}
+                for i in infos if "shard_addr" in i]
 
     if ns.disagg != "none" and ns.engine != "jax":
         raise SystemExit("--disagg requires --engine jax (KV handoff needs a real cache)")
@@ -267,17 +274,16 @@ async def amain(ns: argparse.Namespace) -> None:
     kv_source = None
     if ns.disagg == "prefill":
         from dynamo_tpu.disagg.handlers import PrefillHandler
-        from dynamo_tpu.disagg.source import KV_PULL_ENDPOINT, KvTransferSource
+        from dynamo_tpu.disagg.source import KvTransferSource
 
-        kv_source = KvTransferSource(engine)
+        # shards[0] = this (leader) rank's server — started inside the
+        # source — plus every follower rank's (ready-ack addresses); a
+        # decode engine of any topology pulls its own box slices from them.
+        kv_source = KvTransferSource(
+            engine, advertise_host=rt.advertise_address.rsplit(":", 1)[0],
+            extra_shards=follower_shards)
         kv_source.start()
-        pull_ep = rt.namespace(ns.namespace).component(ns.component).endpoint(KV_PULL_ENDPOINT)
-        await pull_ep.serve(kv_source.kv_pull_handler)
-        prefill = PrefillHandler(
-            engine, kv_source,
-            advertise_addr=rt.advertise_address,
-            endpoint_path=f"{ns.namespace}.{ns.component}.{KV_PULL_ENDPOINT}",
-            block_size=ns.block_size)
+        prefill = PrefillHandler(engine, kv_source, block_size=ns.block_size)
         handler = prefill.generate
     elif ns.disagg == "decode":
         from dynamo_tpu.disagg.handlers import DisaggDecodeHandler

@@ -5,14 +5,17 @@ and decode workers with a KV handoff (reference: disagg flow
 components/src/dynamo/vllm/handlers.py:188-247 decode-first pattern;
 NIXL transfer docs/architecture/disagg_serving.md) — redesigned for TPU:
 
-- The prefill worker computes the prompt's KV, **pins** the blocks, and
-  returns ``kv_transfer_params`` (its data-plane address + the block hash
-  chain + a transfer id) instead of NIXL RDMA metadata.
-- The decode worker dials that address directly over the runtime's framed
-  TCP data plane (DCN path; intra-slice transfers ride ICI inside the
-  engine's own sharding), pulls the raw block bytes, and injects them as
-  matchable prefix-cache entries — its scheduler then admits the request
-  with the whole prompt (minus the tail) already resident.
+- The prefill worker computes the prompt's KV, **pins + stages** each
+  rank's cache shard to host memory (one replayed ``kv_stage`` op on
+  multi-host engines), and returns ``kv_transfer_params`` (the block hash
+  chain + a transfer id + every rank's shard-server endpoint with its
+  (layer, head) box) instead of NIXL RDMA metadata.
+- Every decode rank dials the prefill shards whose boxes intersect its
+  own and pulls exactly those slices (DCN path; intra-slice transfers
+  ride ICI inside the engine's own sharding) — rank-to-rank, resharding
+  across differing prefill/decode topologies — then injects them as
+  matchable prefix-cache entries in SPMD lockstep; the scheduler then
+  admits the request with the whole prompt (minus the tail) resident.
 - Decode-first and conditional: short prompts skip the remote hop, and any
   prefill failure falls back to local prefill (availability over latency,
   same stance as the reference's conditional disaggregation).

@@ -14,7 +14,7 @@ import copy
 from typing import Any, AsyncIterator, Callable
 
 from dynamo_tpu.disagg.receiver import pull_and_import
-from dynamo_tpu.disagg.source import KV_PULL_ENDPOINT, KvTransferSource
+from dynamo_tpu.disagg.source import KvTransferSource
 from dynamo_tpu.engine.engine import AsyncJaxEngine
 from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
 from dynamo_tpu.tokens import compute_block_hashes_for_tokens
@@ -28,11 +28,9 @@ class PrefillHandler:
     the sampled token, pin + advertise the blocks for pulling."""
 
     def __init__(self, engine: AsyncJaxEngine, source: KvTransferSource,
-                 advertise_addr: str, endpoint_path: str, block_size: int):
+                 block_size: int):
         self.engine = engine
         self.source = source
-        self.advertise_addr = advertise_addr   # "host:port" of our data plane
-        self.endpoint_path = endpoint_path     # "ns.comp.kv_pull"
         self.block_size = block_size
 
     async def generate(self, payload: dict, ctx) -> AsyncIterator[dict]:
@@ -55,11 +53,7 @@ class PrefillHandler:
         params = await self.source.register(hashes)
         result: dict[str, Any] = {"token_ids": [], "finish_reason": "stop"}
         if params is not None:
-            result["kv_transfer_params"] = {
-                "addr": self.advertise_addr,
-                "endpoint": self.endpoint_path,
-                **params,
-            }
+            result["kv_transfer_params"] = params
         yield result
 
 

@@ -1,64 +1,56 @@
-"""Decode-side KV transfer receiver: dial, pull, inject.
+"""Decode-side KV transfer receiver: pull shard slices, inject, release.
 
 Reference: the decode worker passing ``kv_transfer_params`` into its local
 engine so vLLM pulls blocks via NIXL (components/src/dynamo/vllm/
-handlers.py:236-241). Here the pull is explicit: a direct framed-TCP call
-to the prefill instance's data plane (the caller address came inside the
-params — data never transits the broker/coordinator, same stance as the
-reference's direct TCP response plane).
+handlers.py:236-241). Here the pull is the replayed ``kv_import`` core op:
+EVERY rank of the decode engine (one, for single-host) fetches exactly the
+box slices it owns from the prefill shard servers listed in the params —
+rank-to-rank transfers that also handle prefill-tp ≠ decode-tp resharding
+— then injects them into its cache shard in SPMD lockstep
+(engine.import_remote, disagg/sharded.py). Data never transits the
+broker/coordinator, same stance as the reference's direct transfers.
 """
 
 from __future__ import annotations
 
-import uuid
-
-import jax.numpy as jnp
-import numpy as np
+import asyncio
 
 from dynamo_tpu.engine.engine import AsyncJaxEngine
-from dynamo_tpu.kvbm.pools import block_shape
-from dynamo_tpu.transports.wire import Frame, MsgpackConnection
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("disagg")
 
 
 async def pull_and_import(engine: AsyncJaxEngine, params: dict) -> int:
-    """Pull the blocks described by ``params`` from the prefill worker and
-    inject them into ``engine``'s prefix cache. Returns blocks injected.
+    """Pull the transfer described by ``params`` into ``engine``'s prefix
+    cache and ack completion to the transfer's owner. Returns blocks
+    injected (a count of 0 means the pull failed consistently on every
+    rank — the caller falls back to local prefill).
 
-    params: {"addr": "host:port", "endpoint": "ns.comp.kv_pull",
-             "xfer_id": ..., "block_hashes": [...]}
+    params: {"xfer_id", "block_hashes": [...],
+             "shards": [{"addr": "host:port", "box": [ls, le, hs, he]}]}
+
+    Raises on a failed pull (import_remote's voted -1) so the caller's
+    conditional-disagg fallback fires; a 0 return is a SUCCESSFUL pull
+    whose blocks were all already device-resident.
     """
-    spec = engine.core.runner.spec
-    shape = block_shape(spec)
-    dtype = jnp.dtype(spec.dtype)
-    host, _, port = params["addr"].rpartition(":")
-    conn = await MsgpackConnection.connect(host, int(port))
-    plan: list[tuple[int, int | None, np.ndarray]] = []
+    # Two replayed ops: the prefetch starts the network fetch on a
+    # background thread on every rank (engine steps keep running while
+    # bytes move); the import joins it, votes, and injects.
+    await engine.run_op("kv_prefetch", {"params": params})
+    n = await engine.run_op("kv_import", {"params": params})
+    if n < 0:
+        raise RuntimeError(
+            f"kv pull {params['xfer_id']} failed (voted down across ranks)")
+    log.info("pulled %s KV blocks from %d shard(s)", n, len(params["shards"]))
+    # Done-ack to the owner (shards[0] = the prefill leader): unpins and
+    # unstages on every prefill rank. Fire-and-forget — TTL expiry covers a
+    # lost ack.
+    from dynamo_tpu.disagg.sharded import send_release
+
     try:
-        await conn.send({
-            "t": Frame.CALL, "stream_id": 1, "endpoint": params["endpoint"],
-            "request_id": uuid.uuid4().hex,
-            "payload": {"xfer_id": params["xfer_id"],
-                        "hashes": params["block_hashes"], "release": True},
-        })
-        while True:
-            msg = await conn.recv()
-            if msg is None or msg.get("t") == Frame.END:
-                break
-            if msg.get("t") == Frame.ERR:
-                raise RuntimeError(f"kv pull failed: {msg.get('error')}")
-            if msg.get("t") != Frame.DATA:
-                continue
-            item = msg["payload"]
-            data = np.frombuffer(item["d"], dtype=dtype).reshape(shape)
-            plan.append((item["h"], item.get("p"), data))
-    finally:
-        conn.close()
-    if not plan:
-        return 0
-    n = await engine.run_in_core(lambda core: core.import_blocks(plan))
-    log.info("pulled %d KV blocks from %s (injected %d)",
-             len(plan), params["addr"], n)
+        await asyncio.get_running_loop().run_in_executor(
+            None, send_release, params["shards"][0]["addr"], params["xfer_id"])
+    except Exception as exc:  # noqa: BLE001
+        log.warning("kv release ack failed (TTL will reclaim): %s", exc)
     return n

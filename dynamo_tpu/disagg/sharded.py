@@ -1,0 +1,256 @@
+"""Sharded KV handoff: per-rank staging, shard servers, box-sliced pulls.
+
+Fills the role of the reference's multi-node disaggregated KV transfer
+(reference: recipes/llama-3-70b/vllm/disagg-multi-node/deploy.yaml:36-71 —
+prefill and decode engines spanning hosts with NIXL moving KV between
+GPU pools; lib/llm/src/block_manager/distributed/leader.rs:126 +
+worker.rs:143 coordinate per-GPU transfers over a ZMQ control channel).
+
+The TPU redesign needs no control channel: a multi-host engine already
+replays ONE deterministic op stream on every rank (parallel/multihost.py),
+so staging and import run as replayed exec ops in SPMD lockstep. What this
+module adds is the *data* path between two engines whose meshes may differ
+(the flagship recipe hands tp16-prefill KV to tp32-decode):
+
+- ``StagingStore``  — host-memory staging of each rank's LOCAL cache shard
+  of the pinned blocks, keyed by transfer id. Staged at register time (one
+  replayed ``kv_stage`` op), so serving a pull never touches device state.
+- ``ShardServer``   — a per-rank daemon thread serving box-sliced reads of
+  staged shards over the framed sync-socket protocol multihost.py already
+  uses. Every prefill rank (leader AND followers) runs one.
+- ``fetch_box``     — the decode-rank side: dial every prefill shard whose
+  (layer, head) box intersects mine, pull exactly the intersecting slices,
+  and assemble my local per-block contribution. Rank-to-rank, no central
+  hop — the same locality NIXL's GPU↔GPU transfers have, ridden over
+  DCN-facing TCP instead.
+
+Boxes are global (layer_start, layer_end, head_start, head_end) extents;
+the shard geometry comes from ``kvbm.distributed.local_box``. A
+single-host engine is the 1-shard degenerate case of the same protocol.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from dynamo_tpu.parallel.multihost import recv_frame, send_frame
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("disagg.sharded")
+
+Box = tuple[int, int, int, int]  # (layer_start, layer_end, head_start, head_end)
+
+
+def box_intersection(a: Box, b: Box) -> Box | None:
+    ls, le = max(a[0], b[0]), min(a[1], b[1])
+    hs, he = max(a[2], b[2]), min(a[3], b[3])
+    if ls >= le or hs >= he:
+        return None
+    return (ls, le, hs, he)
+
+
+@dataclass
+class Staged:
+    """One rank's staged shard of a transfer: data[n, 2, L_loc, bs, H_loc, hd]
+    covering ``box`` of the global (layer, head) space, for ``hashes`` (with
+    ``parents`` the chain links import needs)."""
+
+    ready: threading.Event = field(default_factory=threading.Event)
+    hashes: list[int] = field(default_factory=list)
+    parents: list[int | None] = field(default_factory=list)
+    data: np.ndarray | None = None
+    box: Box = (0, 0, 0, 0)
+    dtype: str = "bfloat16"
+
+
+class StagingStore:
+    """Thread-safe xfer_id → Staged. Entries may be created by an early
+    pull (placeholder, unset event) or by the stage op (fills + sets)."""
+
+    def __init__(self) -> None:
+        self._entries: dict[str, Staged] = {}
+        self._lock = threading.Lock()
+
+    def get_or_create(self, xfer_id: str) -> Staged:
+        with self._lock:
+            entry = self._entries.get(xfer_id)
+            if entry is None:
+                entry = self._entries[xfer_id] = Staged()
+            return entry
+
+    def fill(self, xfer_id: str, hashes: list[int], parents: list[int | None],
+             data: np.ndarray, box: Box) -> None:
+        entry = self.get_or_create(xfer_id)
+        entry.hashes, entry.parents = hashes, parents
+        entry.data, entry.box = data, box
+        entry.dtype = str(data.dtype)
+        entry.ready.set()
+
+    def drop(self, xfer_id: str) -> None:
+        with self._lock:
+            entry = self._entries.pop(xfer_id, None)
+        if entry is not None:
+            entry.data = None
+            entry.ready.set()  # unblock any waiter; it will see data=None
+
+    def drop_if_empty(self, xfer_id: str) -> None:
+        """Remove a never-filled placeholder (created by a pull that raced
+        ahead of — or outlived — the stage op) so late/retried pulls can't
+        grow the store unboundedly."""
+        with self._lock:
+            entry = self._entries.get(xfer_id)
+            if entry is not None and entry.data is None:
+                del self._entries[xfer_id]
+
+
+class ShardServer:
+    """Serve box-sliced reads of staged shards. One per prefill rank.
+
+    Protocol (framed msgpack, multihost.py codec):
+      request  {"xfer_id", "ls", "le", "hs", "he"}
+      reply    {"hashes", "parents", "box": [ls, le, hs, he], "dtype"}
+               then one {"i": idx, "d": bytes} frame per block (the
+               requested slice, C-contiguous), then {"end": true}
+      release  {"xfer_id", "release": true} → {"ok": true} — the decode
+               side's done-ack, honored only by the LEADER's server (the
+               shards[0] convention): ``on_release`` forwards it to the
+               KvTransferSource, which broadcasts the replayed unpin.
+      error    {"error": msg}
+    """
+
+    def __init__(self, store: StagingStore, host: str = "0.0.0.0",
+                 stage_timeout: float = 60.0, on_release=None):
+        self.store = store
+        self.stage_timeout = stage_timeout
+        self.on_release = on_release
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, 0))
+        self.port = self._server.getsockname()[1]
+        self._server.listen(32)
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="kv-shard-server", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_one, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            req = recv_frame(conn)
+            if req is None:
+                return
+            if req.get("release"):
+                if self.on_release is not None:
+                    self.on_release(req["xfer_id"])
+                send_frame(conn, {"ok": True})
+                return
+            entry = self.store.get_or_create(req["xfer_id"])
+            if not entry.ready.wait(self.stage_timeout) or entry.data is None:
+                self.store.drop_if_empty(req["xfer_id"])
+                send_frame(conn, {"error": f"transfer {req['xfer_id']} not "
+                                           "staged (expired or never registered)"})
+                return
+            want = (req["ls"], req["le"], req["hs"], req["he"])
+            inter = box_intersection(want, entry.box)
+            if inter is None:
+                send_frame(conn, {"error": f"no overlap: want {want}, "
+                                           f"have {entry.box}"})
+                return
+            ls, le, hs, he = inter
+            b = entry.box
+            sl = entry.data[:, :, ls - b[0]:le - b[0], :, hs - b[2]:he - b[2], :]
+            send_frame(conn, {"hashes": entry.hashes,
+                              "parents": entry.parents,
+                              "box": list(inter), "dtype": entry.dtype})
+            for i in range(sl.shape[0]):
+                send_frame(conn, {"i": i,
+                                  "d": np.ascontiguousarray(sl[i]).tobytes()})
+            send_frame(conn, {"end": True})
+        except OSError as exc:
+            log.warning("shard serve failed: %s", exc)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def send_release(addr: str, xfer_id: str, timeout: float = 10.0) -> None:
+    """Tell the transfer's owner (the leader shard server, shards[0]) the
+    pull is done — it unpins/unstages on every prefill rank."""
+    host, _, port = addr.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=timeout) as conn:
+        conn.settimeout(timeout)
+        send_frame(conn, {"xfer_id": xfer_id, "release": True})
+        recv_frame(conn)
+
+
+def fetch_slice(addr: str, xfer_id: str, box: Box,
+                timeout: float = 30.0) -> tuple[list[int], list[int | None],
+                                                np.ndarray, Box]:
+    """Pull the slice of ``box`` one shard server holds. Synchronous —
+    called from the engine-core thread inside the replayed import op."""
+    host, _, port = addr.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=timeout) as conn:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.settimeout(timeout)
+        send_frame(conn, {"xfer_id": xfer_id, "ls": box[0], "le": box[1],
+                          "hs": box[2], "he": box[3]})
+        meta = recv_frame(conn)
+        if meta is None or "error" in meta:
+            raise RuntimeError(f"shard pull {addr} failed: "
+                               f"{(meta or {}).get('error', 'connection closed')}")
+        got: Box = tuple(meta["box"])  # type: ignore[assignment]
+        n = len(meta["hashes"])
+        out = None  # [n, flat] — reshaped by assemble_local (bs/hd caller-known)
+        count = 0
+        while True:
+            frame = recv_frame(conn)
+            if frame is None or frame.get("end"):
+                break
+            arr = np.frombuffer(frame["d"], dtype=np.dtype(meta["dtype"]))
+            if out is None:
+                out = np.empty((n, arr.size), dtype=arr.dtype)
+            out[frame["i"]] = arr
+            count += 1
+        if out is None or count != n:
+            raise RuntimeError(f"shard pull {addr}: got {count}/{n} blocks")
+        return meta["hashes"], meta["parents"], out, got
+
+
+def assemble_local(my_box: Box, pieces: list[tuple[np.ndarray, Box]],
+                   n: int, bs: int, hd: int, dtype) -> np.ndarray | None:
+    """Place fetched slices into this rank's [n, 2, myL, bs, myH, hd] block
+    array. Returns None (fetch incomplete) unless the pieces tile my box
+    exactly."""
+    ls, le, hs, he = my_box
+    out = np.empty((n, 2, le - ls, bs, he - hs, hd), dtype=dtype)
+    covered = np.zeros((le - ls, he - hs), dtype=bool)
+    for flat, box in pieces:
+        bl, bL, bh, bH = box[0], box[1], box[2], box[3]
+        block = flat.reshape(n, 2, bL - bl, bs, bH - bh, hd)
+        out[:, :, bl - ls:bL - ls, :, bh - hs:bH - hs, :] = block
+        covered[bl - ls:bL - ls, bh - hs:bH - hs] = True
+    if not covered.all():
+        return None
+    return out

@@ -1,12 +1,15 @@
-"""Prefill-side KV transfer source: pin, serve, expire.
+"""Prefill-side KV transfer source: stage, advertise, expire.
 
 Reference: the KVBM-distributed leader/worker + NIXL metadata handshake
 (lib/llm/src/block_manager/distributed/leader.rs, storage/nixl.rs).
-Here the "RDMA registration" becomes: pin the blocks in the prefill
-engine's pool (incref — survives scheduler churn), hand out a transfer id,
-and stream the raw block bytes over the runtime data plane when the decode
-side calls the ``kv_pull`` endpoint. Unpulled transfers expire after a TTL
-so an aborted decode can't leak device blocks.
+Here the "RDMA registration" becomes one replayed ``kv_stage`` op: every
+rank of the prefill engine pins the blocks in its pool and copies ITS
+cache shard to host staging (engine.stage_export), where the per-rank
+shard servers (disagg/sharded.py) serve box-sliced pulls. The transfer
+params advertise the full shard list, so a decode engine of ANY topology
+(single-host or multi-host, different tp) can assemble its own boxes.
+Unpulled transfers expire after a TTL so an aborted decode can't leak
+pinned device blocks (the release is a replayed op too).
 """
 
 from __future__ import annotations
@@ -21,20 +24,27 @@ from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("disagg")
 
-KV_PULL_ENDPOINT = "kv_pull"
-
 
 @dataclass
 class _Transfer:
-    block_ids: list[int]      # pinned device blocks (refcounted)
-    seq_hashes: list[int]     # chain covered by the pin, same length
+    seq_hashes: list[int]     # covered chain prefix (staged + pinned)
     deadline: float
 
 
 class KvTransferSource:
-    def __init__(self, engine: AsyncJaxEngine, ttl_s: float = 60.0):
+    def __init__(self, engine: AsyncJaxEngine, ttl_s: float = 60.0,
+                 advertise_host: str = "127.0.0.1",
+                 extra_shards: list[dict] | None = None):
+        """``extra_shards``: follower shard endpoints
+        ``[{"addr": "host:port", "box": [ls, le, hs, he]}, ...]`` from the
+        op channel's ready acks (multi-host prefill). The leader's own
+        shard server is always started here and listed FIRST — shards[0]
+        is where the decode side sends the release ack."""
         self.engine = engine
         self.ttl_s = ttl_s
+        self.advertise_host = advertise_host
+        self.extra_shards = extra_shards or []
+        self.shards: list[dict] | None = None
         self._transfers: dict[str, _Transfer] = {}
         self._gc_task: asyncio.Task | None = None
 
@@ -48,30 +58,54 @@ class KvTransferSource:
             self._gc_task = None
         for xid in list(self._transfers):
             await self._release(xid)
+        server = getattr(self.engine.core, "_shard_server", None)
+        if server is not None:
+            server.close()
+            self.engine.core._shard_server = None
+            self.shards = None
 
     # ------------------------------------------------------------------
+    def _ensure_shards(self) -> list[dict]:
+        if self.shards is None:
+            core = self.engine.core
+            loop = asyncio.get_running_loop()
+
+            def on_release(xid: str) -> None:  # shard-server thread → loop
+                loop.call_soon_threadsafe(
+                    lambda: loop.create_task(self.release(xid)))
+
+            addr = core.start_shard_server(self.advertise_host,
+                                           on_release=on_release)
+            self.shards = [{"addr": addr, "box": list(core.my_box())},
+                           *self.extra_shards]
+        return self.shards
+
     async def register(self, seq_hashes: list[int]) -> dict | None:
-        """Pin the device-resident prefix of ``seq_hashes``; returns the
-        kv_transfer_params fragment (id + covered hashes) or None if nothing
-        is resident (e.g. prompt shorter than one block)."""
+        """Stage the device-resident prefix of ``seq_hashes`` on every rank;
+        returns the kv_transfer_params (id + covered hashes + shard
+        endpoints) or None if nothing is resident (e.g. prompt shorter than
+        one block)."""
         if not seq_hashes:
             return None
-        block_ids = await self.engine.run_in_core(
-            lambda core: core.pin_blocks(seq_hashes))
-        if not block_ids:
-            return None
+        shards = self._ensure_shards()
         xid = uuid.uuid4().hex
-        covered = seq_hashes[: len(block_ids)]
+        covered_n = await self.engine.run_op(
+            "kv_stage", {"xfer_id": xid, "hashes": seq_hashes})
+        if not covered_n:
+            return None
+        covered = seq_hashes[:covered_n]
         self._transfers[xid] = _Transfer(
-            block_ids=block_ids, seq_hashes=covered,
-            deadline=time.monotonic() + self.ttl_s)
-        return {"xfer_id": xid, "block_hashes": covered}
+            seq_hashes=covered, deadline=time.monotonic() + self.ttl_s)
+        return {"xfer_id": xid, "block_hashes": covered, "shards": shards}
+
+    async def release(self, xfer_id: str) -> None:
+        """Decode-side ack: the pull completed (or was abandoned) — unpin
+        and drop staging on every rank."""
+        await self._release(xfer_id)
 
     async def _release(self, xid: str) -> None:
-        xfer = self._transfers.pop(xid, None)
-        if xfer is not None:
-            await self.engine.run_in_core(
-                lambda core: core.unpin_blocks(xfer.block_ids))
+        if self._transfers.pop(xid, None) is not None:
+            await self.engine.run_op("kv_release", {"xfer_id": xid})
 
     async def _gc_loop(self) -> None:
         while True:
@@ -81,22 +115,3 @@ class KvTransferSource:
                 if xfer.deadline <= now:
                     log.warning("kv transfer %s expired unpulled; releasing", xid)
                     await self._release(xid)
-
-    # ------------------------------------------------------------------
-    async def kv_pull_handler(self, payload: dict, ctx):
-        """Data-plane handler: stream the pinned blocks' raw bytes.
-
-        One DATA frame per block keeps frames small and lets the decode
-        side overlap receive with inject."""
-        xid = payload.get("xfer_id", "")
-        xfer = self._transfers.get(xid)
-        if xfer is None:
-            raise KeyError(f"unknown or expired kv transfer {xid!r}")
-        plan = await self.engine.run_in_core(
-            lambda core: core.export_blocks(xfer.seq_hashes))
-        try:
-            for h, parent, data in plan:
-                yield {"h": h, "p": parent, "d": data.tobytes()}
-        finally:
-            if payload.get("release", True):
-                await self._release(xid)

@@ -725,14 +725,6 @@ class EngineCore:
                     local_block_spec,
                 )
 
-                if engine_cfg.remote_kv_addr:
-                    # A shared remote store can't guarantee rank-identical
-                    # hit/miss results (connection hiccups, cross-engine LRU),
-                    # and divergent onboard plans mean divergent XLA programs
-                    # → hung collectives. Refuse rather than desync.
-                    raise ValueError(
-                        "remote_kv_addr is not supported on multi-host "
-                        "engines (non-deterministic across ranks)")
                 transfer = ShardedBlockTransferEngine(self.runner.mesh)
                 tier_spec, shard_fp = local_block_spec(
                     self.runner.spec, self.runner.cache_k)
@@ -763,8 +755,14 @@ class EngineCore:
                 tiers.append(disk)
             if remote is not None:
                 tiers.append(remote)
-            self.kvbm = OffloadManager(self.runner, self.pool, tiers,
-                                       transfer=transfer)
+            self.kvbm = OffloadManager(
+                self.runner, self.pool, tiers, transfer=transfer,
+                # The shared G4 store can't guarantee rank-identical
+                # hit/miss (cross-engine LRU, connection hiccups), so
+                # multi-host onboard plans are voted down to the mesh-wide
+                # minimum (OffloadManager.vote_plans) instead of refused.
+                vote_plans=(jax.process_count() > 1
+                            and bool(engine_cfg.remote_kv_addr)))
 
     # ------------------------------------------------------------------
     def add_request(self, req: PreprocessedRequest) -> LLMEngineOutput | None:
@@ -1089,9 +1087,17 @@ class EngineCore:
         if self.kvbm is not None:  # share jit caches with the offload path
             return self.kvbm.transfer
         if getattr(self, "_transfer", None) is None:
-            from dynamo_tpu.kvbm.transfer import BlockTransferEngine
+            if jax.process_count() > 1:
+                # Multi-host cache arrays span processes: extract/inject must
+                # stay shard-local (a plain np.asarray of the global array
+                # would need non-addressable shards).
+                from dynamo_tpu.kvbm.distributed import ShardedBlockTransferEngine
 
-            self._transfer = BlockTransferEngine()
+                self._transfer = ShardedBlockTransferEngine(self.runner.mesh)
+            else:
+                from dynamo_tpu.kvbm.transfer import BlockTransferEngine
+
+                self._transfer = BlockTransferEngine()
         return self._transfer
 
     def export_blocks(self, seq_hashes: list[int]) -> list[tuple[int, int | None, np.ndarray]]:
@@ -1132,6 +1138,169 @@ class EngineCore:
     def unpin_blocks(self, block_ids: list[int]) -> None:
         self.pool.release(block_ids)
 
+    # -- sharded disagg handoff (named ops — replayable on multi-host) -----
+    # These bodies run on EVERY rank of a multi-host engine via the op
+    # stream (parallel/multihost.py), in SPMD lockstep: pool decisions are
+    # deterministic, device work is the same XLA program everywhere, and
+    # each rank touches only its addressable cache shard
+    # (disagg/sharded.py module docstring has the full design).
+
+    @property
+    def staging(self):
+        if getattr(self, "_staging", None) is None:
+            from dynamo_tpu.disagg.sharded import StagingStore
+
+            self._staging = StagingStore()
+            self._staged_pins: dict[str, list[int]] = {}
+        return self._staging
+
+    def my_box(self) -> tuple[int, int, int, int]:
+        """This rank's (layer, head) extents of the global cache."""
+        from dynamo_tpu.kvbm.distributed import local_box
+
+        starts, stops = local_box(self.runner.cache_k)
+        return (starts[0], stops[0], starts[3], stops[3])
+
+    def start_shard_server(self, advertise_host: str, on_release=None) -> str:
+        """Start (once) the per-rank shard server serving staged KV; returns
+        the address to advertise in kv_transfer_params. Thread-safe to call
+        off the engine-core thread: it only binds a socket and reads the
+        (lock-guarded) staging store."""
+        if getattr(self, "_shard_server", None) is None:
+            from dynamo_tpu.disagg.sharded import ShardServer
+
+            self._shard_server = ShardServer(self.staging, on_release=on_release)
+        return f"{advertise_host}:{self._shard_server.port}"
+
+    @staticmethod
+    def _vote_min(n: int) -> int:
+        """Mesh-wide minimum of a per-rank count — the all-or-nothing
+        primitive that keeps nondeterministic effects (IO failures, shared
+        stores) rank-consistent on a multi-host engine. Identity on a
+        single process."""
+        if jax.process_count() <= 1:
+            return n
+        from jax.experimental import multihost_utils
+
+        return int(np.min(multihost_utils.process_allgather(
+            np.array([n], np.int32))))
+
+    def stage_export(self, xfer_id: str, seq_hashes: list[int]) -> int:
+        """Pin the device-resident prefix of a chain and stage this rank's
+        cache shard of it to host memory; returns hashes covered. The pin
+        holds until release_export, the staging until then too — pulls are
+        served from host memory, never re-touching device state.
+
+        Multi-host: the covered count is voted down to the mesh-wide
+        minimum (0 if any rank's extract failed), and pins beyond it are
+        released — so pin state, staged hash lists, and therefore every
+        future eviction decision stay rank-identical."""
+        touch = self.staging  # ensure _staged_pins exists on every path
+        block_ids = self.pool.match_prefix(seq_hashes)
+        data = None
+        try:
+            if block_ids:
+                blocks = self.transfer.extract(
+                    self.runner.cache_k, self.runner.cache_v, block_ids)
+                data = np.stack(blocks)
+        except Exception as exc:  # noqa: BLE001 — vote handles divergence
+            log.warning("stage_export extract failed: %s", exc)
+            data = None
+        n = self._vote_min(len(block_ids) if data is not None else 0)
+        if n < len(block_ids):
+            self.pool.release(block_ids[n:])
+            block_ids = block_ids[:n]
+        if n == 0:
+            return 0
+        covered = seq_hashes[:n]
+        parents: list[int | None] = [None, *covered[:-1]]
+        touch.fill(xfer_id, covered, parents, data[:n], self.my_box())
+        self._staged_pins[xfer_id] = block_ids
+        return n
+
+    def release_export(self, xfer_id: str) -> None:
+        self.staging.drop(xfer_id)
+        ids = self._staged_pins.pop(xfer_id, None)
+        if ids:
+            self.pool.release(ids)
+
+    def _fetch_local(self, params: dict):
+        """The network half of a pull: fetch + assemble this rank's box.
+        Touches no engine state — safe off the core thread. Returns
+        (hashes, parents, local_blocks) or None on any failure."""
+        from dynamo_tpu.disagg.sharded import (
+            assemble_local,
+            box_intersection,
+            fetch_slice,
+        )
+
+        spec = self.runner.spec
+        box = self.my_box()
+        pieces: list[tuple[np.ndarray, tuple[int, int, int, int]]] = []
+        hashes: list[int] = []
+        parents: list[int | None] = []
+        try:
+            for sh in params.get("shards", []):
+                inter = box_intersection(box, tuple(sh["box"]))
+                if inter is None:
+                    continue
+                h, p, flat, got = fetch_slice(sh["addr"], params["xfer_id"], inter)
+                hashes, parents = h, p  # identical across shards (one chain)
+                pieces.append((flat, got))
+            local = (assemble_local(box, pieces, len(hashes), spec.block_size,
+                                    spec.head_dim, jnp.dtype(spec.dtype))
+                     if hashes else None)
+        except Exception as exc:  # noqa: BLE001 — nondeterministic IO
+            log.warning("shard pull failed: %s", exc)
+            return None
+        return (hashes, parents, local) if local is not None else None
+
+    def prefetch_remote(self, params: dict) -> None:
+        """Start the pull's network half on a background thread so engine
+        steps keep running while bytes move; import_remote joins it. As a
+        replayed op, every rank overlaps ITS fetch with ITS serving — the
+        op order stays identical, only the waiting moves off the step
+        path."""
+        if not hasattr(self, "_prefetches"):
+            self._prefetches: dict[str, dict] = {}
+        slot: dict = {}
+
+        def run() -> None:
+            slot["result"] = self._fetch_local(params)
+
+        t = threading.Thread(target=run, name="kv-prefetch", daemon=True)
+        slot["thread"] = t
+        self._prefetches[params["xfer_id"]] = slot
+        t.start()
+
+    def import_remote(self, params: dict) -> int:
+        """Join the prefetch (or fetch inline), vote, and inject. On a
+        multi-host engine every rank runs this as a replayed op; the
+        mesh-wide vote makes fetch failure all-or-nothing so per-rank pool
+        state can never diverge (divergent pools would mean divergent XLA
+        programs → hung collectives). Returns blocks injected, or -1 when
+        the pull failed on some rank (no state was mutated anywhere)."""
+        slot = getattr(self, "_prefetches", {}).pop(params["xfer_id"], None)
+        if slot is not None:
+            slot["thread"].join()
+            fetched = slot["result"]
+        else:
+            fetched = self._fetch_local(params)
+        if self._vote_min(1 if fetched is not None else 0) == 0:
+            return -1
+        hashes, parents, local = fetched
+        plan = [(h, par, local[i]) for i, (h, par) in enumerate(zip(hashes, parents))]
+        n = self.import_blocks(plan)
+        log.info("pulled %d KV blocks for box %s (injected %d)",
+                 len(plan), self.my_box(), n)
+        return n
+
+    def run_op(self, name: str, args: dict):
+        """Execute one named core op — the replayable subset of run_in_core
+        (every rank of a multi-host engine runs the same op with the same
+        args, so unlike a closure it CAN ride the op stream)."""
+        return CORE_OPS[name](self, args)
+
     def embed(self, token_lists: list[list[int]]) -> "np.ndarray":
         """Last-token-pooled embeddings (engine-core thread only)."""
         return self.runner.embed(token_lists)
@@ -1144,6 +1313,18 @@ class EngineCore:
             self.abort(rid)
         self._seqs.clear()
         return rids
+
+
+# The replayable core-op registry: names + msgpack-able args only, so a
+# multi-host leader can broadcast them on the op stream and followers
+# replay them in lockstep (the closure-based run_in_core can't cross
+# process boundaries and stays single-host-only).
+CORE_OPS: dict[str, Callable[["EngineCore", dict], Any]] = {
+    "kv_stage": lambda core, a: core.stage_export(a["xfer_id"], a["hashes"]),
+    "kv_release": lambda core, a: core.release_export(a["xfer_id"]),
+    "kv_prefetch": lambda core, a: core.prefetch_remote(a["params"]),
+    "kv_import": lambda core, a: core.import_remote(a["params"]),
+}
 
 
 class OpChannelDown(RuntimeError):
@@ -1232,13 +1413,33 @@ class AsyncJaxEngine:
                         break  # _stop is set; streams fail below
                     self.core.abort(payload)
                     self._post(payload, LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
+                elif kind == "exec_op":
+                    # Named core op (CORE_OPS): broadcast first so followers
+                    # replay it at the same point in the stream, then run
+                    # locally. This is how disagg KV staging/import composes
+                    # with multi-host engines.
+                    name, args, fut, fut_loop = payload
+                    try:
+                        self._emit_op({"op": "exec", "name": name, "args": args})
+                    except OpChannelDown as exc:
+                        fut_loop.call_soon_threadsafe(self._resolve, fut, None, exc)
+                        break
+                    try:
+                        result, exc = self.core.run_op(name, args), None
+                    except Exception as e:
+                        result, exc = None, e
+                    try:
+                        fut_loop.call_soon_threadsafe(self._resolve, fut, result, exc)
+                    except RuntimeError:
+                        log.warning("exec_op result dropped: caller loop closed")
                 elif kind == "exec" and self._op_sink is not None:
-                    # Disagg/KVBM core access mutates device state outside
-                    # the replicated op stream — running it would desync the
-                    # followers' SPMD programs. Refuse loudly.
+                    # Closure-based core access can't ride the op stream —
+                    # running it would desync the followers' SPMD programs.
+                    # Refuse loudly; use run_op (named ops) instead.
                     fn, fut, fut_loop = payload
                     exc = RuntimeError(
-                        "run_in_core is not supported on a multi-host leader")
+                        "run_in_core is not supported on a multi-host leader; "
+                        "use run_op with a registered named op")
                     try:
                         fut_loop.call_soon_threadsafe(self._resolve, fut, None, exc)
                     except RuntimeError:
@@ -1318,6 +1519,17 @@ class AsyncJaxEngine:
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         self._inbox.put(("exec", (fn, fut, loop)))
+        self._wake.set()
+        return await fut
+
+    async def run_op(self, name: str, args: dict) -> Any:
+        """Run a registered named core op (CORE_OPS) on the engine-core
+        thread. On a multi-host leader the op is broadcast to followers
+        first — this is the multi-host-safe replacement for run_in_core."""
+        self.start()
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._inbox.put(("exec_op", (name, args, fut, loop)))
         self._wake.set()
         return await fut
 

@@ -103,7 +103,8 @@ class OffloadManager:
     donates its inputs, mirroring the engine step functions).
     """
 
-    def __init__(self, runner, pool: PrefixPool, tiers: list, transfer=None):
+    def __init__(self, runner, pool: PrefixPool, tiers: list, transfer=None,
+                 vote_plans: bool = False):
         assert tiers, "OffloadManager needs at least one tier"
         self.runner = runner
         self.pool = pool
@@ -111,6 +112,14 @@ class OffloadManager:
         # transfer override: multi-host engines pass the sharded engine
         # (kvbm/distributed.py) so tiers hold rank-local shards.
         self.transfer = transfer or BlockTransferEngine()
+        # vote_plans: multi-host engines with a SHARED tier (the G4 remote
+        # store) can see rank-divergent hit/miss results — evictions,
+        # connection hiccups. Divergent onboard plans mean divergent XLA
+        # programs → hung collectives, so each onboard truncates its plan to
+        # the mesh-wide minimum length (the walk order is a fixed hash
+        # chain, so equal lengths ⇒ identical hash sets). Rank-local tiers
+        # (G2 host / G3 disk) are deterministic and need no vote.
+        self.vote_plans = vote_plans
         self.stats = OffloadStats()
         self._pending: list[tuple[int, int]] = []  # (block_id, seq_hash)
         pool.evict_hook = self._on_evict
@@ -119,9 +128,16 @@ class OffloadManager:
     def _on_evict(self, block_id: int, seq_hash: int) -> None:
         """Queue the eviction; the device copy happens in one bucketed
         transfer at flush_pending() (an eviction-per-gather here would
-        serialize step() with many tiny device round-trips)."""
+        serialize step() with many tiny device round-trips).
+
+        The already-stored dedup check is skipped for SHARED tiers (the G4
+        remote store): another rank/engine may have stored the hash between
+        two ranks' checks, which would make each rank's pending list — and
+        therefore its extract program shapes — diverge. A redundant put of
+        identical content is idempotent; a rank-divergent device program is
+        a hang."""
         top = self.tiers[0]
-        if seq_hash in top:
+        if not getattr(top, "shared", False) and seq_hash in top:
             return
         self._pending.append((block_id, seq_hash))
 
@@ -158,6 +174,13 @@ class OffloadManager:
         ``_on_evict`` (safe: the evicted blocks are disjoint from the ones
         being loaded, and tier ``get`` returned copies)."""
         plan = plan_onboard(self.pool, seq_hashes, self._lookup)
+        if self.vote_plans:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            lens = multihost_utils.process_allgather(
+                np.array([len(plan)], np.int32))
+            plan = plan[: int(np.min(lens))]
         n = inject_and_commit(self.runner, self.pool, self.transfer, plan,
                               flush=self.flush_pending)
         self.stats.onboarded_blocks += n

@@ -164,6 +164,11 @@ class RemoteBlockPool:
     would go stale the moment another engine writes)."""
 
     name = "remote"
+    # Shared across engines/ranks: contains/get results can change under
+    # our feet (cross-engine LRU, other ranks' writes) — offload dedup and
+    # onboard planning must not assume rank-stable answers
+    # (kvbm/offload.py: _on_evict skip + vote_plans).
+    shared = True
 
     # After a failed call, skip the store entirely for this long — an
     # outage must cost ONE connect timeout per window, not one per call

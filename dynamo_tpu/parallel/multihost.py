@@ -27,9 +27,11 @@ Leader discovery mirrors the reference's etcd pattern: rank 0 publishes
 ``leader_addr`` under the coordination service; other ranks poll for it
 (:func:`publish_leader_addr` / :func:`resolve_leader_addr`).
 
-Scope: aggregated serving. Disagg KV export/import and KVBM host tiers are
-single-host features today — ``run_in_core`` exec ops are refused on a
-multi-host leader rather than silently desyncing the followers.
+Disagg and KVBM compose with this: named core ops (engine.CORE_OPS — KV
+stage/release/import) ride the same op stream, so every rank stages and
+injects ITS cache shard in lockstep (disagg/sharded.py). Only the
+closure-based ``run_in_core`` stays refused on a multi-host leader — a
+closure can't be broadcast.
 """
 
 from __future__ import annotations
@@ -206,18 +208,23 @@ class LeaderOpChannel:
             log.info("follower %d/%d connected from %s",
                      len(self._conns), self.num_followers, addr)
 
-    def wait_ready(self, timeout: float = 600.0) -> None:
+    def wait_ready(self, timeout: float = 600.0) -> list[dict]:
         """Block until every follower has acked readiness (EngineCore built,
         op replay about to start). Serving before this would let the
         leader's first dispatch race far ahead of followers still building
-        their engines."""
+        their engines. Returns the ready payloads (``ready_infos`` keeps
+        them too) — a prefill-role follower's ack carries its shard-server
+        address + (layer, head) box for disagg kv_transfer_params."""
+        self.ready_infos: list[dict] = []
         for conn in self._conns:
             conn.settimeout(timeout)
             ack = recv_frame(conn)
             if ack is None or ack.get("op") != "ready":
                 raise RuntimeError(f"follower sent {ack!r} instead of ready")
             conn.settimeout(None)
+            self.ready_infos.append(ack)
         log.info("all %d followers ready", self.num_followers)
+        return self.ready_infos
 
     def broadcast(self, op: dict) -> None:
         with self._lock:
@@ -279,7 +286,15 @@ def follower_loop(core_factory: Callable[[dict], Any], sock: socket.socket) -> N
     if hello is None or hello.get("op") != "hello":
         raise RuntimeError(f"expected hello from leader, got {hello!r}")
     core = core_factory(hello)
-    send_frame(sock, {"op": "ready"})
+    ready: dict[str, Any] = {"op": "ready"}
+    if hello.get("disagg_role") == "prefill":
+        # This rank must serve ITS cache shard of staged transfers; the
+        # address advertised is this host's IP on the route to the leader
+        # (what the decode side can reach it by in the common topology).
+        addr = core.start_shard_server(sock.getsockname()[0])
+        ready["shard_addr"] = addr
+        ready["shard_box"] = list(core.my_box())
+    send_frame(sock, ready)
     from dynamo_tpu.protocols.common import PreprocessedRequest
 
     pending = None
@@ -292,6 +307,15 @@ def follower_loop(core_factory: Callable[[dict], Any], sock: socket.socket) -> N
             core.add_request(PreprocessedRequest.from_dict(op["req"]))
         elif kind == "abort":
             core.abort(op["rid"])
+        elif kind == "exec":
+            # Replayed named core op (disagg KV stage/release/import). The
+            # leader surfaces its own failure to the caller and keeps
+            # serving; mirror that here — bodies are written so partial
+            # effects stay rank-consistent (import votes over the mesh).
+            try:
+                core.run_op(op["name"], op["args"])
+            except Exception:
+                log.exception("replayed exec op %r failed", op["name"])
         elif kind == "step":
             # Mirror the leader's engine-fatal handling: a deterministic
             # step error raises HERE too (identical programs); wipe and keep
@@ -331,9 +355,10 @@ _HELLO_FIELDS = (
     "dp", "tp", "ep", "sp",
     # KVBM tiers shape scheduling (onboarded blocks change prefill shapes):
     # every rank must run the same tier config in lockstep. remote_kv_addr
-    # is deliberately NOT here — a shared remote store cannot guarantee
-    # rank-identical hit/miss results, so EngineCore refuses it multi-host.
-    "host_kv_blocks", "disk_kv_path", "disk_kv_bytes",
+    # rides along so followers build the same G4 tier — its per-rank
+    # hit/miss nondeterminism is handled by the onboard plan vote
+    # (kvbm/offload.py OffloadManager.vote_plans).
+    "host_kv_blocks", "disk_kv_path", "disk_kv_bytes", "remote_kv_addr",
     # Speculative decoding partitions decode batches into verify/plain rows
     # — a proposal mismatch across ranks would desync dispatch shapes.
     "spec_ngram", "spec_k",

@@ -78,39 +78,31 @@ def test_pin_survives_churn_and_unpin_releases():
 # -- async handler flow (in-process, no network) -----------------------------
 
 async def test_decode_first_flow_in_process():
+    """The REAL pull path in-process: prefill stages to its shard server,
+    decode pulls box slices over actual sockets, injects, and acks the
+    release — no mocks."""
     expected = baseline_tokens(PROMPT)
 
     p_engine = AsyncJaxEngine(EngineCore(tiny_config()))
     d_engine = AsyncJaxEngine(EngineCore(tiny_config()))
     source = KvTransferSource(p_engine)
 
-    # The in-process "network": prefill_call drives PrefillHandler directly,
-    # and the pull hop is replaced by export→import through the source's
-    # registry (the TCP path is covered by the e2e test below).
-    from dynamo_tpu.disagg import handlers as h
-
-    async def fake_pull(engine, params):
-        xfer = source._transfers[params["xfer_id"]]
-        plan = await p_engine.run_in_core(lambda c: c.export_blocks(xfer.seq_hashes))
-        await source._release(params["xfer_id"])
-        return await engine.run_in_core(lambda c: c.import_blocks(plan))
-
-    prefill = PrefillHandler(p_engine, source, "127.0.0.1:0", "ns.prefill.kv_pull", 4)
+    prefill = PrefillHandler(p_engine, source, block_size=4)
 
     async def prefill_call(payload, request_id):
         async for item in prefill.generate(payload, _Ctx()):
             yield item
 
     decode = DisaggDecodeHandler(d_engine, prefill_call, block_size=4)
-    orig = h.pull_and_import
-    h.pull_and_import = fake_pull
-    try:
-        outs = await drain(decode.generate(make_req(prompt=PROMPT, max_tokens=6).to_dict(), _Ctx()))
-    finally:
-        h.pull_and_import = orig
+    outs = await drain(decode.generate(make_req(prompt=PROMPT, max_tokens=6).to_dict(), _Ctx()))
     tokens = [t for o in outs for t in o.get("token_ids", [])]
     assert tokens == expected
     assert decode.remote_prefills == 1 and decode.local_fallbacks == 0
+    # release ack lands via the shard server thread → loop roundtrip
+    for _ in range(50):
+        if not source._transfers:
+            break
+        await asyncio.sleep(0.1)
     assert not source._transfers  # transfer released after pull
     await p_engine.shutdown()
     await d_engine.shutdown()
@@ -235,28 +227,16 @@ async def test_decode_first_flow_with_spec_decoding():
     p_engine = AsyncJaxEngine(EngineCore(tiny_config()))
     d_engine = AsyncJaxEngine(EngineCore(tiny_config(spec_ngram=2, spec_k=4)))
     source = KvTransferSource(p_engine)
-    from dynamo_tpu.disagg import handlers as h
 
-    async def fake_pull(engine, params):
-        xfer = source._transfers[params["xfer_id"]]
-        plan = await p_engine.run_in_core(lambda c: c.export_blocks(xfer.seq_hashes))
-        await source._release(params["xfer_id"])
-        return await engine.run_in_core(lambda c: c.import_blocks(plan))
-
-    prefill = PrefillHandler(p_engine, source, "127.0.0.1:0", "ns.prefill.kv_pull", 4)
+    prefill = PrefillHandler(p_engine, source, block_size=4)
 
     async def prefill_call(payload, request_id):
         async for item in prefill.generate(payload, _Ctx()):
             yield item
 
     decode = DisaggDecodeHandler(d_engine, prefill_call, block_size=4)
-    orig = h.pull_and_import
-    h.pull_and_import = fake_pull
-    try:
-        outs = await drain(decode.generate(
-            make_req(prompt=prompt, max_tokens=10).to_dict(), _Ctx()))
-    finally:
-        h.pull_and_import = orig
+    outs = await drain(decode.generate(
+        make_req(prompt=prompt, max_tokens=10).to_dict(), _Ctx()))
     tokens = [t for o in outs for t in o.get("token_ids", [])]
     assert tokens == expected
     assert decode.remote_prefills == 1
