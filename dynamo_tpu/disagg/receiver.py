@@ -24,8 +24,7 @@ log = get_logger("disagg")
 async def pull_and_import(engine: AsyncJaxEngine, params: dict) -> int:
     """Pull the transfer described by ``params`` into ``engine``'s prefix
     cache and ack completion to the transfer's owner. Returns blocks
-    injected (a count of 0 means the pull failed consistently on every
-    rank — the caller falls back to local prefill).
+    injected.
 
     params: {"xfer_id", "block_hashes": [...],
              "shards": [{"addr": "host:port", "box": [ls, le, hs, he]}]}

@@ -165,29 +165,39 @@ class ShardServer:
                 send_frame(conn, {"ok": True})
                 return
             entry = self.store.get_or_create(req["xfer_id"])
-            if not entry.ready.wait(self.stage_timeout) or entry.data is None:
+            entry.ready.wait(self.stage_timeout)
+            # Snapshot the entry fields once: a concurrent drop() (TTL
+            # expiry, release ack) nulls entry.data mid-pull, and fill()
+            # mutates fields without the store lock — serve a consistent
+            # view or the error frame, never a half-updated one.
+            data, box = entry.data, entry.box
+            hashes, parents, dtype = entry.hashes, entry.parents, entry.dtype
+            if data is None:
                 self.store.drop_if_empty(req["xfer_id"])
                 send_frame(conn, {"error": f"transfer {req['xfer_id']} not "
                                            "staged (expired or never registered)"})
                 return
             want = (req["ls"], req["le"], req["hs"], req["he"])
-            inter = box_intersection(want, entry.box)
+            inter = box_intersection(want, box)
             if inter is None:
                 send_frame(conn, {"error": f"no overlap: want {want}, "
-                                           f"have {entry.box}"})
+                                           f"have {box}"})
                 return
             ls, le, hs, he = inter
-            b = entry.box
-            sl = entry.data[:, :, ls - b[0]:le - b[0], :, hs - b[2]:he - b[2], :]
-            send_frame(conn, {"hashes": entry.hashes,
-                              "parents": entry.parents,
-                              "box": list(inter), "dtype": entry.dtype})
+            sl = data[:, :, ls - box[0]:le - box[0], :, hs - box[2]:he - box[2], :]
+            send_frame(conn, {"hashes": hashes, "parents": parents,
+                              "box": list(inter), "dtype": dtype})
             for i in range(sl.shape[0]):
                 send_frame(conn, {"i": i,
                                   "d": np.ascontiguousarray(sl[i]).tobytes()})
             send_frame(conn, {"end": True})
-        except OSError as exc:
+        except Exception as exc:  # noqa: BLE001 — a handler thread must not
+            # die silently; best-effort error frame, then close.
             log.warning("shard serve failed: %s", exc)
+            try:
+                send_frame(conn, {"error": f"shard serve failed: {exc}"})
+            except OSError:
+                pass
         finally:
             try:
                 conn.close()

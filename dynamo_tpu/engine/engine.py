@@ -238,6 +238,7 @@ class ModelRunner:
         attn_impl = self.attn_impl
         moe_impl = "ep" if self.engine_cfg.ep > 1 else "dense"
         mesh = self.mesh
+        pp_micro = self.engine_cfg.pp_microbatches
 
         def step(params, ck, cv, counts, keys, slot_toks, tokens, q_start, q_len,
                  bt, slots, temp, top_k, top_p, fp, pp, rp, do_sample, from_slot,
@@ -254,7 +255,8 @@ class ModelRunner:
                                            attn_impl=attn_impl, moe_impl=moe_impl,
                                            mesh=mesh, sp_prefill=sp_prefill,
                                            embed_override=emb_override,
-                                           embed_mask=emb_mask)
+                                           embed_mask=emb_mask,
+                                           pp_microbatches=pp_micro)
             logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
             write_slots = jnp.where(do_sample, slots, trash_row)
             if fast_greedy:
@@ -312,6 +314,7 @@ class ModelRunner:
         attn_impl = self.attn_impl
         moe_impl = "ep" if self.engine_cfg.ep > 1 else "dense"
         mesh = self.mesh
+        pp_micro = self.engine_cfg.pp_microbatches
 
         def step(params, ck, cv, counts, keys, slot_toks, tokens, q_start, q_len,
                  bt, slots, temp, top_k, top_p, fp, pp, rp, do_sample, from_slot):
@@ -322,7 +325,8 @@ class ModelRunner:
                 ck, cv, counts, keys, slot_toks, cur = carry
                 hidden, ck, cv = llama.forward(
                     params, cfg, cur[:, None], q_start + j, q_len, bt, ck, cv,
-                    attn_impl=attn_impl, moe_impl=moe_impl, mesh=mesh)
+                    attn_impl=attn_impl, moe_impl=moe_impl, mesh=mesh,
+                    pp_microbatches=pp_micro)
                 logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
                 if fast_greedy:
                     # See _build_step_fn: bit-identical for all-greedy
@@ -1174,16 +1178,9 @@ class EngineCore:
 
     @staticmethod
     def _vote_min(n: int) -> int:
-        """Mesh-wide minimum of a per-rank count — the all-or-nothing
-        primitive that keeps nondeterministic effects (IO failures, shared
-        stores) rank-consistent on a multi-host engine. Identity on a
-        single process."""
-        if jax.process_count() <= 1:
-            return n
-        from jax.experimental import multihost_utils
+        from dynamo_tpu.parallel.multihost import vote_min
 
-        return int(np.min(multihost_utils.process_allgather(
-            np.array([n], np.int32))))
+        return vote_min(n)
 
     def stage_export(self, xfer_id: str, seq_hashes: list[int]) -> int:
         """Pin the device-resident prefix of a chain and stage this rank's

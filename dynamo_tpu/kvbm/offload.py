@@ -175,12 +175,9 @@ class OffloadManager:
         being loaded, and tier ``get`` returned copies)."""
         plan = plan_onboard(self.pool, seq_hashes, self._lookup)
         if self.vote_plans:
-            import numpy as np
-            from jax.experimental import multihost_utils
+            from dynamo_tpu.parallel.multihost import vote_min
 
-            lens = multihost_utils.process_allgather(
-                np.array([len(plan)], np.int32))
-            plan = plan[: int(np.min(lens))]
+            plan = plan[: vote_min(len(plan))]
         n = inject_and_commit(self.runner, self.pool, self.transfer, plan,
                               flush=self.flush_pending)
         self.stats.onboarded_blocks += n

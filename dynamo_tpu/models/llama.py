@@ -254,6 +254,7 @@ def forward(
     return_all_hidden: bool = False,
     embed_override: jax.Array | None = None,  # [B, T, H] multimodal embeds
     embed_mask: jax.Array | None = None,      # [B, T] True → use override
+    pp_microbatches: int = 0,                 # pp>1: schedule depth (0 = auto)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One engine step. Returns (last_hidden [B,H], cache_k, cache_v) —
     or (hidden [B,T,H], ...) with ``return_all_hidden`` (the speculative
@@ -271,15 +272,9 @@ def forward(
     sp = mesh.shape.get("seq", 1) if mesh is not None else 1
     if mesh is not None and mesh.shape.get("pipe", 1) > 1:
         # Pipeline-parallel path: layer blocks sharded over "pipe".
-        if attn_impl in ("pallas", "pallas_interpret"):
-            # Trace-time, so this logs once per compiled bucket actually
-            # serving the slower path (matching the tp-fallback warnings).
-            log.warning(
-                "pp>1 serves the dense gather attention path (the pallas "
-                "kernel does not yet run inside the pipeline stage block) "
-                "for bucket (b=%d, t=%d)", b, t)
         return forward_pp(params, cfg, token_ids, q_start, q_len, block_tables,
-                          cache_k, cache_v, mesh)
+                          cache_k, cache_v, mesh, attn_impl=attn_impl,
+                          microbatches=pp_microbatches)
     if attn_impl in ("pallas", "pallas_interpret") and tp > 1 and (
         cfg.num_kv_heads % tp != 0 or b % dp != 0
     ):
@@ -396,47 +391,182 @@ def forward_pp(
     cache_k: jax.Array,
     cache_v: jax.Array,
     mesh,
+    attn_impl: str = "dense",
+    microbatches: int = 0,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Pipeline-parallel forward: layer blocks sharded over the "pipe" axis.
 
     The reference's planner sizes ``pp`` for its engines
     (components/src/dynamo/planner/utils/planner_core.py:110-118); here PP
-    is first-party. Formulation: each stage holds ``L/pp`` stacked layers
-    and the matching slice of the paged KV cache (kv_cache_spec shards the
-    layer dim). Inside a ``shard_map`` over "pipe", the program runs ``pp``
-    select-and-broadcast rounds: every stage computes its block on the
-    current activations, round ``i`` keeps stage ``i``'s result (and its
-    cache writes) and ``psum``-broadcasts the activations to all stages.
+    is first-party. Each stage holds ``L/pp`` stacked layers and the
+    matching slice of the paged KV cache (kv_cache_spec shards the layer
+    dim). Inside one ``shard_map`` over "pipe", a GPipe-style microbatch
+    schedule runs M + pp - 1 ticks: every tick each stage computes its
+    layer block on ONE microbatch and ``ppermute``s the activations to the
+    next stage, so in steady state all pp stages work on different
+    microbatches simultaneously — efficiency M/(M+pp-1) vs 1/pp for the
+    naive select-and-broadcast pipeline (kept as the fallback for shapes
+    too small to split).
 
-    This is CAPACITY-scaling PP: per-device memory drops to L/pp layers
-    (params + KV cache) at unchanged latency; aggregate FLOPs are pp x the
-    model (the SPMD rounds compute every stage every round, keeping one),
-    i.e. the utilization of an unmicrobatched sequential pipeline. GPipe-
-    style microbatch interleaving over the same layout is the planned
-    optimization. Current composition limits: dense attention/MoE paths
-    inside the stage block (tp/ep stay 1 when pp > 1 — guarded by the
-    runner).
+    Microbatch axis: prefill chunks (T > 1) split along T — sub-chunk c's
+    attention context is the cache, which sub-chunks < c of the same stage
+    populated at earlier ticks (the tick order IS the causal order).
+    Decode (T = 1) splits along B. Bubble ticks write their (garbage) KV
+    to trash block 0 — the same masking the engine's padding rows use —
+    and contribute nothing to the output.
+
+    The Pallas paged-attention kernel runs INSIDE the stage block
+    (pallas_call nests fine under shard_map; this is the same composition
+    paged_attention_sharded uses over "model"). tp/ep stay 1 when pp > 1
+    (runner-guarded).
     """
     pp = mesh.shape["pipe"]
     if cfg.num_layers % pp != 0:
         raise ValueError(f"num_layers={cfg.num_layers} not divisible by pp={pp}")
     b, t = token_ids.shape
     bs = cache_k.shape[2]
+    nblk = block_tables.shape[1]
     from jax.sharding import PartitionSpec as P
 
     positions = q_start[:, None] + jnp.arange(t)[None, :]
     valid = jnp.arange(t)[None, :] < q_len[:, None]
-    kv_lens = q_start + q_len
     blk = jnp.take_along_axis(
         block_tables, jnp.clip(positions // bs, 0, block_tables.shape[1] - 1), axis=1
     )
     slot = jnp.where(valid, blk * bs + positions % bs, 0)
     h0 = params["embed"][token_ids].astype(_dtype(cfg))
 
-    def stage_block(lp_stack, ck_local, cv_local, h):
-        """One stage's layers — same math as the unsharded layer_fn, dense
-        attention over the stage's local cache slice."""
+    # Microbatch count: the largest divisor of the split axis ≤ the target
+    # (default 2*pp — enough for ~2/3+ steady-state efficiency without
+    # blowing up compile time on the tick loop).
+    target = microbatches if microbatches > 0 else 2 * pp
+    split_t = t > 1
+    axis = t if split_t else b
+    m = min(target, axis)
+    while m > 1 and axis % m:
+        m -= 1
+    use_kernel = attn_impl in ("pallas", "pallas_interpret")
 
+    if m < 2:
+        if use_kernel:
+            log.warning(
+                "pp>1 bucket (b=%d, t=%d) too small to microbatch: serving "
+                "the sequential dense-attention pipeline", b, t)
+        return _forward_pp_sequential(
+            params, cfg, positions, q_start + q_len, slot, block_tables,
+            cache_k, cache_v, mesh, h0, q_len, pp)
+
+    # Per-microbatch statics, uniformly [M, B', T', ...].
+    if split_t:
+        tm = t // m
+        bm = b
+        h0_mb = h0.reshape(b, m, tm, -1).swapaxes(0, 1)
+        pos_mb = positions.reshape(b, m, tm).swapaxes(0, 1)
+        slot_mb = slot.reshape(b, m, tm).swapaxes(0, 1)
+        bt_mb = jnp.broadcast_to(block_tables[None], (m, b, nblk))
+        qs_mb = q_start[None, :] + (jnp.arange(m) * tm)[:, None]
+        # visible context after sub-chunk c = everything ≤ its last valid
+        # token; clip keeps rows whose q_len ends mid-earlier-chunk exact.
+        kl_mb = q_start[None, :] + jnp.minimum(
+            q_len[None, :], (jnp.arange(m)[:, None] + 1) * tm)
+    else:
+        tm = t
+        bm = b // m
+        h0_mb = h0.reshape(m, bm, t, -1)
+        pos_mb = positions.reshape(m, bm, t)
+        slot_mb = slot.reshape(m, bm, t)
+        bt_mb = block_tables.reshape(m, bm, nblk)
+        qs_mb = q_start.reshape(m, bm)
+        kl_mb = (q_start + q_len).reshape(m, bm)
+
+    def stage_block(lp_stack, ck_loc, cv_loc, h, pos_t, slot_t, bt_t, qs_t, kl_t):
+        """One stage's layers on one microbatch — same math as the
+        unsharded layer_fn, attention over the stage's local cache slice."""
+
+        def layer_fn(carry, xs):
+            hid = carry
+            lp, ck, cv = xs
+            x = rms_norm(hid, lp["attn_norm"], cfg.rms_norm_eps)
+            q = (x @ lp["wq"]).reshape(bm, tm, cfg.num_heads, cfg.head_dim)
+            k = (x @ lp["wk"]).reshape(bm, tm, cfg.num_kv_heads, cfg.head_dim)
+            v = (x @ lp["wv"]).reshape(bm, tm, cfg.num_kv_heads, cfg.head_dim)
+            q = rope(q, pos_t, cfg.rope_theta)
+            k = rope(k, pos_t, cfg.rope_theta)
+            ck = _scatter_kv(ck, k, slot_t)
+            cv = _scatter_kv(cv, v, slot_t)
+            if use_kernel:
+                from dynamo_tpu.ops.paged_attention import paged_attention_kernel
+
+                attn = paged_attention_kernel(
+                    q, ck, cv, bt_t, qs_t, kl_t,
+                    interpret=(attn_impl == "pallas_interpret"))
+            else:
+                ctx_k = _gather_kv(ck, bt_t)
+                ctx_v = _gather_kv(cv, bt_t)
+                attn = paged_attention(q, ctx_k, ctx_v, pos_t, kl_t)
+            hid = hid + attn.reshape(bm, tm, cfg.q_size) @ lp["wo"]
+            x = rms_norm(hid, lp["mlp_norm"], cfg.rms_norm_eps)
+            if cfg.is_moe:
+                mlp_out = moe_mlp(x, lp, cfg)
+            else:
+                mlp_out = swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+            hid = hid + mlp_out
+            return hid, (ck, cv)
+
+        h, (ck_loc, cv_loc) = lax.scan(layer_fn, h, (lp_stack, ck_loc, cv_loc))
+        return h, ck_loc, cv_loc
+
+    def pp_fn(lp_stack, ck_loc, cv_loc, h0_mb, pos_mb, slot_mb, bt_mb, qs_mb, kl_mb):
+        s = lax.axis_index("pipe")
+
+        def tick(i, carry):
+            h_cur, ck, cv, out = carry
+            mb = i - s                     # microbatch at this stage now
+            mbc = jnp.clip(mb, 0, m - 1)
+            live = (mb >= 0) & (mb < m)
+            # Bubble ticks compute on stale activations (finite — zeros at
+            # worst) and must leave no trace: KV writes go to trash block 0
+            # and the output contribution is masked.
+            slot_t = jnp.where(live, slot_mb[mbc], 0)
+            h_in = jnp.where(s == 0, h0_mb[mbc], h_cur)
+            h_out, ck, cv = stage_block(
+                lp_stack, ck, cv, h_in, pos_mb[mbc], slot_t, bt_mb[mbc],
+                qs_mb[mbc], kl_mb[mbc])
+            out = out.at[mbc].add(jnp.where((s == pp - 1) & live, h_out, 0))
+            h_nxt = lax.ppermute(
+                h_out, "pipe", [(j, (j + 1) % pp) for j in range(pp)])
+            return (h_nxt, ck, cv, out)
+
+        init = (jnp.zeros_like(h0_mb[0]), ck_loc, cv_loc, jnp.zeros_like(h0_mb))
+        _, ck_loc, cv_loc, out = lax.fori_loop(0, m + pp - 1, tick, init)
+        # Only the last stage accumulated into `out`; the psum replicates it.
+        return lax.psum(out, "pipe"), ck_loc, cv_loc
+
+    fn = jax.shard_map(
+        pp_fn, mesh=mesh,
+        in_specs=(P("pipe"), P("pipe"), P("pipe"), P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P("pipe"), P("pipe")),
+        check_vma=False,
+    )
+    out, cache_k, cache_v = fn(params["layers"], cache_k, cache_v,
+                               h0_mb, pos_mb, slot_mb, bt_mb, qs_mb, kl_mb)
+    h = out.swapaxes(0, 1).reshape(b, t, -1) if split_t else out.reshape(b, t, -1)
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    last_idx = jnp.clip(q_len - 1, 0, t - 1)
+    last_h = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
+    return last_h, cache_k, cache_v
+
+
+def _forward_pp_sequential(params, cfg, positions, kv_lens, slot, block_tables,
+                           cache_k, cache_v, mesh, h0, q_len, pp):
+    """Fallback pipeline for shapes too small to microbatch (e.g. a lone
+    decode row): pp select-and-broadcast rounds — every stage computes the
+    full batch each round, round i keeps stage i's result. Efficiency 1/pp;
+    correctness identical."""
+    b, t = positions.shape
+    from jax.sharding import PartitionSpec as P
+
+    def stage_block(lp_stack, ck_local, cv_local, h):
         def layer_fn(carry, xs):
             hid = carry
             lp, ck, cv = xs
@@ -468,9 +598,6 @@ def forward_pp(
         for i in range(pp):
             h_out, ck_new, cv_new = stage_block(lp_stack, ck_local, cv_local, h)
             keep = s == i
-            # Round i commits stage i's cache writes and activations only;
-            # other stages' compute this round ran on not-yet-ready inputs
-            # and is discarded (the SPMD cost of an unmicrobatched pipeline).
             ck_local = jnp.where(keep, ck_new, ck_local)
             cv_local = jnp.where(keep, cv_new, cv_local)
             h = lax.psum(jnp.where(keep, h_out, jnp.zeros_like(h_out)), "pipe")

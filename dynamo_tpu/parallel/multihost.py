@@ -79,6 +79,24 @@ class MultiNodeConfig:
         return int(self.leader_addr.rsplit(":", 1)[1]) + OP_PORT_OFFSET
 
 
+def vote_min(n: int) -> int:
+    """Mesh-wide minimum of a per-rank count — THE all-or-nothing primitive
+    that keeps nondeterministic effects (IO failures, shared-store
+    hit/miss) rank-consistent on a multi-host engine: every rank truncates
+    its plan to the minimum, so divergent local outcomes can never become
+    divergent XLA programs. Identity on a single process. Must be called
+    at the same op-stream position on every rank (it is a collective)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return n
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    return int(np.min(multihost_utils.process_allgather(
+        np.array([n], np.int32))))
+
+
 def initialize_distributed(mn: MultiNodeConfig) -> None:
     """``jax.distributed.initialize`` with the MultiNodeConfig; call ONCE
     per process, before any other jax use."""
@@ -352,7 +370,7 @@ _HELLO_FIELDS = (
     "num_blocks", "block_size",
     "max_batch_size", "max_model_len", "prefill_chunk", "max_tokens_per_step",
     "decode_bucket", "decode_window", "seed", "enable_prefix_caching",
-    "dp", "tp", "ep", "sp",
+    "dp", "pp", "tp", "ep", "sp", "pp_microbatches",
     # KVBM tiers shape scheduling (onboarded blocks change prefill shapes):
     # every rank must run the same tier config in lockstep. remote_kv_addr
     # rides along so followers build the same G4 tier — its per-rank

@@ -102,6 +102,10 @@ class EngineConfig:
     tp: int = 1
     ep: int = 1
     sp: int = 1
+    # pp>1: microbatches interleaved across stage blocks per dispatch
+    # (models/llama.forward_pp). 0 = auto (2*pp); shapes that don't divide
+    # fall back to the sequential pipeline.
+    pp_microbatches: int = 0
     enable_prefix_caching: bool = True
     kv_event_publishing: bool = True
     # KVBM tiers (reference: lib/llm/src/block_manager.rs CacheLevel):

@@ -1,0 +1,118 @@
+"""Pipeline parallelism: microbatched schedule correctness + utilization.
+
+Reference: the planner sizes pp for its engines
+(components/src/dynamo/planner/utils/planner_core.py:110-118); the engines
+themselves get PP from vLLM/TRT-LLM. Here forward_pp is first-party
+(models/llama.py): a GPipe-style microbatch schedule inside one shard_map
+over "pipe". These tests pin (a) bit-exactness vs pp=1 across the
+microbatched and sequential-fallback paths, dense AND Pallas attention,
+and (b) the utilization claim — the microbatched program's total FLOPs
+must beat the sequential pipeline's by >1.5x at pp=2 (sequential computes
+every stage every round: efficiency 1/pp; microbatched M/(M+pp-1)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.engine.engine import EngineCore
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import resolve_model_config
+from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+
+from tests.test_engine import make_req, run_to_completion, tiny_config
+
+
+def _run(pp, attn="dense", mb=0, prompts=None, max_tokens=5):
+    core = EngineCore(tiny_config(
+        pp=pp, dtype="float32", attn_impl=attn, pp_microbatches=mb,
+        decode_bucket=(4,)))
+    reqs = [make_req(prompt=p, max_tokens=max_tokens, rid=f"r{i}")
+            for i, p in enumerate(prompts or [[3 * i + j for j in range(5 + i)]
+                                              for i in range(3)])]
+    got, fin = run_to_completion(core, reqs)
+    assert len(fin) == len(reqs)
+    return got
+
+
+def test_pp_microbatched_matches_unsharded_dense_and_pallas():
+    ref = _run(1)
+    assert _run(2) == ref                            # auto microbatches
+    assert _run(2, attn="pallas_interpret") == ref   # kernel inside stages
+    assert _run(2, mb=4) == ref                      # explicit depth
+
+
+def test_pp_sequential_fallback_still_exact():
+    """microbatches=1 forces the select-and-broadcast fallback."""
+    assert _run(2, mb=1) == _run(1)
+
+
+def test_pp_microbatched_flops_beat_sequential():
+    """The whole point of the microbatch schedule: at pp=2 the compiled
+    prefill program must cost <1/1.5 the sequential pipeline's FLOPs
+    (model: sequential = pp x ideal; microbatched = (M+pp-1)/M x ideal —
+    at M=8, ratio = 16/9 ≈ 1.78)."""
+    cfg = resolve_model_config("tiny-llama")
+    mesh = make_mesh(MeshConfig(pp=2), devices=jax.devices()[:2])
+    b, t, bs, nb, nblk = 1, 32, 4, 32, 16
+
+    def fwd(mb):
+        def f(tokens, q_start, q_len, bt, ck, cv, params):
+            return llama.forward_pp(params, cfg, tokens, q_start, q_len, bt,
+                                    ck, cv, mesh, microbatches=mb)
+        return f
+
+    params = llama.init_params(cfg, jax.random.key(0))
+    args = (
+        jnp.ones((b, t), jnp.int32),
+        jnp.zeros((b,), jnp.int32),
+        jnp.full((b,), t, jnp.int32),
+        jnp.tile(jnp.arange(1, nblk + 1, dtype=jnp.int32)[None], (b, 1)),
+        jnp.zeros((cfg.num_layers, nb, bs, cfg.num_kv_heads, cfg.head_dim),
+                  jnp.float32),
+        jnp.zeros((cfg.num_layers, nb, bs, cfg.num_kv_heads, cfg.head_dim),
+                  jnp.float32),
+        params,
+    )
+
+    def flops(mb):
+        compiled = jax.jit(fwd(mb)).lower(*args).compile()
+        cost = compiled.cost_analysis()
+        cost = cost[0] if isinstance(cost, list) else cost
+        return cost["flops"]
+
+    sequential, micro = flops(1), flops(8)
+    assert sequential / micro > 1.5, (
+        f"microbatching saved only {sequential / micro:.2f}x "
+        f"(seq={sequential:.3g}, micro={micro:.3g})")
+
+
+def test_pp_decode_splits_batch_rows():
+    """Decode (T=1) microbatches along B: a 4-row greedy decode batch on
+    pp=2 must match pp=1 exactly (B-split path; the prefill above covered
+    the T-split path)."""
+    prompts = [[40 + 2 * i + j for j in range(6)] for i in range(4)]
+    assert _run(2, prompts=prompts, max_tokens=8) == \
+        _run(1, prompts=prompts, max_tokens=8)
+
+
+def test_pp_with_sampling_matches_unsharded():
+    """Seeded sampling through the pp path (PRNG state rides outside the
+    pipeline; streams must be identical)."""
+    def run(pp):
+        core = EngineCore(tiny_config(pp=pp, dtype="float32"))
+        got, _ = run_to_completion(core, [
+            make_req(prompt=list(range(20, 30)), max_tokens=8, rid="s",
+                     temperature=0.8, seed=11)])
+        return got
+
+    assert run(2) == run(1)
+
+
+def test_pp_requires_divisible_layers():
+    # Surfaces at param sharding (device_put) or forward_pp's own check,
+    # depending on which runs first — either way layers % pp is enforced.
+    with pytest.raises(ValueError, match="divisible"):
+        EngineCore(tiny_config(pp=3, dtype="float32"))
