@@ -58,6 +58,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="max proposed tokens per verify step")
     p.add_argument("--decode-window", type=int, default=1,
                    help="decode steps fused per device dispatch")
+    p.add_argument("--quantization", choices=["none", "int8"], default="none",
+                   help="weight-only quantization (int8: per-channel scales, "
+                        "bf16 compute; halves decode HBM traffic)")
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--speedup-ratio", type=float, default=10.0, help="mocker only")
     p.add_argument("--no-kv-events", action="store_true")
@@ -239,6 +242,7 @@ async def amain(ns: argparse.Namespace) -> None:
             ep=ns.ep,
             sp=ns.sp,
             decode_window=ns.decode_window,
+            quantization=ns.quantization,
             spec_ngram=ns.spec_ngram,
             spec_k=ns.spec_k,
             allow_random_weights=ns.allow_random_weights,

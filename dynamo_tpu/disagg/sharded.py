@@ -85,10 +85,24 @@ class StagingStore:
     def fill(self, xfer_id: str, hashes: list[int], parents: list[int | None],
              data: np.ndarray, box: Box) -> None:
         entry = self.get_or_create(xfer_id)
-        entry.hashes, entry.parents = hashes, parents
-        entry.data, entry.box = data, box
-        entry.dtype = str(data.dtype)
+        with self._lock:  # publish all fields atomically (see snapshot)
+            entry.hashes, entry.parents = hashes, parents
+            entry.dtype = str(data.dtype)
+            entry.data, entry.box = data, box
         entry.ready.set()
+
+    def snapshot(self, xfer_id: str):
+        """Consistent read of a staged entry's fields (or None if not
+        staged). Serve threads that wake from a TIMED-OUT ready.wait() can
+        race a concurrent fill(); reading under the same lock fill()
+        publishes under means they see all-or-nothing, never fresh data
+        paired with a stale dtype/box."""
+        entry = self.get_or_create(xfer_id)
+        with self._lock:
+            if entry.data is None:
+                return None
+            return (entry.hashes, entry.parents, entry.data, entry.box,
+                    entry.dtype)
 
     def drop(self, xfer_id: str) -> None:
         with self._lock:
@@ -166,12 +180,11 @@ class ShardServer:
                 return
             entry = self.store.get_or_create(req["xfer_id"])
             entry.ready.wait(self.stage_timeout)
-            # Snapshot the entry fields once: a concurrent drop() (TTL
-            # expiry, release ack) nulls entry.data mid-pull, and fill()
-            # mutates fields without the store lock — serve a consistent
-            # view or the error frame, never a half-updated one.
-            data, box = entry.data, entry.box
-            hashes, parents, dtype = entry.hashes, entry.parents, entry.dtype
+            snap = self.store.snapshot(req["xfer_id"])
+            if snap is not None:
+                hashes, parents, data, box, dtype = snap
+            else:
+                data = None
             if data is None:
                 self.store.drop_if_empty(req["xfer_id"])
                 send_frame(conn, {"error": f"transfer {req['xfer_id']} not "

@@ -176,6 +176,14 @@ class ModelRunner:
 
             self.params = shard_params(
                 self.params, llama.param_logical_axes(cfg), mesh)
+        if engine_cfg.quantization == "int8":
+            # After placement: the elementwise quantize preserves the mesh
+            # sharding, so TP/EP layouts carry over (models/quant.py).
+            # (The value itself was validated with the other config checks
+            # in EngineCore, before any weight IO.)
+            from dynamo_tpu.models.quant import quantize_params_int8
+
+            self.params = quantize_params_int8(self.params, cfg)
         num_blocks = engine_cfg.num_blocks or self._auto_num_blocks()
         self.spec = KVCacheSpec.for_model(cfg, num_blocks, engine_cfg.block_size)
         self.cache_k, self.cache_v = allocate_cache(self.spec, mesh)
@@ -680,8 +688,14 @@ class EngineCore:
                                   or engine_cfg.sp > 1):
             raise ValueError(
                 "pp>1 currently composes only with dp; tp/ep/sp must be 1 "
-                "(the PP stage block runs dense attention/MoE — see "
-                "models/llama.forward_pp)")
+                "(the PP stage block is not head/expert/sequence-sharded — "
+                "see models/llama.forward_pp)")
+        if engine_cfg.quantization not in ("none", "", "int8"):
+            # Validate here, before any weight IO — a typo must fail in
+            # milliseconds, not after loading/sharding a 70B checkpoint.
+            raise ValueError(
+                f"unknown quantization {engine_cfg.quantization!r} "
+                "(supported: none, int8)")
         if mesh is None and any(v != 1 for v in engine_cfg.mesh_shape().values()):
             mesh = make_mesh(MeshConfig(dp=engine_cfg.dp, pp=engine_cfg.pp,
                                         sp=engine_cfg.sp, tp=engine_cfg.tp,

@@ -69,6 +69,8 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                    help="n-gram speculative decoding (greedy-exact; 0 = off)")
     p.add_argument("--spec-k", type=int, default=4,
                    help="max proposed tokens per verify step")
+    p.add_argument("--quantization", choices=["none", "int8"], default="none",
+                   help="weight-only quantization (int8)")
     p.add_argument("--decode-window", type=int, default=1,
                    help="decode steps fused per device dispatch (stop checks "
                         "lag by up to window-1 tokens; output is unchanged)")
@@ -109,6 +111,7 @@ def build_local_engine(ns: argparse.Namespace) -> tuple[AsyncJaxEngine, EngineCo
         ep=ns.ep,
         sp=ns.sp,
         decode_window=ns.decode_window,
+        quantization=ns.quantization,
         spec_ngram=ns.spec_ngram,
         spec_k=ns.spec_k,
         allow_random_weights=ns.allow_random_weights,

@@ -12,8 +12,8 @@ appears as ``ne=[in, out]`` with identical row-major bytes — reading with
 ``reshape(dims[::-1])`` recovers the ``[out, in]`` view, after which the
 same transpose convention as the safetensors loader applies.
 
-Scope: F32/F16/BF16 tensors (quantized GGML blocks are rejected with a
-clear error — dequantization is a later step); llama-family metadata →
+Scope: F32/F16/BF16 tensors (zero-copy) plus Q8_0/Q4_0 GGML block
+dequantization; llama-family metadata →
 :class:`~dynamo_tpu.models.config.ModelConfig`. ``save_gguf`` writes the
 same subset, used by tests and by tools that re-export checkpoints.
 """
@@ -42,6 +42,7 @@ _SCALAR_FMT = {_U8: "<B", _I8: "<b", _U16: "<H", _I16: "<h", _U32: "<I",
 
 # ggml tensor types we can read losslessly
 GGML_F32, GGML_F16 = 0, 1
+GGML_Q4_0, GGML_Q8_0 = 2, 8
 GGML_BF16 = 30
 try:
     import ml_dtypes
@@ -124,19 +125,38 @@ class GGUFReader:
         return name in self._tensors
 
     def tensor(self, name: str) -> np.ndarray:
-        """Zero-copy view in numpy convention (outermost dim first)."""
+        """Tensor in numpy convention (outermost dim first). F32/F16/BF16
+        are zero-copy views; Q8_0/Q4_0 GGML blocks (32-element groups with
+        an f16 scale) dequantize to float32 — serving re-quantizes to the
+        engine's own per-channel int8 when ``quantization=int8`` is set
+        (models/quant.py), so the HBM saving survives the round trip."""
         dims, ggml_type, offset = self._tensors[name]
+        count = int(np.prod(dims)) if dims else 1
+        shape = tuple(reversed(dims))  # GGML dims are innermost-first
+        if ggml_type in (GGML_Q8_0, GGML_Q4_0):
+            nblocks = count // 32
+            bb = 34 if ggml_type == GGML_Q8_0 else 18
+            raw = np.frombuffer(self._mm, dtype=np.uint8, count=nblocks * bb,
+                                offset=self._data_base + offset)
+            raw = raw.reshape(nblocks, bb)
+            scale = raw[:, :2].copy().view(np.float16).astype(np.float32)
+            if ggml_type == GGML_Q8_0:
+                vals = raw[:, 2:].copy().view(np.int8).astype(np.float32)
+            else:  # Q4_0: 16 bytes of nibbles, value = nibble - 8
+                nib = raw[:, 2:]
+                vals = np.concatenate(
+                    [(nib & 0x0F).astype(np.int8), (nib >> 4).astype(np.int8)],
+                    axis=1).astype(np.float32) - 8.0
+            return (vals * scale).reshape(shape)
         dtype = _TENSOR_DTYPES.get(ggml_type)
         if dtype is None:
             raise ValueError(
-                f"tensor {name!r} uses ggml type {ggml_type} (quantized?); "
-                "only F32/F16/BF16 GGUF tensors are supported — requantize "
-                "or convert the checkpoint")
-        count = int(np.prod(dims)) if dims else 1
+                f"tensor {name!r} uses ggml type {ggml_type}; only "
+                "F32/F16/BF16/Q8_0/Q4_0 GGUF tensors are supported — "
+                "requantize or convert the checkpoint")
         arr = np.frombuffer(self._mm, dtype=dtype, count=count,
                             offset=self._data_base + offset)
-        # GGML dims are innermost-first; reverse for the numpy view.
-        return arr.reshape(tuple(reversed(dims)))
+        return arr.reshape(shape)
 
     def architecture(self) -> str:
         return str(self.metadata.get("general.architecture", ""))
@@ -282,8 +302,10 @@ def _w_value(f: BinaryIO, v: Any) -> None:
 
 
 def save_gguf(path: str | Path, metadata: dict[str, Any],
-              tensors: dict[str, np.ndarray]) -> None:
-    """Write a GGUF v3 file (F32/F16/BF16 tensors, numpy-convention shapes)."""
+              tensors: dict[str, Any]) -> None:
+    """Write a GGUF v3 file. Values are numpy arrays (F32/F16/BF16) or
+    pre-encoded quantized tensors as ``(numpy_shape, ggml_type, raw_bytes)``
+    tuples (e.g. Q8_0 blocks)."""
     rev_types = {np.dtype(np.float32): GGML_F32, np.dtype(np.float16): GGML_F16}
     if _BF16 is not None:
         rev_types[_BF16] = GGML_BF16
@@ -298,15 +320,18 @@ def save_gguf(path: str | Path, metadata: dict[str, Any],
         offset = 0
         blobs: list[bytes] = []
         for name, arr in tensors.items():
-            arr = np.ascontiguousarray(arr)
+            if isinstance(arr, tuple):
+                shape, gtype, blob = arr
+            else:
+                arr = np.ascontiguousarray(arr)
+                shape, gtype, blob = arr.shape, rev_types[np.dtype(arr.dtype)], arr.tobytes()
             _w_string(f, name)
-            dims = tuple(reversed(arr.shape))  # ggml: innermost first
+            dims = tuple(reversed(shape))  # ggml: innermost first
             f.write(struct.pack("<I", len(dims)))
             for d in dims:
                 f.write(struct.pack("<Q", d))
-            f.write(struct.pack("<I", rev_types[np.dtype(arr.dtype)]))
+            f.write(struct.pack("<I", gtype))
             f.write(struct.pack("<Q", offset))
-            blob = arr.tobytes()
             pad = (-len(blob)) % DEFAULT_ALIGNMENT
             blobs.append(blob + b"\0" * pad)
             offset += len(blob) + pad

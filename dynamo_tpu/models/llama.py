@@ -203,8 +203,26 @@ def paged_attention(
     return out.reshape(b, t, h, d).astype(q.dtype)
 
 
-def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array) -> jax.Array:
-    return (jax.nn.silu(x @ w_gate) * (x @ w_up)) @ w_down
+def mm(x: jax.Array, w) -> jax.Array:
+    """Dense matmul that understands weight-only int8 leaves
+    ({"q": int8, "so": per-out-channel scale} — models/quant.py). The
+    scale factors out of the contraction exactly; XLA fuses the int8→bf16
+    widening into the dot so weights stream from HBM at 1 byte/elem."""
+    if isinstance(w, dict):
+        return (x @ w["q"].astype(x.dtype)) * w["so"].astype(x.dtype)
+    return x @ w
+
+
+def embed_lookup(embed, token_ids: jax.Array, dt) -> jax.Array:
+    """Embedding gather over a plain or row-quantized ({"q","sr"}) table."""
+    if isinstance(embed, dict):
+        return (embed["q"][token_ids].astype(dt)
+                * embed["sr"][token_ids][..., None].astype(dt))
+    return embed[token_ids].astype(dt)
+
+
+def swiglu(x: jax.Array, w_gate, w_up, w_down) -> jax.Array:
+    return mm(jax.nn.silu(mm(x, w_gate)) * mm(x, w_up), w_down)
 
 
 def moe_mlp(x: jax.Array, lp: Params, cfg: ModelConfig) -> jax.Array:
@@ -305,7 +323,7 @@ def forward(
     )                                                              # [B, T]
     slot = jnp.where(valid, blk * bs + positions % bs, 0)
 
-    h = params["embed"][token_ids].astype(_dtype(cfg))             # [B, T, H]
+    h = embed_lookup(params["embed"], token_ids, _dtype(cfg))      # [B, T, H]
     if embed_override is not None:
         # Multimodal positions carry encoder outputs instead of token
         # embeddings (their placeholder ids exist only for position/hash
@@ -316,9 +334,9 @@ def forward(
         hid = carry
         lp, ck, cv = xs
         x = rms_norm(hid, lp["attn_norm"], cfg.rms_norm_eps)
-        q = (x @ lp["wq"]).reshape(b, t, cfg.num_heads, cfg.head_dim)
-        k = (x @ lp["wk"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
-        v = (x @ lp["wv"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        q = mm(x, lp["wq"]).reshape(b, t, cfg.num_heads, cfg.head_dim)
+        k = mm(x, lp["wk"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        v = mm(x, lp["wv"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         ck = _scatter_kv(ck, k, slot)
@@ -349,7 +367,7 @@ def forward(
             ctx_k = _gather_kv(ck, block_tables)
             ctx_v = _gather_kv(cv, block_tables)
             attn = paged_attention(q, ctx_k, ctx_v, positions, kv_lens)
-        attn = attn.reshape(b, t, cfg.q_size) @ lp["wo"]
+        attn = mm(attn.reshape(b, t, cfg.q_size), lp["wo"])
         hid = hid + attn
         x = rms_norm(hid, lp["mlp_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:
@@ -434,7 +452,7 @@ def forward_pp(
         block_tables, jnp.clip(positions // bs, 0, block_tables.shape[1] - 1), axis=1
     )
     slot = jnp.where(valid, blk * bs + positions % bs, 0)
-    h0 = params["embed"][token_ids].astype(_dtype(cfg))
+    h0 = embed_lookup(params["embed"], token_ids, _dtype(cfg))
 
     # Microbatch count: the largest divisor of the split axis ≤ the target
     # (default 2*pp — enough for ~2/3+ steady-state efficiency without
@@ -479,43 +497,6 @@ def forward_pp(
         qs_mb = q_start.reshape(m, bm)
         kl_mb = (q_start + q_len).reshape(m, bm)
 
-    def stage_block(lp_stack, ck_loc, cv_loc, h, pos_t, slot_t, bt_t, qs_t, kl_t):
-        """One stage's layers on one microbatch — same math as the
-        unsharded layer_fn, attention over the stage's local cache slice."""
-
-        def layer_fn(carry, xs):
-            hid = carry
-            lp, ck, cv = xs
-            x = rms_norm(hid, lp["attn_norm"], cfg.rms_norm_eps)
-            q = (x @ lp["wq"]).reshape(bm, tm, cfg.num_heads, cfg.head_dim)
-            k = (x @ lp["wk"]).reshape(bm, tm, cfg.num_kv_heads, cfg.head_dim)
-            v = (x @ lp["wv"]).reshape(bm, tm, cfg.num_kv_heads, cfg.head_dim)
-            q = rope(q, pos_t, cfg.rope_theta)
-            k = rope(k, pos_t, cfg.rope_theta)
-            ck = _scatter_kv(ck, k, slot_t)
-            cv = _scatter_kv(cv, v, slot_t)
-            if use_kernel:
-                from dynamo_tpu.ops.paged_attention import paged_attention_kernel
-
-                attn = paged_attention_kernel(
-                    q, ck, cv, bt_t, qs_t, kl_t,
-                    interpret=(attn_impl == "pallas_interpret"))
-            else:
-                ctx_k = _gather_kv(ck, bt_t)
-                ctx_v = _gather_kv(cv, bt_t)
-                attn = paged_attention(q, ctx_k, ctx_v, pos_t, kl_t)
-            hid = hid + attn.reshape(bm, tm, cfg.q_size) @ lp["wo"]
-            x = rms_norm(hid, lp["mlp_norm"], cfg.rms_norm_eps)
-            if cfg.is_moe:
-                mlp_out = moe_mlp(x, lp, cfg)
-            else:
-                mlp_out = swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
-            hid = hid + mlp_out
-            return hid, (ck, cv)
-
-        h, (ck_loc, cv_loc) = lax.scan(layer_fn, h, (lp_stack, ck_loc, cv_loc))
-        return h, ck_loc, cv_loc
-
     def pp_fn(lp_stack, ck_loc, cv_loc, h0_mb, pos_mb, slot_mb, bt_mb, qs_mb, kl_mb):
         s = lax.axis_index("pipe")
 
@@ -529,9 +510,9 @@ def forward_pp(
             # and the output contribution is masked.
             slot_t = jnp.where(live, slot_mb[mbc], 0)
             h_in = jnp.where(s == 0, h0_mb[mbc], h_cur)
-            h_out, ck, cv = stage_block(
-                lp_stack, ck, cv, h_in, pos_mb[mbc], slot_t, bt_mb[mbc],
-                qs_mb[mbc], kl_mb[mbc])
+            h_out, ck, cv = _pp_stage_block(
+                cfg, lp_stack, ck, cv, h_in, pos_mb[mbc], slot_t, bt_mb[mbc],
+                kl_mb[mbc], attn_impl=attn_impl, q_start=qs_mb[mbc])
             out = out.at[mbc].add(jnp.where((s == pp - 1) & live, h_out, 0))
             h_nxt = lax.ppermute(
                 h_out, "pipe", [(j, (j + 1) % pp) for j in range(pp)])
@@ -557,46 +538,64 @@ def forward_pp(
     return last_h, cache_k, cache_v
 
 
+def _pp_stage_block(cfg, lp_stack, ck_loc, cv_loc, h, pos, slot, bt, kv_lens,
+                    attn_impl="dense", q_start=None):
+    """One pipeline stage's layer block — the shared layer math of BOTH pp
+    schedules (microbatched and sequential fallback): same per-layer flow
+    as forward's layer_fn, attention over the stage's local cache slice.
+    ``q_start`` is only needed by the Pallas kernel path."""
+    b_, t_ = pos.shape
+
+    def layer_fn(carry, xs):
+        hid = carry
+        lp, ck, cv = xs
+        x = rms_norm(hid, lp["attn_norm"], cfg.rms_norm_eps)
+        q = mm(x, lp["wq"]).reshape(b_, t_, cfg.num_heads, cfg.head_dim)
+        k = mm(x, lp["wk"]).reshape(b_, t_, cfg.num_kv_heads, cfg.head_dim)
+        v = mm(x, lp["wv"]).reshape(b_, t_, cfg.num_kv_heads, cfg.head_dim)
+        q = rope(q, pos, cfg.rope_theta)
+        k = rope(k, pos, cfg.rope_theta)
+        ck = _scatter_kv(ck, k, slot)
+        cv = _scatter_kv(cv, v, slot)
+        if attn_impl in ("pallas", "pallas_interpret"):
+            from dynamo_tpu.ops.paged_attention import paged_attention_kernel
+
+            attn = paged_attention_kernel(
+                q, ck, cv, bt, q_start, kv_lens,
+                interpret=(attn_impl == "pallas_interpret"))
+        else:
+            ctx_k = _gather_kv(ck, bt)
+            ctx_v = _gather_kv(cv, bt)
+            attn = paged_attention(q, ctx_k, ctx_v, pos, kv_lens)
+        hid = hid + mm(attn.reshape(b_, t_, cfg.q_size), lp["wo"])
+        x = rms_norm(hid, lp["mlp_norm"], cfg.rms_norm_eps)
+        if cfg.is_moe:
+            mlp_out = moe_mlp(x, lp, cfg)
+        else:
+            mlp_out = swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+        hid = hid + mlp_out
+        return hid, (ck, cv)
+
+    h, (ck_loc, cv_loc) = lax.scan(layer_fn, h, (lp_stack, ck_loc, cv_loc))
+    return h, ck_loc, cv_loc
+
+
 def _forward_pp_sequential(params, cfg, positions, kv_lens, slot, block_tables,
                            cache_k, cache_v, mesh, h0, q_len, pp):
     """Fallback pipeline for shapes too small to microbatch (e.g. a lone
     decode row): pp select-and-broadcast rounds — every stage computes the
     full batch each round, round i keeps stage i's result. Efficiency 1/pp;
-    correctness identical."""
+    correctness identical. Dense attention only (the warning at the call
+    site covers the kernel case)."""
     b, t = positions.shape
     from jax.sharding import PartitionSpec as P
-
-    def stage_block(lp_stack, ck_local, cv_local, h):
-        def layer_fn(carry, xs):
-            hid = carry
-            lp, ck, cv = xs
-            x = rms_norm(hid, lp["attn_norm"], cfg.rms_norm_eps)
-            q = (x @ lp["wq"]).reshape(b, t, cfg.num_heads, cfg.head_dim)
-            k = (x @ lp["wk"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
-            v = (x @ lp["wv"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
-            q = rope(q, positions, cfg.rope_theta)
-            k = rope(k, positions, cfg.rope_theta)
-            ck = _scatter_kv(ck, k, slot)
-            cv = _scatter_kv(cv, v, slot)
-            ctx_k = _gather_kv(ck, block_tables)
-            ctx_v = _gather_kv(cv, block_tables)
-            attn = paged_attention(q, ctx_k, ctx_v, positions, kv_lens)
-            hid = hid + attn.reshape(b, t, cfg.q_size) @ lp["wo"]
-            x = rms_norm(hid, lp["mlp_norm"], cfg.rms_norm_eps)
-            if cfg.is_moe:
-                mlp_out = moe_mlp(x, lp, cfg)
-            else:
-                mlp_out = swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
-            hid = hid + mlp_out
-            return hid, (ck, cv)
-
-        h, (ck_local, cv_local) = lax.scan(layer_fn, h, (lp_stack, ck_local, cv_local))
-        return h, ck_local, cv_local
 
     def pp_fn(lp_stack, ck_local, cv_local, h):
         s = lax.axis_index("pipe")
         for i in range(pp):
-            h_out, ck_new, cv_new = stage_block(lp_stack, ck_local, cv_local, h)
+            h_out, ck_new, cv_new = _pp_stage_block(
+                cfg, lp_stack, ck_local, cv_local, h, positions, slot,
+                block_tables, kv_lens)
             keep = s == i
             ck_local = jnp.where(keep, ck_new, ck_local)
             cv_local = jnp.where(keep, cv_new, cv_local)
@@ -617,7 +616,12 @@ def _forward_pp_sequential(params, cfg, positions, kv_lens, slot, block_tables,
 
 
 def logits_from_hidden(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
-    """Project hidden [B,H] → logits [B,V] (tied or separate lm head)."""
+    """Project hidden [B,H] → logits [B,V] (tied or separate lm head).
+    Row-quantized embeddings put the scale on the vocab axis, so it
+    applies per logit column after the contraction."""
     if cfg.tie_word_embeddings:
-        return hidden @ params["embed"].T
-    return hidden @ params["lm_head"]
+        e = params["embed"]
+        if isinstance(e, dict):
+            return (hidden @ e["q"].astype(hidden.dtype).T) * e["sr"].astype(hidden.dtype)
+        return hidden @ e.T
+    return mm(hidden, params["lm_head"])

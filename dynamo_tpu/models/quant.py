@@ -1,0 +1,119 @@
+"""Weight-only int8 quantization: per-channel scales, bf16 compute.
+
+Fills the role of the reference's quantized serving path (reference: the
+baseline model is Llama-3.3-70B-Instruct-FP8,
+recipes/llama-3-70b/vllm/agg/deploy.yaml:36-47, served through vLLM's
+quantized kernels) — redesigned for TPU: batched decode is HBM-bandwidth
+bound (roofline tok/s = batch * BW / param_bytes), so storing weights as
+int8 halves the bytes read per step and directly doubles the decode
+roofline. Compute stays bf16 on the MXU: the dequant is a cast fused by
+XLA into the consuming matmul (weights stream from HBM as int8, widen in
+registers), never materialized.
+
+Scheme: symmetric per-output-channel scales. For a matrix W[in, out],
+``scale[o] = max_i |W[i,o]| / 127`` and ``q = round(W/scale)``; the
+matmul applies the scale AFTER the contraction — ``(x @ q) * scale`` —
+which is exact algebra because the scale is constant along the
+contracted axis. The embedding quantizes per vocab row, which serves
+both the gather (row dequant) and the tied lm_head (scale per logit
+column). A quantized leaf is the pytree ``{"q": int8, "so"|"sr": float32}``;
+``llama.mm`` consumes either representation, so every forward variant
+(TP, PP stages, fused windows) works unchanged. The scheme rides in the
+key name ("so" out-channel / "sr" row) — static structure, jit-safe.
+
+Quantization happens AFTER mesh placement: the elementwise quantize jit
+preserves the source sharding, so TP/EP layouts carry over for free.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("quant")
+
+# Matrices consumed through llama.mm (contraction along the second-to-last
+# axis, scale on the last). MoE expert tensors ride einsum/ragged paths and
+# stay bf16 for now.
+_MM_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+            "shared_gate", "shared_up", "shared_down")
+
+
+def is_quantized(leaf) -> bool:
+    # "so" = per-output-channel scale (mm matrices); "sr" = per-row scale
+    # (embedding). The scheme lives in the KEY name — static pytree
+    # structure, so jitted step fns take quantized params unchanged.
+    return isinstance(leaf, dict) and "q" in leaf and ("so" in leaf or "sr" in leaf)
+
+
+@partial(jax.jit, donate_argnums=0)
+def _quant_mm(w):
+    """[..., in, out] → q int8 + per-out-channel scale [..., out]."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[..., None, :]),
+                 -127, 127).astype(jnp.int8)
+    return {"q": q, "so": scale}
+
+
+@partial(jax.jit, donate_argnums=0)
+def _quant_rows(w):
+    """[rows, h] → q int8 + per-row scale [rows] (embedding / lm vocab)."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return {"q": q, "sr": scale}
+
+
+def quantize_params_int8(params: dict, cfg: ModelConfig,
+                         quantize_embed: bool = True) -> dict:
+    """Quantize the big matrices of a loaded params pytree in place of
+    their bf16 leaves. Norms stay bf16 (tiny, precision-sensitive); MoE
+    expert stacks stay bf16 (einsum/ragged paths). Idempotent: an
+    already-quantized tree passes through."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    skipped = []
+    for key in _MM_KEYS:
+        if key not in layers or is_quantized(layers[key]):
+            continue
+        if cfg.is_moe and key in ("w_gate", "w_up", "w_down"):
+            skipped.append(key)
+            continue
+        layers[key] = _quant_mm(layers[key])
+    out["layers"] = layers
+    if quantize_embed and not is_quantized(params["embed"]):
+        out["embed"] = _quant_rows(params["embed"])
+        if "lm_head" in params and not is_quantized(params["lm_head"]):
+            # lm_head is [h, vocab]: per-vocab-column scale == per-row of
+            # the transpose — same _quant_mm geometry.
+            out["lm_head"] = _quant_mm(params["lm_head"])
+    if skipped:
+        log.warning("int8 quantization skipped MoE expert tensors %s "
+                    "(einsum/ragged dispatch paths are bf16-only for now)",
+                    skipped)
+    return out
+
+
+def dequantize_params(params: dict) -> dict:
+    """Inverse (testing): expand every quantized leaf back to floats."""
+    def deq(leaf):
+        if not is_quantized(leaf):
+            return leaf
+        q = leaf["q"].astype(jnp.float32)
+        if "sr" in leaf:
+            return q * leaf["sr"][..., None]
+        return q * leaf["so"][..., None, :]
+
+    return jax.tree.map(deq, params, is_leaf=is_quantized)
+
+
+def param_bytes(params: dict) -> int:
+    """Actual HBM bytes of a params pytree (int8 leaves count as 1B)."""
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))

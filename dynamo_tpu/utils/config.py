@@ -106,6 +106,11 @@ class EngineConfig:
     # (models/llama.forward_pp). 0 = auto (2*pp); shapes that don't divide
     # fall back to the sequential pipeline.
     pp_microbatches: int = 0
+    # Weight-only quantization (models/quant.py): "none" | "int8".
+    # int8 halves decode's HBM traffic (per-out-channel scales, bf16
+    # compute on the MXU) — the roofline-doubling lever for the
+    # bandwidth-bound decode metric.
+    quantization: str = "none"
     enable_prefix_caching: bool = True
     kv_event_publishing: bool = True
     # KVBM tiers (reference: lib/llm/src/block_manager.rs CacheLevel):

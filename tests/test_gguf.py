@@ -132,20 +132,36 @@ def test_engine_serves_gguf(gguf_file):
     assert a == b, f"gguf-loaded engine diverged: {a} != {b}"
 
 
-def test_quantized_rejected(tmp_path):
+def test_q4_0_dequantizes_and_unknown_type_rejected(tmp_path):
     import struct
 
     from dynamo_tpu.models.gguf import DEFAULT_ALIGNMENT, MAGIC, _w_string, _w_value
 
-    path = tmp_path / "quant.gguf"
-    with open(path, "wb") as f:
-        f.write(MAGIC + struct.pack("<I", 3) + struct.pack("<Q", 1) + struct.pack("<Q", 1))
-        _w_string(f, "general.architecture"); _w_value(f, "llama")
-        _w_string(f, "t")
-        f.write(struct.pack("<I", 1) + struct.pack("<Q", 32))
-        f.write(struct.pack("<I", 2))  # GGML_TYPE_Q4_0
-        f.write(struct.pack("<Q", 0))
-        f.write(b"\0" * 64)
-    r = GGUFReader(path)
-    with pytest.raises(ValueError, match="quantized"):
-        r.tensor("t")
+    def write_one(path, gtype, payload):
+        with open(path, "wb") as f:
+            f.write(MAGIC + struct.pack("<I", 3) + struct.pack("<Q", 1) + struct.pack("<Q", 1))
+            _w_string(f, "general.architecture"); _w_value(f, "llama")
+            _w_string(f, "t")
+            f.write(struct.pack("<I", 1) + struct.pack("<Q", 32))
+            f.write(struct.pack("<I", gtype))
+            f.write(struct.pack("<Q", 0))
+            f.write(b"\0" * ((-f.tell()) % DEFAULT_ALIGNMENT))  # data align
+            f.write(payload)
+
+    # Q4_0 (type 2) now dequantizes: one block, scale 2.0, nibbles = i%16
+    import numpy as np
+
+    nibs = bytes((i % 16) | (((i + 16) % 16) << 4) for i in range(16))
+    blk = struct.pack("<e", 2.0) + nibs
+    q4 = tmp_path / "q4.gguf"
+    write_one(q4, 2, blk + b"\0" * 64)
+    got = GGUFReader(q4).tensor("t")
+    lo = (np.arange(16) % 16 - 8) * 2.0
+    assert got.shape == (32,)
+    assert np.allclose(got[:16], lo)
+
+    # a genuinely unsupported type (Q3_K = 12) still fails loudly
+    bad = tmp_path / "bad.gguf"
+    write_one(bad, 12, b"\0" * 64)
+    with pytest.raises(ValueError, match="supported"):
+        GGUFReader(bad).tensor("t")
