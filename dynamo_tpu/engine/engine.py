@@ -239,7 +239,8 @@ class ModelRunner:
 
     # ------------------------------------------------------------------
     def _build_step_fn(self, b: int, t: int, nblk: int, sp_prefill: bool = False,
-                       fast_greedy: bool = False, mm: bool = False):
+                       fast_greedy: bool = False, mm: bool = False,
+                       masked: bool = False):
         cfg = self.cfg
         trash_row = self.engine_cfg.max_batch_size
 
@@ -257,8 +258,10 @@ class ModelRunner:
             # guarantees the producing step has run.
             first = jnp.where(from_slot, slot_toks[slots], tokens[:, 0])
             tokens = tokens.at[:, 0].set(first)
-            emb_override = mm_args[0] if mm else None
-            emb_mask = mm_args[1] if mm else None
+            rest = list(mm_args)
+            emb_override = rest.pop(0) if mm else None
+            emb_mask = rest.pop(0) if mm else None
+            logit_mask = rest.pop(0) if masked else None
             hidden, ck, cv = llama.forward(params, cfg, tokens, q_start, q_len, bt, ck, cv,
                                            attn_impl=attn_impl, moe_impl=moe_impl,
                                            mesh=mesh, sp_prefill=sp_prefill,
@@ -266,6 +269,11 @@ class ModelRunner:
                                            embed_mask=emb_mask,
                                            pp_microbatches=pp_micro)
             logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
+            if masked:
+                # Structured output (engine/guided.py): the grammar's
+                # per-row allow-mask, additive in log space. The model
+                # program is untouched — only the sampling input shifts.
+                logits = logits + logit_mask
             write_slots = jnp.where(do_sample, slots, trash_row)
             if fast_greedy:
                 # Whole batch greedy + penalty-free (host-verified at
@@ -363,18 +371,19 @@ class ModelRunner:
                        **self._jit_shardings())
 
     def step_fn(self, b: int, t: int, nblk: int, sp_prefill: bool = False,
-                window: int = 1, fast_greedy: bool = False, mm: bool = False):
-        key = (b, t, nblk, sp_prefill, window, fast_greedy, mm)
+                window: int = 1, fast_greedy: bool = False, mm: bool = False,
+                masked: bool = False):
+        key = (b, t, nblk, sp_prefill, window, fast_greedy, mm, masked)
         if key not in self._step_fns:
             log.info("compiling step fn B=%d T=%d NBLK=%d sp_prefill=%s W=%d "
-                     "greedy=%s mm=%s", b, t, nblk, sp_prefill, window,
-                     fast_greedy, mm)
+                     "greedy=%s mm=%s masked=%s", b, t, nblk, sp_prefill,
+                     window, fast_greedy, mm, masked)
             if window > 1:
                 self._step_fns[key] = self._build_window_fn(
                     b, nblk, window, fast_greedy)
             else:
                 self._step_fns[key] = self._build_step_fn(
-                    b, t, nblk, sp_prefill, fast_greedy, mm)
+                    b, t, nblk, sp_prefill, fast_greedy, mm, masked)
         return self._step_fns[key]
 
     def used_fast_greedy(self) -> bool:
@@ -396,6 +405,7 @@ class ModelRunner:
         rows: list[tuple[Seq, int, int]],  # (seq, start, length) per row
         sample_rows: list[bool],
         window: int = 1,
+        masks: list | None = None,  # per-row bool[V] allow-masks (guided)
     ) -> tuple[jax.Array, jax.Array]:
         """Enqueue one bucketed step on the device WITHOUT blocking; returns
         device arrays (tokens [B] or [B, window], logprobs likewise) still
@@ -423,6 +433,7 @@ class ModelRunner:
             and all(start == 0 for _, start, _ in rows)
         )
 
+        masked = masks is not None and any(m is not None for m in masks)
         tokens = np.zeros((b, t), np.int32)
         q_start = np.zeros((b,), np.int32)
         q_len = np.zeros((b,), np.int32)
@@ -490,9 +501,18 @@ class ModelRunner:
                 emb_mask[i, lo - start:hi - start] = True
         mm = emb_override is not None
 
-        fn = self.step_fn(b, t, nblk, sp_prefill, window, fast_greedy, mm)
+        if masked:
+            fast_greedy = False
+            logit_mask = np.zeros((b, self.cfg.vocab_size), np.float32)
+            for i, m in enumerate(masks):
+                if m is not None:
+                    logit_mask[i, ~m] = -1e30
+        fn = self.step_fn(b, t, nblk, sp_prefill, window, fast_greedy, mm,
+                          masked)
         place = self._place
         extra = ((place(emb_override), place(emb_mask)) if mm else ())
+        if masked:
+            extra = (*extra, place(logit_mask))
         (self.cache_k, self.cache_v, self.counts, self.keys, self.slot_toks,
          toks, lps) = fn(
             self.params, self.cache_k, self.cache_v, self.counts, self.keys,
@@ -564,6 +584,7 @@ class ModelRunner:
         nblk_need = max(len(s.block_ids) for s, _, _ in rows)
         nblk = min(_pow2_bucket(max(nblk_need, 1), 4, self.max_nblk), self.max_nblk)
 
+        masked = masks is not None and any(m is not None for m in masks)
         tokens = np.zeros((b, t), np.int32)
         q_start = np.zeros((b,), np.int32)
         q_len = np.zeros((b,), np.int32)
@@ -722,6 +743,9 @@ class EngineCore:
         self.metrics = EngineMetrics()
         self._seqs: dict[str, Seq] = {}
         self.default_eos: list[int] = []
+        # Structured output: token-id → text table + tokenizer EOS, built
+        # lazily on the first guided request (engine/guided.py).
+        self._guided_vocab: tuple[list[str], list[int]] | None = None
         self.kvbm: "OffloadManager | None" = None
         if (engine_cfg.host_kv_blocks > 0 or engine_cfg.disk_kv_path
                 or engine_cfg.remote_kv_addr):
@@ -782,6 +806,17 @@ class EngineCore:
                 vote_plans=(jax.process_count() > 1
                             and bool(engine_cfg.remote_kv_addr)))
 
+    def _guided_pieces(self) -> tuple[list[str], list[int]]:
+        if self._guided_vocab is None:
+            from dynamo_tpu.tokenizer import load_tokenizer
+
+            tok = load_tokenizer(self.engine_cfg.model)
+            v = self.runner.cfg.vocab_size
+            pieces = [tok.decode([i]) for i in range(v)]
+            eos = getattr(tok, "eos_id", None)
+            self._guided_vocab = (pieces, [eos] if eos is not None else [])
+        return self._guided_vocab
+
     # ------------------------------------------------------------------
     def add_request(self, req: PreprocessedRequest) -> LLMEngineOutput | None:
         """Queue a request; returns an immediate error output if rejected."""
@@ -790,6 +825,13 @@ class EngineCore:
                 finish_reason=FinishReason.ERROR, error="empty prompt (no token_ids)"
             )
         seq = Seq(req=req, block_size=self.engine_cfg.block_size)
+        if req.sampling_options.guided_json is not None:
+            from dynamo_tpu.engine.guided import TokenMasker
+
+            pieces, tok_eos = self._guided_pieces()
+            eos_ids = list(req.eos_token_ids or self.default_eos or tok_eos)
+            seq.guided = TokenMasker(pieces, eos_ids,
+                                     req.sampling_options.guided_json)
         if req.mm_embeddings:
             if self.engine_cfg.sp > 1 or self.engine_cfg.pp > 1:
                 return LLMEngineOutput(
@@ -897,8 +939,20 @@ class EngineCore:
         # (decode first — see scheduler module docstring for why they are
         # not one padded batch).
         pending = PendingStep()
-        batches: list[tuple[str, list, list[bool], int]] = []
+        batches: list[tuple[str, list, list[bool], int, list | None]] = []
         decode_seqs = plan.decode
+        guided_rows: list = []
+        if any(s.guided is not None for s in decode_seqs):
+            rest = []
+            for s in decode_seqs:
+                if s.guided is None:
+                    rest.append(s)
+                elif s.inflight_samples == 0:
+                    # Unpipelined by design: the mask for token t needs
+                    # token t-1 materialized on the host.
+                    guided_rows.append((s, s.num_computed, 1))
+                # else: pause this cycle until the in-flight token lands
+            decode_seqs = rest
         if self.engine_cfg.spec_ngram > 0 and decode_seqs:
             verify_rows, verify_chunks, decode_seqs = self._plan_verify(decode_seqs)
             if verify_rows:
@@ -911,7 +965,11 @@ class EngineCore:
                     ("verify", verify_rows, verify_chunks, toks, lps))
         if decode_seqs:
             rows = [(s, s.num_computed, 1) for s in decode_seqs]
-            batches.append(("decode", rows, [True] * len(rows), plan.decode_window))
+            batches.append(("decode", rows, [True] * len(rows),
+                            plan.decode_window, None))
+        if guided_rows:
+            batches.append(("decode", guided_rows, [True] * len(guided_rows),
+                            1, [s.guided.mask() for s, _, _ in guided_rows]))
         if plan.prefill:
             rows = [(w.seq, w.start, w.length) for w in plan.prefill]
             # Sample only on the chunk completing a *fresh* prompt; a
@@ -922,10 +980,19 @@ class EngineCore:
                 and len(w.seq.tokens) == w.seq.prompt_len
                 for w in plan.prefill
             ]
-            batches.append(("prefill", rows, sample_rows, 1))
+            pf_masks = None
+            if any(w.seq.guided is not None and s for w, s in
+                   zip(plan.prefill, sample_rows)):
+                # The FIRST sampled token must already obey the grammar.
+                pf_masks = [
+                    w.seq.guided.mask()
+                    if (w.seq.guided is not None and sample_rows[i]) else None
+                    for i, w in enumerate(plan.prefill)]
+            batches.append(("prefill", rows, sample_rows, 1, pf_masks))
 
-        for kind, rows, sample_rows, window in batches:
-            toks, lps = self.runner.dispatch(rows, sample_rows, window=window)
+        for kind, rows, sample_rows, window, b_masks in batches:
+            toks, lps = self.runner.dispatch(rows, sample_rows, window=window,
+                                             masks=b_masks)
             # Value-independent bookkeeping, done at dispatch so the next
             # plan() sees advanced positions. Token metrics count at
             # finalize, so discarded speculative rows don't inflate them.
@@ -958,7 +1025,7 @@ class EngineCore:
         ec = self.engine_cfg
         verify_rows, verify_chunks, plain = [], [], []
         for seq in decode_seqs:
-            if not greedy_eligible(seq.req.sampling_options):
+            if seq.guided is not None or not greedy_eligible(seq.req.sampling_options):
                 plain.append(seq)
                 continue
             # cap proposals to stay inside the model context
@@ -993,6 +1060,8 @@ class EngineCore:
             seq.tokens.append(token)
             seq.block_seq.append(token)
             emitted.append(token)
+            if seq.guided is not None:
+                seq.guided.advance(token)
             reason = self._check_stop(seq, token)
             if reason is not None:
                 break

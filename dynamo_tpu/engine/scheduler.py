@@ -60,6 +60,10 @@ class Seq:
     # A speculative verify step is in flight: the scheduler must not plan
     # this seq again until finalize accepts/rolls back (engine/spec.py).
     verify_inflight: bool = False
+    # Structured output: a TokenMasker (engine/guided.py) constraining each
+    # sampled token to the request's JSON grammar. Guided seqs decode
+    # unpipelined in their own masked batches.
+    guided: object | None = None
     # Multimodal embedding spans [(pos, np.ndarray[K, H])]: encoder outputs
     # injected at prompt positions during prefill (engine dispatch). Spans
     # are retained for the seq's whole life — preemption recomputes the

@@ -54,7 +54,25 @@ class OpenAIPreprocessor:
             repetition_penalty=getattr(req, "repetition_penalty", None),
             seed=req.seed,
             n=req.n or 1,
+            guided_json=self._guided(req),
         )
+
+    @staticmethod
+    def _guided(req) -> dict | None:
+        """OpenAI response_format → the engine's guided_json constraint
+        (engine/guided.py): {} for json_object, the schema dict for
+        json_schema, None otherwise ("text" passes through)."""
+        rf = getattr(req, "response_format", None)
+        if not rf:
+            return None
+        kind = rf.get("type")
+        if kind == "json_object":
+            return {}
+        if kind == "json_schema":
+            js = rf.get("json_schema") or {}
+            schema = js.get("schema") if isinstance(js, dict) else None
+            return schema if isinstance(schema, dict) else {}
+        return None
 
     def _stops(self, req: ChatCompletionRequest | CompletionRequest, max_tokens: int | None,
                prompt_len: int) -> StopConditions:

@@ -62,6 +62,10 @@ class SamplingOptions:
     seed: int | None = None
     logprobs: int | None = None
     n: int = 1
+    # Structured output (OpenAI response_format): None = unconstrained,
+    # {} = json_object mode, non-empty dict = json_schema subset
+    # (engine/guided.py).
+    guided_json: dict | None = None
 
     def to_dict(self) -> dict:
         return {k: getattr(self, k) for k in self.__dataclass_fields__}

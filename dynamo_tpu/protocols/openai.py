@@ -66,6 +66,7 @@ class ChatCompletionRequest(BaseModel):
     user: str | None = None
     tools: list[dict[str, Any]] | None = None
     tool_choice: str | dict[str, Any] | None = None
+    response_format: dict[str, Any] | None = None
     ignore_eos: bool | None = None
     nvext: NvExt | None = None
 
@@ -99,6 +100,7 @@ class CompletionRequest(BaseModel):
     echo: bool = False
     seed: int | None = None
     user: str | None = None
+    response_format: dict[str, Any] | None = None
     ignore_eos: bool | None = None
     nvext: NvExt | None = None
 
