@@ -79,7 +79,8 @@ def percentile(values: list[float], p: float) -> float:
 
 
 class RequestResult:
-    __slots__ = ("ok", "ttft_s", "itl_s", "output_tokens", "latency_s", "error")
+    __slots__ = ("ok", "ttft_s", "itl_s", "output_tokens", "latency_s", "error",
+                 "status", "retry_after", "priority")
 
     def __init__(self) -> None:
         self.ok = False
@@ -88,12 +89,26 @@ class RequestResult:
         self.output_tokens = 0
         self.latency_s = 0.0
         self.error = ""
+        self.status = 0
+        self.retry_after = None  # Retry-After header value, if any
+        self.priority = ""
 
 
 async def one_request(session: aiohttp.ClientSession, url: str, model: str,
                       isl: int, osl: int, seed: int,
-                      chars_per_token: float) -> RequestResult:
+                      chars_per_token: float,
+                      priority: str | None = None,
+                      deadline_ms: float | None = None,
+                      client_id: str | None = None) -> RequestResult:
     res = RequestResult()
+    res.priority = priority or ""
+    headers = {}
+    if priority is not None:
+        headers["x-priority"] = priority
+    if deadline_ms is not None:
+        headers["x-deadline-ms"] = str(deadline_ms)
+    if client_id is not None:
+        headers["x-client-id"] = client_id
     body = {
         "model": model,
         "messages": [{"role": "user", "content": make_prompt(isl, seed, chars_per_token)}],
@@ -106,8 +121,11 @@ async def one_request(session: aiohttp.ClientSession, url: str, model: str,
     t0 = time.perf_counter()
     prev = t0
     try:
-        async with session.post(f"{url}/v1/chat/completions", json=body) as resp:
+        async with session.post(f"{url}/v1/chat/completions", json=body,
+                                headers=headers) as resp:
+            res.status = resp.status
             if resp.status != 200:
+                res.retry_after = resp.headers.get("Retry-After")
                 res.error = f"http {resp.status}: {(await resp.text())[:200]}"
                 return res
             async for raw in resp.content:
@@ -200,19 +218,114 @@ async def run_load(url: str, model: str, concurrency: int, num_requests: int,
     }
 
 
+def _parse_mix(spec: str) -> list[tuple[str, float]]:
+    """"interactive=0.2,standard=0.3,batch=0.5" → cumulative class mix."""
+    mix = []
+    for part in spec.split(","):
+        name, _, frac = part.partition("=")
+        mix.append((name.strip(), float(frac or 1.0)))
+    total = sum(f for _, f in mix) or 1.0
+    return [(n, f / total) for n, f in mix]
+
+
+async def run_overload(url: str, model: str, arrival_rate: float,
+                       num_requests: int, isl: int, osl: int,
+                       priority_mix: str, expired_frac: float) -> dict:
+    """Open-loop overload mode: Poisson arrivals at a rate the engine cannot
+    sustain, mixed priority classes, a slice of already-expired deadlines.
+    Demonstrates QoS behavior: admitted high-priority traffic keeps a bounded
+    p99 while excess low-priority load is shed with 429 + Retry-After and
+    expired requests never consume engine compute (504/cancelled)."""
+    mix = _parse_mix(priority_mix)
+    rng = random.Random(4242)
+    counter = iter(range(10 ** 9))
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=30)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        cpt = await calibrate(session, url, model)
+        tasks: list[asyncio.Task] = []
+        t_start = time.perf_counter()
+        for _ in range(num_requests):
+            roll, pri = rng.random(), mix[-1][0]
+            acc = 0.0
+            for name, frac in mix:
+                acc += frac
+                if roll < acc:
+                    pri = name
+                    break
+            dl_ms = 0.0 if rng.random() < expired_frac else None
+            tasks.append(asyncio.create_task(one_request(
+                session, url, model, isl, osl, next(counter), cpt,
+                priority=pri, deadline_ms=dl_ms, client_id=f"loadgen-{pri}")))
+            await asyncio.sleep(rng.expovariate(arrival_rate))
+        results = await asyncio.gather(*tasks)
+        wall = time.perf_counter() - t_start
+
+    classes: dict[str, dict] = {}
+    for r in results:
+        c = classes.setdefault(r.priority or "default", {
+            "issued": 0, "completed": 0, "shed_429": 0, "unavailable_503": 0,
+            "expired_504": 0, "other_errors": 0, "retry_after_present": 0,
+            "_ttfts": [], "_e2es": []})
+        c["issued"] += 1
+        if r.ok:
+            c["completed"] += 1
+            c["_ttfts"].append(r.ttft_s)
+            c["_e2es"].append(r.latency_s)
+        elif r.status == 429:
+            c["shed_429"] += 1
+        elif r.status == 503:
+            c["unavailable_503"] += 1
+        elif r.status == 504:
+            c["expired_504"] += 1
+        else:
+            c["other_errors"] += 1
+        if r.retry_after is not None:
+            c["retry_after_present"] += 1
+    for c in classes.values():
+        c["ttft_p50_s"] = round(percentile(c.pop("_ttfts"), 50), 4)
+        c["e2e_p99_s"] = round(percentile(c.pop("_e2es"), 99), 4)
+    return {
+        "mode": "overload",
+        "arrival_rate": arrival_rate,
+        "requests": len(results),
+        "wall_s": round(wall, 3),
+        "classes": classes,
+    }
+
+
 def main(argv: list[str] | None = None) -> dict:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", default="http://127.0.0.1:8000")
     ap.add_argument("--model", default="tiny-llama")
+    ap.add_argument("--mode", choices=["closed", "overload"], default="closed",
+                    help="closed: fixed-concurrency loop; overload: open-loop "
+                         "Poisson arrivals past capacity (QoS shedding demo)")
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--isl", type=int, default=128)
     ap.add_argument("--osl", type=int, default=32)
     ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--arrival-rate", type=float, default=50.0,
+                    help="overload mode: mean requests/second issued")
+    ap.add_argument("--priority-mix", default="interactive=0.2,standard=0.3,batch=0.5",
+                    help="overload mode: class=frac list for issued traffic")
+    ap.add_argument("--expired-frac", type=float, default=0.05,
+                    help="overload mode: fraction sent with an already-expired "
+                         "deadline (must never reach prefill)")
     ap.add_argument("--chips", type=int, default=1,
                     help="chips serving the endpoint (for tok/s/chip)")
     ap.add_argument("--out", default=None, help="also write the JSON here")
     ns = ap.parse_args(argv)
+
+    if ns.mode == "overload":
+        result = asyncio.run(run_overload(
+            ns.url, ns.model, ns.arrival_rate, ns.requests, ns.isl, ns.osl,
+            ns.priority_mix, ns.expired_frac))
+        print(json.dumps(result))
+        if ns.out:
+            with open(ns.out, "w") as f:
+                json.dump(result, f, indent=2)
+        return result
 
     result = asyncio.run(run_load(
         ns.url, ns.model, ns.concurrency, ns.requests, ns.isl, ns.osl, ns.warmup))

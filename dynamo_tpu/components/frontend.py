@@ -50,7 +50,47 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--encoder-endpoint", default=None,
                    help="dyn://ns.encoder.encode — enables multimodal chat "
                         "via a remote encode worker (components/encode.py)")
+    # QoS gateway (dynamo_tpu/qos/): admission control + load shedding.
+    p.add_argument("--no-qos", action="store_true",
+                   help="disable the QoS gateway entirely")
+    p.add_argument("--qos-default-priority", default="standard",
+                   choices=["interactive", "standard", "batch"],
+                   help="priority class when the request carries none")
+    p.add_argument("--qos-rate-limit-rps", type=float, default=0.0,
+                   help="per-client token-bucket refill rate (0 = off)")
+    p.add_argument("--qos-rate-burst", type=float, default=10.0,
+                   help="per-client token-bucket burst size")
+    p.add_argument("--qos-degrade-queue-depth", type=int, default=16,
+                   help="queue depth at which max_tokens is clamped and "
+                        "speculative decode disabled")
+    p.add_argument("--qos-shed-queue-depth", type=int, default=32,
+                   help="queue depth at which batch-class requests get 429")
+    p.add_argument("--qos-max-queue-depth", type=int, default=64,
+                   help="queue depth above which only interactive admits")
+    p.add_argument("--qos-clamp-max-tokens", type=int, default=256,
+                   help="max_tokens ceiling applied under degradation")
+    p.add_argument("--qos-default-deadline-ms", type=float, default=None,
+                   help="deadline budget assigned to requests without one")
     return p.parse_args(argv)
+
+
+def qos_config_from_args(ns: argparse.Namespace):
+    """Build the gateway config from --qos-* flags (None when --no-qos)."""
+    from dynamo_tpu.qos import QosConfig
+
+    if getattr(ns, "no_qos", False):
+        return QosConfig(enabled=False)
+    return QosConfig(
+        default_priority=ns.qos_default_priority,
+        rate_limit_rps=ns.qos_rate_limit_rps,
+        rate_burst=ns.qos_rate_burst,
+        degrade_queue_depth=ns.qos_degrade_queue_depth,
+        shed_queue_depth=ns.qos_shed_queue_depth,
+        max_queue_depth=ns.qos_max_queue_depth,
+        full_queue_depth=2 * ns.qos_max_queue_depth,
+        clamp_max_tokens=ns.qos_clamp_max_tokens,
+        default_deadline_ms=ns.qos_default_deadline_ms,
+    )
 
 
 class ModelWatcher:
@@ -246,7 +286,7 @@ async def amain(ns: argparse.Namespace) -> None:
 
         watcher.image_encoder = image_encoder
     await watcher.start()
-    svc = HttpService(models)
+    svc = HttpService(models, qos=qos_config_from_args(ns))
     port = await svc.start(ns.host, ns.port,
                            tls_cert=ns.tls_cert, tls_key=ns.tls_key)
     grpc_srv = None

@@ -64,6 +64,15 @@ async def amain(ns: argparse.Namespace) -> None:
     ))
 
     async def handler(payload: dict, ctx: RequestContext):
+        if ctx.deadline_ts is None and isinstance(payload, dict):
+            # QoS deadline from the request annotations: expired work is
+            # dropped at the routing hop instead of being forwarded.
+            from dynamo_tpu.qos.deadline import deadline_of
+
+            ctx.deadline_ts = deadline_of(payload.get("annotations"))
+        if ctx.is_expired():
+            yield {"token_ids": [], "finish_reason": "cancelled"}
+            return
         async for item in router.generate(payload):
             if ctx.is_cancelled():
                 return

@@ -13,7 +13,11 @@ import asyncio
 import json
 import signal
 
-from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
 from dynamo_tpu.router.publisher import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.runtime.protocols import MODEL_PREFIX
 from dynamo_tpu.runtime.runtime import DistributedRuntime, RequestContext
@@ -332,6 +336,17 @@ async def amain(ns: argparse.Namespace) -> None:
     else:
         async def handler(payload: dict, ctx: RequestContext):
             req = PreprocessedRequest.from_dict(payload)
+            # QoS deadline rides the request annotations; stamping it on the
+            # ctx makes every is_cancelled() poll double as deadline
+            # enforcement, and an already-expired request short-circuits
+            # before the engine sees it.
+            from dynamo_tpu.qos.deadline import deadline_of
+
+            ctx.deadline_ts = ctx.deadline_ts or deadline_of(req.annotations)
+            if ctx.is_expired():
+                yield LLMEngineOutput(
+                    finish_reason=FinishReason.CANCELLED).to_dict()
+                return
             async for out in engine.generate(req):
                 if ctx.is_cancelled():
                     return

@@ -83,6 +83,9 @@ class EngineMetrics:
     # accepted = proposals that matched the true greedy path.
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # QoS: requests cancelled because their deadline passed (either while
+    # waiting — before any prefill — or mid-decode via the stop check).
+    deadline_cancelled: int = 0
 
     def snapshot(self, sched: Scheduler, pool: PrefixPool) -> dict:
         return {
@@ -98,6 +101,7 @@ class EngineMetrics:
             "prefix_hit_rate": self.prefix_hit_blocks / max(self.prefix_lookup_blocks, 1),
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,
+            "deadline_cancelled": self.deadline_cancelled,
         }
 
 
@@ -584,7 +588,6 @@ class ModelRunner:
         nblk_need = max(len(s.block_ids) for s, _, _ in rows)
         nblk = min(_pow2_bucket(max(nblk_need, 1), 4, self.max_nblk), self.max_nblk)
 
-        masked = masks is not None and any(m is not None for m in masks)
         tokens = np.zeros((b, t), np.int32)
         q_start = np.zeros((b,), np.int32)
         q_len = np.zeros((b,), np.int32)
@@ -743,6 +746,11 @@ class EngineCore:
         self.metrics = EngineMetrics()
         self._seqs: dict[str, Seq] = {}
         self.default_eos: list[int] = []
+        # Deadline clock for the current step window. On multi-host engines
+        # the leader stamps it over the op stream so every rank evaluates
+        # deadline expiry against the SAME instant — per-rank wall clocks
+        # would let ranks disagree on a cancellation and diverge.
+        self._step_now: float | None = None
         # Structured output: token-id → text table + tokenizer EOS, built
         # lazily on the first guided request (engine/guided.py).
         self._guided_vocab: tuple[list[str], list[int]] | None = None
@@ -808,22 +816,31 @@ class EngineCore:
 
     def _guided_pieces(self) -> tuple[list[str], list[int]]:
         if self._guided_vocab is None:
-            from dynamo_tpu.tokenizer import load_tokenizer
+            from dynamo_tpu.tokenizer import guided_vocab, load_tokenizer
 
             tok = load_tokenizer(self.engine_cfg.model)
-            v = self.runner.cfg.vocab_size
-            pieces = [tok.decode([i]) for i in range(v)]
+            pieces = guided_vocab(tok, self.runner.cfg.vocab_size)
             eos = getattr(tok, "eos_id", None)
             self._guided_vocab = (pieces, [eos] if eos is not None else [])
         return self._guided_vocab
 
     # ------------------------------------------------------------------
-    def add_request(self, req: PreprocessedRequest) -> LLMEngineOutput | None:
-        """Queue a request; returns an immediate error output if rejected."""
+    def add_request(self, req: PreprocessedRequest,
+                    now: float | None = None) -> LLMEngineOutput | None:
+        """Queue a request; returns an immediate error output if rejected.
+        `now` pins the deadline-expiry clock (multi-host replay passes the
+        leader's timestamp so all ranks make the same admit decision)."""
         if not req.token_ids:
             return LLMEngineOutput(
                 finish_reason=FinishReason.ERROR, error="empty prompt (no token_ids)"
             )
+        from dynamo_tpu.qos.deadline import deadline_of, expired
+
+        if expired(deadline_of(getattr(req, "annotations", None)), now):
+            # Already past deadline: never enters the scheduler, so no
+            # prefill compute is ever dispatched for it.
+            self.metrics.deadline_cancelled += 1
+            return LLMEngineOutput(finish_reason=FinishReason.CANCELLED)
         seq = Seq(req=req, block_size=self.engine_cfg.block_size)
         if req.sampling_options.guided_json is not None:
             from dynamo_tpu.engine.guided import TokenMasker
@@ -893,6 +910,14 @@ class EngineCore:
     def _check_stop(self, seq: Seq, token: int) -> FinishReason | None:
         sc = seq.req.stop_conditions
         n_out = seq.num_output_tokens
+        if seq.deadline_ts is not None:
+            from dynamo_tpu.qos.deadline import expired
+
+            if expired(seq.deadline_ts, self._step_now):
+                # Mid-decode deadline: nobody is waiting for the rest of
+                # this stream — stop burning decode steps on it.
+                self.metrics.deadline_cancelled += 1
+                return FinishReason.CANCELLED
         eos_ids = set(seq.req.eos_token_ids or self.default_eos)
         if token in (sc.stop_token_ids or []):
             return FinishReason.STOP
@@ -1163,10 +1188,36 @@ class EngineCore:
                 seq, emitted_all, lps[i], outputs, count_decode=True)
             self.metrics.spec_accepted += max(n_emitted - 1, 0)
 
+    def set_step_time(self, now: float | None) -> None:
+        """Pin the deadline clock for the next step window (op-stream
+        replay passes the leader's timestamp; see _step_now)."""
+        self._step_now = now
+
+    def has_expired_waiting(self, now: float | None = None) -> bool:
+        from dynamo_tpu.qos.deadline import expired
+
+        return any(expired(s.deadline_ts, now) for s in self.sched.waiting)
+
+    def reap_expired(self, now: float | None = None) -> dict[str, LLMEngineOutput]:
+        """Cancel WAITING seqs whose deadline passed and emit their terminal
+        outputs. Waiting seqs never flow through step batches, so without an
+        explicit reap an expired queued request would only die on admission."""
+        outs: dict[str, LLMEngineOutput] = {}
+        for seq in self.sched.expire_waiting(now):
+            self._seqs.pop(seq.request_id, None)
+            self.metrics.deadline_cancelled += 1
+            outs[seq.request_id] = LLMEngineOutput(finish_reason=FinishReason.CANCELLED)
+        return outs
+
     def step(self) -> dict[str, LLMEngineOutput]:
         """Run one engine step synchronously; returns per-request deltas."""
+        now = time.time()
+        self.set_step_time(now)
+        outs = self.reap_expired(now)
         pending = self.step_begin()
-        return self.step_finalize(pending) if pending is not None else {}
+        if pending is not None:
+            outs.update(self.step_finalize(pending))
+        return outs
 
     # -- disagg / KV-transfer primitives (engine-core thread only) ---------
     @property
@@ -1477,13 +1528,17 @@ class AsyncJaxEngine:
                     break
                 moved = True
                 if kind == "add":
+                    # The admit timestamp rides the op so follower ranks
+                    # evaluate deadline expiry at the leader's instant.
+                    t_add = time.time()
                     try:
-                        self._emit_op({"op": "add", "req": payload.to_dict()})
+                        self._emit_op({"op": "add", "req": payload.to_dict(),
+                                       "now": t_add})
                     except OpChannelDown as exc:
                         self._post(payload.request_id, LLMEngineOutput(
                             finish_reason=FinishReason.ERROR, error=str(exc)))
                         break
-                    err = self.core.add_request(payload)
+                    err = self.core.add_request(payload, now=t_add)
                     if err is not None:
                         self._post(payload.request_id, err)
                 elif kind == "abort":
@@ -1559,7 +1614,15 @@ class AsyncJaxEngine:
                 continue
             try:
                 if self.core.has_work() or pending is not None:
-                    self._emit_op({"op": "step"})
+                    t_step = time.time()
+                    if self.core.has_expired_waiting(t_step):
+                        # Broadcast-then-apply, like every state-changing op:
+                        # followers reap the same seqs at the same instant.
+                        self._emit_op({"op": "reap", "now": t_step})
+                        for rid, out in self.core.reap_expired(t_step).items():
+                            self._post(rid, out)
+                    self._emit_op({"op": "step", "now": t_step})
+                    self.core.set_step_time(t_step)
                 nxt = self.core.step_begin() if self.core.has_work() else None
                 if pending is not None:
                     outputs = self.core.step_finalize(pending)

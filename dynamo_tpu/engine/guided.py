@@ -43,6 +43,16 @@ log = get_logger("guided")
 
 _WS = " \t\n\r"
 _DIGITS = "0123456789"
+# Structural whitespace is bounded per run (progress forcing): a random
+# model under a grammar that admits unlimited inter-token whitespace would
+# happily spend its whole budget on newlines and never complete a document.
+# Two consecutive blanks cover every sane emission style; pretty-printers
+# with deeper indentation are outside the guided-decode contract.
+_MAX_WS = 2
+# Modes where whitespace is structural (between tokens) rather than string
+# content — only these count against the run bound.
+_WS_MODES = frozenset(
+    ("done", "value", "obj_open", "colon", "obj_post", "key_open", "arr_post"))
 # ONE canonical empty schema: signatures key sub-schemas by object identity
 # (the schema tree is shared across machine clones), so the fallback must
 # be a stable singleton — a fresh {} per transition would defeat the mask
@@ -61,7 +71,7 @@ class _Frame:
 
     def __init__(self, kind: str, schema: dict | None):
         self.kind = kind                  # "obj" | "arr"
-        self.schema = schema or {}
+        self.schema = schema if schema else _EMPTY
         self.seen: tuple[str, ...] = ()   # object keys already emitted
         self.pending_key: str | None = None
 
@@ -99,32 +109,38 @@ class JsonMachine:
     ``clone`` for trial runs.
     """
 
-    __slots__ = ("mode", "stack", "schema", "partial", "lit_rest", "num_state")
+    __slots__ = ("mode", "stack", "schema", "partial", "lit_rest", "num_state",
+                 "ws_run")
 
     def __init__(self, schema: dict | None = None):
         self.mode = "value"
         self.stack: list[_Frame] = []
-        self.schema = schema or {}        # schema of the value being read
+        self.schema = schema if schema else _EMPTY  # schema of the value being read
         self.partial = ""                 # current string/key content
         self.lit_rest = ""                # remaining literal chars
         self.num_state = ""               # coarse number validity state
+        self.ws_run = 0                   # consecutive structural whitespace
 
     def clone(self) -> "JsonMachine":
         m = JsonMachine.__new__(JsonMachine)
         m.mode, m.schema = self.mode, self.schema
         m.partial, m.lit_rest, m.num_state = self.partial, self.lit_rest, self.num_state
+        m.ws_run = self.ws_run
         m.stack = [f.clone() for f in self.stack]
         return m
 
     # -- signature for mask memoization ---------------------------------
     def signature(self) -> tuple:
         """Collapses states with identical allowed-token sets. The partial
-        string matters only under prefix constraints (keys / enums)."""
-        top = self.stack[-1] if self.stack else None
-        frame_sig = (top.kind, id(top.schema), top.seen) if top else None
+        string matters only under prefix constraints (keys / enums). Every
+        frame on the stack contributes (kind, schema, seen): two stacks that
+        agree only at the top can still differ on which closers are legal
+        (e.g. an outer object with pending required keys vs. one without) —
+        keying by the top frame alone reused wrong masks across them."""
+        frames = tuple((f.kind, id(f.schema), f.seen) for f in self.stack)
         partial = self.partial if self._candidates() is not None else ""
-        return (self.mode, id(self.schema), frame_sig, partial,
-                self.lit_rest, self.num_state, len(self.stack))
+        return (self.mode, id(self.schema), frames, partial,
+                self.lit_rest, self.num_state, self.ws_run)
 
     # -- constraints ----------------------------------------------------
     def _candidates(self) -> list[str] | None:
@@ -149,6 +165,12 @@ class JsonMachine:
     # -- feeding --------------------------------------------------------
     def feed(self, ch: str) -> None:
         """Consume one character or raise Reject."""
+        if ch in _WS and self.mode in _WS_MODES:
+            if self.ws_run >= _MAX_WS:
+                raise Reject
+            self.ws_run += 1
+            return
+        self.ws_run = 0
         m = self.mode
         if m == "done":
             if ch in _WS:
@@ -191,6 +213,12 @@ class JsonMachine:
                     self._value_done()
                 return
             if ch == "\\":
+                # Constrained strings (keys / enums) exclude escapes (see
+                # *_esc below); rejecting the backslash HERE keeps the next
+                # mask non-empty — deferring to the esc mode would be a
+                # dead end where every escape char is rejected.
+                if cands is not None:
+                    raise Reject
                 self.mode = m + "_esc"
                 return
             if ord(ch) < 0x20:
@@ -238,6 +266,13 @@ class JsonMachine:
             if ch in _WS:
                 return
             if ch == ",":
+                # A keyed object with every property already emitted has no
+                # legal next key — the comma itself is the dead end, reject
+                # it so the mask still contains the closing brace.
+                props = (self.stack[-1].schema or _EMPTY).get("properties")
+                if isinstance(props, dict) and \
+                        all(k in self.stack[-1].seen for k in props):
+                    raise Reject
                 self.mode = "key_open"
                 return
             if ch == "}":
@@ -310,7 +345,12 @@ class JsonMachine:
         self._value_done()
 
     def _value_done(self) -> None:
-        """A value finished; return to the parent context."""
+        """A value finished; return to the parent context. Scalar scratch
+        state is reset here: a stale num_state/lit_rest would otherwise leak
+        into the signature of every later state at the same stack shape and
+        alias distinct grammar states in the mask cache."""
+        self.num_state = ""
+        self.lit_rest = ""
         if not self.stack:
             self.mode = "done"
             return
@@ -323,6 +363,7 @@ class JsonMachine:
             # "]" while expecting a first array element closes the array
             if ch == "]" and self.mode == "value" and self.stack \
                     and self.stack[-1].kind == "arr":
+                self.ws_run = 0
                 self.stack.pop()
                 self._value_done()
                 continue

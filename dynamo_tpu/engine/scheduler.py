@@ -20,13 +20,14 @@ buckets keep XLA compile counts bounded.
 from __future__ import annotations
 
 import enum
-from collections import deque
 from dataclasses import dataclass, field
 from typing import Iterable
 
 from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
 from dynamo_tpu.protocols.common import FinishReason, PreprocessedRequest
+from dynamo_tpu.qos.deadline import NO_SPEC_KEY, deadline_of, expired, priority_of
+from dynamo_tpu.qos.wdrr import WdrrQueue
 from dynamo_tpu.tokens import TokenBlockSequence
 
 
@@ -71,11 +72,19 @@ class Seq:
     # lets decode dispatches skip the span scan with one comparison.
     mm_spans: list = field(default_factory=list)
     mm_end: int = 0
+    # QoS: priority class feeds the WDRR waiting queue; deadline_ts is an
+    # absolute wall-clock deadline after which the seq is cancelled (before
+    # prefill via expire_waiting, mid-decode via the engine's stop check).
+    qos_priority: str = "standard"
+    deadline_ts: float | None = None
 
     def __post_init__(self) -> None:
         self.tokens = list(self.req.token_ids)
         self.prompt_len = len(self.tokens)
         self.block_seq = TokenBlockSequence.from_tokens(self.tokens, self.block_size)
+        ann = getattr(self.req, "annotations", None)
+        self.qos_priority = priority_of(ann, self.qos_priority)
+        self.deadline_ts = deadline_of(ann)
 
     @property
     def request_id(self) -> str:
@@ -106,6 +115,11 @@ class Seq:
 def _spec_eligible(seq: "Seq") -> bool:
     from dynamo_tpu.engine.spec import greedy_eligible
 
+    ann = getattr(seq.req, "annotations", None)
+    if ann and ann.get(NO_SPEC_KEY):
+        # QoS degradation: under pressure, speculative width is the first
+        # throughput knob to go — draft compute serves latency, not capacity.
+        return False
     return greedy_eligible(seq.req.sampling_options)
 
 
@@ -139,6 +153,7 @@ class Scheduler:
         max_tokens_per_step: int = 8192,
         decode_window: int = 1,
         spec_lookahead: int = 0,
+        qos_weights: dict[str, int] | None = None,
     ):
         self.pool = pool
         self.max_batch_size = max_batch_size
@@ -149,7 +164,12 @@ class Scheduler:
         # Speculative verify chunks write KV for up to spec_k proposed
         # positions ahead — block growth must cover them (engine/spec.py).
         self.spec_lookahead = spec_lookahead
-        self.waiting: deque[Seq] = deque()
+        # Weighted deficit-round-robin over priority classes instead of a
+        # plain FIFO: interactive traffic admits ahead of batch without
+        # starving it (WdrrQueue is deque-compatible; preempted seqs resume
+        # ahead of all lanes via appendleft).
+        self.waiting: WdrrQueue = WdrrQueue(
+            key_fn=lambda s: s.qos_priority, weights=qos_weights)
         self.running: list[Seq] = []
         self._slot_free: list[int] = list(range(max_batch_size - 1, -1, -1))
         self.preemption_count = 0
@@ -252,6 +272,15 @@ class Scheduler:
         if seq.slot >= 0:
             self._slot_free.append(seq.slot)
             seq.slot = -1
+
+    def expire_waiting(self, now: float | None = None) -> list[Seq]:
+        """Cancel waiting seqs whose deadline has passed — before any
+        prefill compute is spent on them. Returns the cancelled seqs so
+        the engine can emit their terminal outputs."""
+        stale = [s for s in self.waiting if expired(s.deadline_ts, now)]
+        for seq in stale:
+            self.finish(seq, FinishReason.CANCELLED)
+        return stale
 
     # ------------------------------------------------------------------
     def plan(self) -> StepPlan:

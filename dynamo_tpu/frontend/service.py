@@ -39,6 +39,8 @@ from dynamo_tpu.protocols.openai import (
     ModelList,
 )
 from dynamo_tpu.protocols.sse import DONE_EVENT, encode_sse_json
+from dynamo_tpu.qos import QosConfig, QosGateway
+from dynamo_tpu.qos.deadline import CLIENT_HEADER, deadline_from, priority_from
 from dynamo_tpu.utils.logging import get_logger
 from dynamo_tpu.utils.metrics import MetricsRegistry
 from dynamo_tpu.utils.tls import validate_tls_pair
@@ -46,9 +48,11 @@ from dynamo_tpu.utils.tls import validate_tls_pair
 log = get_logger("frontend")
 
 
-def _error(status: int, message: str) -> web.Response:
+def _error(status: int, message: str,
+           headers: dict[str, str] | None = None) -> web.Response:
     body = ErrorResponse(error=ErrorInfo(message=message, code=status)).model_dump_json()
-    return web.Response(status=status, text=body, content_type="application/json")
+    return web.Response(status=status, text=body, content_type="application/json",
+                        headers=headers)
 
 
 
@@ -86,11 +90,20 @@ def _wants_logprobs(req, chat: bool) -> bool:
     return bool(req.logprobs) if chat else req.logprobs is not None
 
 class HttpService:
-    def __init__(self, models: ModelManager | None = None, metrics: MetricsRegistry | None = None):
+    def __init__(self, models: ModelManager | None = None, metrics: MetricsRegistry | None = None,
+                 qos: QosGateway | QosConfig | None = None):
         # NOT `models or ...`: ModelManager is empty (falsy by __len__) at
         # startup and models are registered later by the watcher.
         self.models = models if models is not None else ModelManager()
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        if isinstance(qos, QosGateway):
+            self.qos = qos
+        else:
+            # Default gateway: rate limiting off, capacity predicate fails
+            # open until a stats source reports, so behavior only changes
+            # under observed pressure or explicit configuration.
+            self.qos = QosGateway(qos if isinstance(qos, QosConfig) else None,
+                                  registry=self.metrics)
         m = self.metrics
         self._requests = m.counter("frontend_requests_total", "HTTP requests by route/status")
         self._inflight = m.gauge("frontend_inflight", "in-flight requests")
@@ -443,6 +456,9 @@ class HttpService:
             if req.n > 16:
                 self._requests.inc(route=route, status="400")
                 return _error(400, "n must be <= 16")
+        rejected = self._qos_gate(request, payload, req, entry, pre, route)
+        if rejected is not None:
+            return rejected
         self._inflight.inc(model=req.model)
         self._input_tokens.inc(len(pre.token_ids), model=req.model)
         self._model_requests.inc(model=req.model)
@@ -456,6 +472,47 @@ class HttpService:
         finally:
             self._inflight.inc(-1, model=req.model)
             self._req_dur.observe(time.monotonic() - t_start, model=req.model)
+
+    # ------------------------------------------------------------------
+    def _qos_gate(self, request: web.Request, payload: dict, req,
+                  entry: ModelEntry, pre, route: str) -> web.Response | None:
+        """Admission control: rate limit, capacity predicate, deadline.
+        Returns an error response for rejected requests, None when
+        admitted (after stamping priority/deadline annotations on `pre`
+        and applying degradation actions)."""
+        gw = self.qos
+        cfg = gw.cfg
+        priority = priority_from(request.headers, payload, cfg.default_priority)
+        deadline_ts = deadline_from(request.headers, payload, cfg.default_deadline_ms)
+        client = (request.headers.get(CLIENT_HEADER)
+                  or getattr(req, "user", None)
+                  or request.remote or "anonymous")
+        stats = None
+        if entry.stats is not None:
+            try:
+                stats = entry.stats()
+            except Exception:  # noqa: BLE001 - stats are advisory
+                stats = None
+        decision = gw.admit(str(client), priority, stats, deadline_ts)
+        if not decision.admitted:
+            self._requests.inc(route=route, status=str(decision.status))
+            headers = None
+            if decision.status in (429, 503):
+                import math as _math
+
+                headers = {"Retry-After": str(max(1, _math.ceil(
+                    decision.retry_after_s or cfg.retry_after_s)))}
+            msgs = {
+                "rate_limit": "rate limit exceeded for this client",
+                "shed": f"server over capacity; '{priority}' requests are being shed",
+                "overload": "server over capacity",
+                "deadline": "deadline already expired on arrival",
+            }
+            return _error(decision.status,
+                          msgs.get(decision.reason, "request rejected"),
+                          headers=headers)
+        gw.annotate(pre, priority, deadline_ts, decision)
+        return None
 
     # ------------------------------------------------------------------
     @staticmethod

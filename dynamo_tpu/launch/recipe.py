@@ -189,6 +189,19 @@ def build_plan(doc: dict, engine_override: str | None = None,
         fe_args += ["--grpc-port", str(fe["grpcPort"])]
     if "migrationLimit" in fe:
         fe_args += ["--migration-limit", str(fe["migrationLimit"])]
+    qos = fe.get("qos", {})
+    if qos.get("enabled") is False:
+        fe_args += ["--no-qos"]
+    for key, flag in (("defaultPriority", "--qos-default-priority"),
+                      ("rateLimitRps", "--qos-rate-limit-rps"),
+                      ("rateBurst", "--qos-rate-burst"),
+                      ("degradeQueueDepth", "--qos-degrade-queue-depth"),
+                      ("shedQueueDepth", "--qos-shed-queue-depth"),
+                      ("maxQueueDepth", "--qos-max-queue-depth"),
+                      ("clampMaxTokens", "--qos-clamp-max-tokens"),
+                      ("defaultDeadlineMs", "--qos-default-deadline-ms")):
+        if key in qos:
+            fe_args += [flag, str(qos[key])]
     plan.processes.append(Process(
         name="frontend", module="dynamo_tpu.components.frontend",
         args=fe_args, replicas=int(fe.get("replicas", 1)),

@@ -20,6 +20,8 @@ from typing import AsyncIterator, Callable
 from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
 from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.qos.config import class_rank
+from dynamo_tpu.qos.deadline import deadline_of, expired, priority_of
 from dynamo_tpu.router.events import KvCacheEvent
 from dynamo_tpu.tokens import TokenBlockSequence
 from dynamo_tpu.utils.logging import get_logger
@@ -55,6 +57,13 @@ class _MockSeq:
     cached_blocks: int = 0
     queue: asyncio.Queue = field(default_factory=asyncio.Queue)
     done: bool = False
+    priority: str = "standard"
+    deadline_ts: float | None = None
+
+    def __post_init__(self) -> None:
+        ann = getattr(self.req, "annotations", None)
+        self.priority = priority_of(ann, self.priority)
+        self.deadline_ts = deadline_of(ann)
 
 
 class MockEngine:
@@ -74,6 +83,7 @@ class MockEngine:
         self.prefix_hits = 0
         self.prefix_lookups = 0
         self.steps = 0
+        self.deadline_cancelled = 0
 
     def start(self) -> None:
         if self._task is None:
@@ -123,11 +133,21 @@ class MockEngine:
             # reap cancelled
             for seq in [s for s in self.running if s.done]:
                 self._finish(seq, None)
-            # admit
+            # admit — higher priority classes first (stable within a class,
+            # mirroring the real scheduler's WDRR front; QoS deadlines are
+            # enforced before any simulated prefill is spent)
+            self.waiting.sort(key=lambda s: class_rank(s.priority))
             while self.waiting and len(self.running) < a.max_batch_size:
                 seq = self.waiting[0]
                 if seq.done:  # client walked away before admission
                     self.waiting.pop(0)
+                    continue
+                if expired(seq.deadline_ts):
+                    self.waiting.pop(0)
+                    seq.done = True
+                    self.deadline_cancelled += 1
+                    seq.queue.put_nowait(
+                        LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
                     continue
                 hashes = seq.block_seq.sequence_hashes()
                 matchable = max((len(seq.req.token_ids) - 1) // a.block_size, 0)
@@ -189,6 +209,13 @@ class MockEngine:
             await asyncio.sleep(a.decode_itl_ms / 1e3 / a.speedup_ratio)
 
     def _emit_token(self, seq: _MockSeq) -> None:
+        if expired(seq.deadline_ts):
+            # Mid-decode deadline: stop the stream where it stands.
+            self.deadline_cancelled += 1
+            seq.queue.put_nowait(
+                LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
+            self._finish(seq, FinishReason.CANCELLED)
+            return
         tok = self._token_for(seq.req.request_id, seq.generated)
         seq.generated += 1
         seq.block_seq.append(tok)
@@ -229,6 +256,7 @@ class MockEngine:
             "kv_total_blocks": self.pool.num_blocks,
             "prefix_hit_rate": self.prefix_hits / max(self.prefix_lookups, 1),
             "num_steps": self.steps,
+            "deadline_cancelled": self.deadline_cancelled,
         }
 
     async def clear_kv(self) -> None:

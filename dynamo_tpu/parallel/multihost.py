@@ -322,9 +322,14 @@ def follower_loop(core_factory: Callable[[dict], Any], sock: socket.socket) -> N
             break
         kind = op["op"]
         if kind == "add":
-            core.add_request(PreprocessedRequest.from_dict(op["req"]))
+            # "now" pins deadline-expiry to the leader's clock so every
+            # rank makes the same admit decision (engine QoS deadlines).
+            core.add_request(PreprocessedRequest.from_dict(op["req"]),
+                             now=op.get("now"))
         elif kind == "abort":
             core.abort(op["rid"])
+        elif kind == "reap":
+            core.reap_expired(op.get("now"))
         elif kind == "exec":
             # Replayed named core op (disagg KV stage/release/import). The
             # leader surfaces its own failure to the caller and keeps
@@ -341,6 +346,7 @@ def follower_loop(core_factory: Callable[[dict], Any], sock: socket.socket) -> N
             # live follower. A crash instead would kill this rank before the
             # fail_all frame even arrives.
             try:
+                core.set_step_time(op.get("now"))
                 nxt = core.step_begin() if core.has_work() else None
                 if pending is not None:
                     core.step_finalize(pending)

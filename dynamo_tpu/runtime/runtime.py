@@ -50,9 +50,16 @@ class RequestContext:
     endpoint: str
     cancelled: asyncio.Event = field(default_factory=asyncio.Event)
     trace_headers: dict[str, str] = field(default_factory=dict)
+    # QoS: absolute wall-clock deadline (epoch seconds). An expired deadline
+    # reads as cancellation so every per-output `is_cancelled()` check in
+    # worker/router handlers doubles as mid-stream deadline enforcement.
+    deadline_ts: float | None = None
+
+    def is_expired(self) -> bool:
+        return self.deadline_ts is not None and time.time() >= self.deadline_ts
 
     def is_cancelled(self) -> bool:
-        return self.cancelled.is_set()
+        return self.cancelled.is_set() or self.is_expired()
 
 
 @dataclass
@@ -275,6 +282,15 @@ class DistributedRuntime:
             endpoint=target,
             trace_headers=msg.get("headers") or {},
         )
+        try:
+            # Deadline propagation for generic endpoints: LLM requests carry
+            # it in payload annotations (the worker handler re-stamps ctx),
+            # anything else can use this wire header.
+            hdr = ctx.trace_headers.get("x-deadline-ts")
+            if hdr is not None:
+                ctx.deadline_ts = float(hdr)
+        except (TypeError, ValueError):
+            pass
         self._inflight.inc(endpoint=target)
         try:
             async for item in served.handler(msg.get("payload"), ctx):

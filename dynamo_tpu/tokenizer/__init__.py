@@ -3,7 +3,9 @@ from dynamo_tpu.tokenizer.base import (
     ByteTokenizer,
     DecodeStream,
     HFTokenizer,
+    guided_vocab,
     load_tokenizer,
 )
 
-__all__ = ["BaseTokenizer", "ByteTokenizer", "DecodeStream", "HFTokenizer", "load_tokenizer"]
+__all__ = ["BaseTokenizer", "ByteTokenizer", "DecodeStream", "HFTokenizer",
+           "guided_vocab", "load_tokenizer"]

@@ -157,3 +157,54 @@ class DecodeStream:
         self._seg_ids.clear()
         self._seg_emitted = 0
         return delta
+
+
+def _byte_decoder() -> dict[str, int]:
+    """Inverse of the GPT-2 bytes→unicode table used by byte-level BPE
+    vocabs: printable chars map to themselves, the rest to a private range."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("¡"), ord("¬") + 1))
+          + list(range(ord("®"), ord("ÿ") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
+def guided_vocab(tok, size: int | None = None) -> list[str]:
+    """Token-id → text table for constrained decoding (engine/guided.py).
+
+    Built from the tokenizer's own vocab in one pass instead of V per-id
+    ``decode([i])`` round-trips: byte-level BPE pieces are mapped through
+    the GPT-2 byte decoder (exact text, leading-space markers included),
+    sentencepiece pieces get their ▁ marker substituted, and special tokens
+    decode to "" so the masker never trial-feeds control markup. ``size``
+    pads/truncates to the MODEL vocab (sharding may round it up)."""
+    if isinstance(tok, ByteTokenizer):
+        v = size or tok.vocab_size
+        pieces = [""] * v
+        for i in range(tok.OFFSET, min(tok.OFFSET + 256, v)):
+            pieces[i] = bytes([i - tok.OFFSET]).decode("utf-8", errors="replace")
+        return pieces
+    inner = getattr(tok, "_tok", None)
+    if inner is not None and hasattr(inner, "get_vocab"):
+        vocab = inner.get_vocab()
+        v = size or max(len(inner), max(vocab.values(), default=-1) + 1)
+        pieces = [""] * v
+        dec = _byte_decoder()
+        special = set(getattr(inner, "all_special_ids", None) or [])
+        for piece, idx in vocab.items():
+            if not (0 <= idx < v) or idx in special:
+                continue
+            if all(ch in dec for ch in piece):
+                pieces[idx] = bytes(dec[ch] for ch in piece).decode(
+                    "utf-8", errors="replace")
+            else:
+                pieces[idx] = piece.replace("▁", " ")
+        return pieces
+    v = size or tok.vocab_size
+    return [tok.decode([i]) for i in range(v)]

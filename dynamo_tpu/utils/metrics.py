@@ -13,7 +13,7 @@ import math
 import threading
 from collections import defaultdict
 from dataclasses import dataclass, field
-from typing import Iterable
+from typing import Callable, Iterable
 
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
@@ -62,6 +62,30 @@ class Gauge(Counter):
         for key, v in sorted(self._values.items()):
             labels = {**self.const, **dict(key)}
             yield f"{self.name}{_fmt_labels(labels)} {v}"
+
+
+class FuncGauge:
+    """Gauge whose value is computed at scrape time from a callback —
+    for live state (queue depths, tracked clients) that would otherwise
+    need a set() call on every mutation."""
+
+    def __init__(self, name: str, help_: str, const_labels: dict[str, str],
+                 fn: "Callable[[], float]"):
+        self.name, self.help = name, help_
+        self.const = const_labels
+        self.fn = fn
+
+    def get(self) -> float:
+        return float(self.fn())
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        try:
+            v = float(self.fn())
+        except Exception:
+            v = 0.0
+        yield f"{self.name}{_fmt_labels(self.const)} {v}"
 
 
 class Histogram:
@@ -137,6 +161,12 @@ class MetricsRegistry:
         key = "g:" + name
         if key not in self._metrics:
             self._metrics[key] = Gauge(self._full(name), help_, self.const_labels)
+        return self._metrics[key]  # type: ignore[return-value]
+
+    def func_gauge(self, name: str, fn, help_: str = "") -> FuncGauge:
+        key = "f:" + name
+        if key not in self._metrics:
+            self._metrics[key] = FuncGauge(self._full(name), help_, self.const_labels, fn)
         return self._metrics[key]  # type: ignore[return-value]
 
     def histogram(self, name: str, help_: str = "", buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:

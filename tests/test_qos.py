@@ -1,0 +1,579 @@
+"""QoS gateway: admission control, WDRR priority scheduling, deadline
+propagation, and load shedding (dynamo_tpu/qos/, docs/QOS.md).
+
+Unit tests cover the primitives with injected clocks; the e2e tests run
+the real HTTP frontend against a mocker engine and assert the externally
+visible contract: 429 + Retry-After for shed classes while interactive
+traffic completes, 504 for dead-on-arrival deadlines, and every decision
+visible in the Prometheus export.
+"""
+
+import asyncio
+
+import aiohttp
+
+from dynamo_tpu.frontend.model_manager import ModelManager
+from dynamo_tpu.frontend.service import HttpService
+from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
+from dynamo_tpu.preprocessor.preprocessor import ModelDefaults
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    StopConditions,
+)
+from dynamo_tpu.qos import (
+    AdmissionController,
+    ClientRateLimiter,
+    DEADLINE_KEY,
+    EngineLoad,
+    NO_SPEC_KEY,
+    PRIORITY_KEY,
+    QosConfig,
+    QosGateway,
+    TokenBucket,
+    WdrrQueue,
+    aggregate_stats,
+    class_rank,
+    deadline_of,
+    expired,
+    priority_of,
+)
+from dynamo_tpu.qos.admission import DEGRADE, FULL, OK, OVERLOAD, SHED
+from dynamo_tpu.qos.deadline import deadline_from, priority_from
+from dynamo_tpu.tokenizer import ByteTokenizer
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# token bucket
+
+
+def test_token_bucket_refill_and_retry_after():
+    clk = FakeClock()
+    b = TokenBucket(rate=1.0, burst=2.0, now_fn=clk)
+    assert b.try_acquire() and b.try_acquire()
+    assert not b.try_acquire()
+    assert b.retry_after() == 1.0  # 1 token deficit at 1 tok/s
+    clk.advance(0.5)
+    assert not b.try_acquire()
+    clk.advance(0.5)
+    assert b.try_acquire()
+    # refill never exceeds burst
+    clk.advance(100.0)
+    assert b.try_acquire() and b.try_acquire() and not b.try_acquire()
+
+
+def test_client_rate_limiter_disabled_and_lru():
+    clk = FakeClock()
+    off = ClientRateLimiter(rate=0.0, burst=1.0, now_fn=clk)
+    for _ in range(100):
+        assert off.check("c") == (True, 0.0)
+    assert len(off) == 0  # disabled limiter tracks nobody
+
+    lim = ClientRateLimiter(rate=1.0, burst=1.0, max_clients=2, now_fn=clk)
+    assert lim.check("a")[0] and lim.check("b")[0] and lim.check("c")[0]
+    assert len(lim) == 2  # LRU evicted "a"
+    allowed, retry = lim.check("c")  # burst spent, no refill yet
+    assert not allowed and retry == 1.0
+
+
+# ---------------------------------------------------------------------------
+# WDRR queue
+
+
+def _mk(cls, tag):
+    class Item:
+        qos_priority = cls
+
+        def __repr__(self):
+            return f"{cls}:{tag}"
+
+    return Item()
+
+
+def _drain(q):
+    out = []
+    while q:
+        out.append(q.popleft())
+    return out
+
+
+def test_wdrr_interactive_ahead_of_batch():
+    q = WdrrQueue()
+    batch = [_mk("batch", i) for i in range(3)]
+    inter = [_mk("interactive", i) for i in range(3)]
+    for s in batch + inter:
+        q.append(s)
+    order = _drain(q)
+    # weight 8 covers the whole interactive lane in one rotation visit
+    assert order[:3] == inter
+    assert order[3:] == batch  # FIFO within a class
+
+
+def test_wdrr_no_starvation():
+    q = WdrrQueue(weights={"a": 2, "b": 1})
+    a = [_mk("a", i) for i in range(6)]
+    b = [_mk("b", i) for i in range(6)]
+    for s in a + b:
+        q.append(s)
+    order = _drain(q)
+    assert len(order) == 12
+    # the low-weight class is served before the heavy lane fully drains
+    first_b = order.index(b[0])
+    assert first_b < 6, "batch class starved behind the heavy lane"
+
+
+def test_wdrr_peek_commits_across_enqueues():
+    q = WdrrQueue()
+    low = _mk("batch", 0)
+    q.append(low)
+    peeked = q[0]
+    assert peeked is low
+    # a higher-priority arrival must not change an already-committed peek
+    hi = _mk("interactive", 0)
+    q.append(hi)
+    assert q[0] is low
+    assert q.popleft() is low
+    assert q.popleft() is hi
+
+
+def test_wdrr_appendleft_resume_and_remove():
+    q = WdrrQueue()
+    x, y, z = _mk("standard", 0), _mk("standard", 1), _mk("interactive", 0)
+    q.append(x)
+    q.append(y)
+    q.appendleft(z)  # preempted seq resumes ahead of all lanes
+    assert len(q) == 3 and z in q
+    assert q[0] is z
+    q.remove(z)  # cancel the committed peek
+    assert z not in q and len(q) == 2
+    q.remove(y)  # remove from mid-lane
+    assert _drain(q) == [x]
+    assert not q and len(q) == 0
+    assert q.depths().get("standard", 0) == 0
+
+
+def test_wdrr_unknown_class_auto_registers():
+    q = WdrrQueue()
+    item = _mk("bulk-tier", 0)
+    q.append(item)
+    assert item in q
+    assert q.popleft() is item
+
+
+# ---------------------------------------------------------------------------
+# admission predicate
+
+
+def test_aggregate_stats_both_shapes():
+    flat = aggregate_stats({"num_waiting": 4, "num_running": 2,
+                            "kv_usage": 0.5, "kv_total_blocks": 100})
+    assert flat.known and flat.queue_depth == 4 and flat.workers == 1
+
+    watcher = aggregate_stats({"workers": {
+        "w1": {"num_waiting": 10, "kv_usage": 0.2},
+        "w2": {"num_waiting": 2, "kv_usage": 0.9},
+    }})
+    assert watcher.known and watcher.workers == 2
+    assert watcher.queue_depth == 6.0     # per-worker average
+    assert watcher.kv_usage == 0.9        # max across workers
+
+    assert not aggregate_stats(None).known
+    assert not aggregate_stats({}).known
+    assert not aggregate_stats({"unrelated": 1}).known
+
+
+def test_pressure_levels_and_decisions():
+    cfg = QosConfig()
+    ac = AdmissionController(cfg)
+    assert ac.pressure(EngineLoad()) == OK  # unknown load fails open
+    assert ac.pressure(EngineLoad(queue_depth=0, known=True)) == OK
+    assert ac.pressure(EngineLoad(queue_depth=16, known=True)) == DEGRADE
+    assert ac.pressure(EngineLoad(kv_usage=0.86, known=True)) == DEGRADE
+    assert ac.pressure(EngineLoad(queue_depth=32, known=True)) == SHED
+    assert ac.pressure(EngineLoad(queue_depth=64, known=True)) == OVERLOAD
+    assert ac.pressure(EngineLoad(kv_usage=0.99, known=True)) == OVERLOAD
+    assert ac.pressure(EngineLoad(queue_depth=128, known=True)) == FULL
+
+    shed = EngineLoad(queue_depth=40, workers=1, known=True)
+    d = ac.evaluate("batch", shed)
+    assert not d.admitted and d.status == 429 and d.reason == "shed"
+    assert d.retry_after_s >= cfg.retry_after_s
+    d = ac.evaluate("standard", shed)
+    assert d.admitted and d.degrade  # shed level still degrades admits
+    d = ac.evaluate("interactive", shed)
+    assert d.admitted
+
+    over = EngineLoad(queue_depth=70, workers=1, known=True)
+    assert not ac.evaluate("standard", over).admitted
+    assert ac.evaluate("interactive", over).admitted
+
+    full = EngineLoad(queue_depth=200, workers=1, known=True)
+    d = ac.evaluate("interactive", full)
+    assert not d.admitted and d.status == 503
+
+    # unknown priorities rank as standard
+    assert class_rank("no-such-class") == class_rank("standard")
+
+
+# ---------------------------------------------------------------------------
+# deadline helpers
+
+
+def test_deadline_parsing_and_expiry():
+    assert priority_from({"x-priority": " Interactive "}) == "interactive"
+    assert priority_from({}, {"priority": "BATCH"}) == "batch"
+    assert priority_from({}, {}, default="standard") == "standard"
+
+    ts = deadline_from({"x-deadline-ms": "250"}, now=100.0)
+    assert ts == 100.25
+    assert deadline_from({}, {"deadline_ms": 1000}, now=100.0) == 101.0
+    assert deadline_from({}, {}, default_ms=500, now=100.0) == 100.5
+    assert deadline_from({"x-deadline-ms": "junk"}, now=100.0) is None
+    assert deadline_from({}, {}) is None
+
+    assert not expired(None)
+    assert not expired(101.0, now=100.0)
+    assert expired(100.0, now=100.0)
+    assert expired(99.0, now=100.0)
+
+    ann = {DEADLINE_KEY: "123.5", PRIORITY_KEY: "batch"}
+    assert deadline_of(ann) == 123.5
+    assert priority_of(ann) == "batch"
+    assert deadline_of({DEADLINE_KEY: "junk"}) is None
+    assert deadline_of(None) is None and priority_of(None) == "standard"
+
+
+# ---------------------------------------------------------------------------
+# gateway
+
+
+def test_gateway_pipeline_and_metrics():
+    clk = FakeClock()
+    gw = QosGateway(QosConfig(rate_limit_rps=1.0, rate_burst=1.0),
+                    now_fn=clk, mono_fn=clk)
+    # expired deadline rejects before rate limiting spends a token
+    d = gw.admit("c1", "standard", None, deadline_ts=clk() - 1)
+    assert not d.admitted and d.status == 504 and d.reason == "deadline"
+    # first request admitted (unknown load fails open), second rate-limited
+    assert gw.admit("c1", "standard", None).admitted
+    d = gw.admit("c1", "standard", None)
+    assert not d.admitted and d.status == 429 and d.reason == "rate_limit"
+    assert d.retry_after_s > 0
+    # capacity shed
+    clk.advance(10.0)
+    d = gw.admit("c1", "batch", {"num_waiting": 40})
+    assert not d.admitted and d.reason == "shed"
+
+    text = gw.registry.expose()
+    assert 'dynamo_qos_rejected_total{priority="standard",reason="rate_limit"} 1.0' in text
+    assert 'dynamo_qos_rejected_total{priority="batch",reason="shed"} 1.0' in text
+    assert "dynamo_qos_pressure_level" in text
+    assert "dynamo_qos_tracked_clients 1.0" in text
+
+
+def test_gateway_annotate_degrades():
+    gw = QosGateway(QosConfig(clamp_max_tokens=8))
+    pre = PreprocessedRequest(token_ids=[1, 2],
+                              stop_conditions=StopConditions(max_tokens=512))
+    d = gw.admit("c", "standard", {"num_waiting": 20})  # DEGRADE level
+    assert d.admitted and d.degrade
+    gw.annotate(pre, "standard", 123.0, d)
+    assert pre.annotations[PRIORITY_KEY] == "standard"
+    assert pre.annotations[DEADLINE_KEY] == 123.0
+    assert pre.annotations[NO_SPEC_KEY] is True
+    assert pre.stop_conditions.max_tokens == 8
+    # annotations survive the wire format
+    rt = PreprocessedRequest.from_dict(pre.to_dict())
+    assert deadline_of(rt.annotations) == 123.0
+    assert priority_of(rt.annotations) == "standard"
+
+    # a request already under the clamp is left alone
+    gw2 = QosGateway(QosConfig(clamp_max_tokens=256))
+    pre2 = PreprocessedRequest(token_ids=[1],
+                               stop_conditions=StopConditions(max_tokens=4))
+    d2 = gw2.admit("c", "standard", {"num_waiting": 20})
+    gw2.annotate(pre2, "standard", None, d2)
+    assert pre2.stop_conditions.max_tokens == 4
+    assert DEADLINE_KEY not in pre2.annotations
+
+
+def test_gateway_disabled_admits_everything():
+    gw = QosGateway(QosConfig(enabled=False, rate_limit_rps=0.001, rate_burst=1))
+    for _ in range(10):
+        d = gw.admit("c", "batch", {"num_waiting": 10_000}, deadline_ts=0.0)
+        assert d.admitted
+
+
+# ---------------------------------------------------------------------------
+# engine scheduler integration
+
+
+def _seq(priority=None, deadline_ts=None, tokens=(1, 2, 3)):
+    from dynamo_tpu.engine.scheduler import Seq
+
+    ann = {}
+    if priority is not None:
+        ann[PRIORITY_KEY] = priority
+    if deadline_ts is not None:
+        ann[DEADLINE_KEY] = deadline_ts
+    return Seq(req=PreprocessedRequest(token_ids=list(tokens), annotations=ann),
+               block_size=4)
+
+
+def _sched():
+    from dynamo_tpu.engine.prefix_pool import PrefixPool
+    from dynamo_tpu.engine.scheduler import Scheduler
+
+    return Scheduler(PrefixPool(64, 4), max_batch_size=8,
+                     prefill_chunk=16, max_model_len=128)
+
+
+def test_scheduler_waiting_is_priority_ordered():
+    sched = _sched()
+    batch = [_seq("batch") for _ in range(3)]
+    inter = [_seq("interactive") for _ in range(3)]
+    for s in batch + inter:
+        sched.add(s)
+    order = []
+    while sched.waiting:
+        order.append(sched.waiting.popleft())
+    assert order[:3] == inter and order[3:] == batch
+
+
+def test_scheduler_expire_waiting():
+    sched = _sched()
+    live = _seq("standard", deadline_ts=2000.0)
+    stale = _seq("standard", deadline_ts=900.0)
+    undated = _seq("standard")
+    for s in (live, stale, undated):
+        sched.add(s)
+    cancelled = sched.expire_waiting(now=1000.0)
+    assert cancelled == [stale]
+    assert stale.finish_reason is FinishReason.CANCELLED
+    assert stale not in sched.waiting
+    assert live in sched.waiting and undated in sched.waiting
+    assert sched.expire_waiting(now=1000.0) == []
+
+
+def test_scheduler_plan_admits_priority_first():
+    sched = _sched()
+    for i in range(4):
+        sched.add(_seq("batch", tokens=[i, i + 1]))
+    sched.add(_seq("interactive", tokens=[9, 9]))
+    plan = sched.plan()
+    assert plan.prefill, "nothing admitted"
+    first = plan.prefill[0].seq
+    assert first.qos_priority == "interactive"
+
+
+# ---------------------------------------------------------------------------
+# e2e: HTTP frontend + mocker engine
+
+
+def canned_generate(text: str):
+    tok = ByteTokenizer()
+    ids = tok.encode(text)
+
+    async def generate(pre):
+        yield LLMEngineOutput(token_ids=ids, finish_reason=FinishReason.STOP)
+
+    return generate
+
+
+async def _serve(generate, stats=None, qos=None):
+    models = ModelManager()
+    models.register("m", ByteTokenizer(), generate,
+                    defaults=ModelDefaults(), stats=stats)
+    svc = HttpService(models, qos=qos)
+    port = await svc.start(port=0)
+    return svc, f"http://127.0.0.1:{port}"
+
+
+def _body(**kw):
+    body = {"model": "m", "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 8}
+    body.update(kw)
+    return body
+
+
+async def test_e2e_overload_sheds_batch_keeps_interactive():
+    """Overloaded mocker-backed frontend: batch traffic is shed with 429 +
+    Retry-After while interactive requests still complete."""
+    eng = MockEngine(MockEngineArgs(vocab_size=128, speedup_ratio=1000.0))
+    load = {"num_waiting": 0, "num_running": 0, "kv_usage": 0.0}
+    svc, base = await _serve(eng.generate, stats=lambda: dict(load))
+    try:
+        async with aiohttp.ClientSession() as s:
+            # healthy: batch admitted
+            async with s.post(f"{base}/v1/chat/completions", json=_body(),
+                              headers={"x-priority": "batch"}) as r:
+                assert r.status == 200, await r.text()
+
+            # queue past the shed threshold (default 32)
+            load["num_waiting"] = 40
+            async with s.post(f"{base}/v1/chat/completions", json=_body(),
+                              headers={"x-priority": "batch",
+                                       "x-client-id": "batch-client"}) as r:
+                assert r.status == 429
+                assert int(r.headers["Retry-After"]) >= 1
+                err = await r.json()
+                assert "shed" in err["error"]["message"]
+            async with s.post(f"{base}/v1/chat/completions", json=_body(),
+                              headers={"x-priority": "interactive"}) as r:
+                assert r.status == 200
+                data = await r.json()
+                assert data["choices"][0]["message"]["content"]
+
+            # saturated: everything refused with 503
+            load["num_waiting"] = 200
+            async with s.post(f"{base}/v1/chat/completions", json=_body(),
+                              headers={"x-priority": "interactive"}) as r:
+                assert r.status == 503
+                assert "Retry-After" in r.headers
+
+            # every decision visible in the Prometheus export
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+            assert 'dynamo_qos_rejected_total{priority="batch",reason="shed"} 1.0' in text
+            assert 'reason="overload"' in text
+            assert "dynamo_qos_pressure_level" in text
+            assert "dynamo_qos_queue_depth" in text
+    finally:
+        await eng.stop()
+        await svc.stop()
+
+
+async def test_e2e_rate_limit_per_client():
+    svc, base = await _serve(
+        canned_generate("ok"),
+        qos=QosConfig(rate_limit_rps=0.001, rate_burst=2.0))
+    try:
+        async with aiohttp.ClientSession() as s:
+            for _ in range(2):
+                async with s.post(f"{base}/v1/chat/completions", json=_body(),
+                                  headers={"x-client-id": "noisy"}) as r:
+                    assert r.status == 200
+            async with s.post(f"{base}/v1/chat/completions", json=_body(),
+                              headers={"x-client-id": "noisy"}) as r:
+                assert r.status == 429
+                assert int(r.headers["Retry-After"]) >= 1
+            # a different client has its own bucket
+            async with s.post(f"{base}/v1/chat/completions", json=_body(),
+                              headers={"x-client-id": "quiet"}) as r:
+                assert r.status == 200
+    finally:
+        await svc.stop()
+
+
+async def test_e2e_expired_deadline_is_504():
+    calls = []
+
+    def counting_generate():
+        inner = canned_generate("late")
+
+        async def generate(pre):
+            calls.append(pre)
+            async for out in inner(pre):
+                yield out
+
+        return generate
+
+    svc, base = await _serve(counting_generate())
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json=_body(),
+                              headers={"x-deadline-ms": "0"}) as r:
+                assert r.status == 504
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+            assert 'dynamo_qos_deadline_cancelled_total{stage="admission"} 1.0' in text
+    finally:
+        await svc.stop()
+    assert not calls, "dead-on-arrival request reached the engine"
+
+
+async def test_e2e_degrade_clamps_and_annotates():
+    seen = []
+
+    def capturing_generate():
+        inner = canned_generate("clamped")
+
+        async def generate(pre):
+            seen.append(pre)
+            async for out in inner(pre):
+                yield out
+
+        return generate
+
+    svc, base = await _serve(
+        capturing_generate(),
+        stats=lambda: {"num_waiting": 20},        # DEGRADE level
+        qos=QosConfig(clamp_max_tokens=4))
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"{base}/v1/chat/completions",
+                    json=_body(max_tokens=512, deadline_ms=60_000),
+                    headers={"x-priority": "interactive"}) as r:
+                assert r.status == 200
+    finally:
+        await svc.stop()
+    (pre,) = seen
+    assert pre.stop_conditions.max_tokens == 4
+    assert pre.annotations[PRIORITY_KEY] == "interactive"
+    assert pre.annotations[NO_SPEC_KEY] is True
+    assert deadline_of(pre.annotations) is not None
+
+
+async def test_mocker_cancels_expired_before_prefill():
+    """A deadline that expires while queued never reaches prefill: the
+    mocker emits CANCELLED without spending simulated prefill time."""
+    eng = MockEngine(MockEngineArgs(vocab_size=128, speedup_ratio=1000.0))
+    req = PreprocessedRequest(
+        token_ids=[1, 2, 3],
+        stop_conditions=StopConditions(max_tokens=4),
+        annotations={PRIORITY_KEY: "batch", DEADLINE_KEY: 1.0})  # long past
+    outs = []
+    async for out in eng.generate(req):
+        outs.append(out)
+    await eng.stop()
+    assert outs[-1].finish_reason is FinishReason.CANCELLED
+    assert not outs[-1].token_ids
+    assert eng.deadline_cancelled == 1
+    assert eng.stats()["deadline_cancelled"] == 1
+
+
+async def test_mocker_priority_admission_order():
+    """Under a single-slot mocker, a later interactive arrival is admitted
+    ahead of queued batch work (class-ranked admission)."""
+    eng = MockEngine(MockEngineArgs(vocab_size=128, max_batch_size=1,
+                                    speedup_ratio=1000.0))
+    done_order = []
+
+    async def run(priority, tag):
+        req = PreprocessedRequest(
+            token_ids=[1, 2, 3],
+            stop_conditions=StopConditions(max_tokens=2),
+            annotations={PRIORITY_KEY: priority})
+        async for out in eng.generate(req):
+            if out.finish_reason is not None:
+                done_order.append(tag)
+
+    tasks = [asyncio.create_task(run("batch", f"b{i}")) for i in range(3)]
+    await asyncio.sleep(0)  # let the batch requests enqueue first
+    tasks.append(asyncio.create_task(run("interactive", "hot")))
+    await asyncio.gather(*tasks)
+    await eng.stop()
+    assert "hot" in done_order[:2], f"interactive starved: {done_order}"
