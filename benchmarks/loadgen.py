@@ -293,6 +293,24 @@ async def run_overload(url: str, model: str, arrival_rate: float,
     }
 
 
+async def fetch_traces(url: str, path: str) -> None:
+    """Pull the frontend flight recorder (Chrome trace JSON) post-run."""
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{url}/debug/traces",
+                                   timeout=aiohttp.ClientTimeout(total=30)) as resp:
+                body = await resp.read()
+                if resp.status != 200:
+                    print(f"loadgen: /debug/traces -> {resp.status}",
+                          file=sys.stderr)
+                    return
+        with open(path, "wb") as f:
+            f.write(body)
+        print(f"loadgen: wrote traces to {path}", file=sys.stderr)
+    except Exception as exc:  # a missing endpoint must not fail the bench
+        print(f"loadgen: trace fetch failed: {exc}", file=sys.stderr)
+
+
 def main(argv: list[str] | None = None) -> dict:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", default="http://127.0.0.1:8000")
@@ -315,6 +333,10 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--chips", type=int, default=1,
                     help="chips serving the endpoint (for tok/s/chip)")
     ap.add_argument("--out", default=None, help="also write the JSON here")
+    ap.add_argument("--trace-out", default=None,
+                    help="after the run, fetch <url>/debug/traces (Chrome "
+                         "trace JSON from the frontend flight recorder) and "
+                         "write it here; analyse with tools/trace_report.py")
     ns = ap.parse_args(argv)
 
     if ns.mode == "overload":
@@ -325,6 +347,8 @@ def main(argv: list[str] | None = None) -> dict:
         if ns.out:
             with open(ns.out, "w") as f:
                 json.dump(result, f, indent=2)
+        if ns.trace_out:
+            asyncio.run(fetch_traces(ns.url, ns.trace_out))
         return result
 
     result = asyncio.run(run_load(
@@ -335,6 +359,8 @@ def main(argv: list[str] | None = None) -> dict:
     if ns.out:
         with open(ns.out, "w") as f:
             json.dump(result, f, indent=2)
+    if ns.trace_out:
+        asyncio.run(fetch_traces(ns.url, ns.trace_out))
     if result["failed"]:
         print(f"loadgen: {result['failed']} failed requests: {result['errors']}",
               file=sys.stderr)

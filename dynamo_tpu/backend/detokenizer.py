@@ -11,6 +11,7 @@ and partial matches stream correctly.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 from dynamo_tpu.protocols.common import BackendOutput, FinishReason, LLMEngineOutput
@@ -35,8 +36,19 @@ class DetokenizerBackend:
         self.tokenizer = tokenizer
         self.stops = [s for s in (stops or []) if s]
         self._st = _StreamState(decode=DecodeStream(tokenizer))
+        # Cumulative wall time spent detokenizing; the frontend folds it
+        # into one aggregate frontend.detokenize span at stream end
+        # (obs/tracer.py — a per-delta span would be pure overhead).
+        self.elapsed_s = 0.0
 
     def step(self, out: LLMEngineOutput) -> BackendOutput:
+        t0 = time.perf_counter()
+        try:
+            return self._step(out)
+        finally:
+            self.elapsed_s += time.perf_counter() - t0
+
+    def _step(self, out: LLMEngineOutput) -> BackendOutput:
         st = self._st
         if st.finished:
             return BackendOutput(finish_reason=out.finish_reason)

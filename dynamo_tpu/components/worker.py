@@ -341,16 +341,37 @@ async def amain(ns: argparse.Namespace) -> None:
             # enforcement, and an already-expired request short-circuits
             # before the engine sees it.
             from dynamo_tpu.qos.deadline import deadline_of
+            from dynamo_tpu.obs.tracer import get_tracer, trace_context_of
 
             ctx.deadline_ts = ctx.deadline_ts or deadline_of(req.annotations)
             if ctx.is_expired():
                 yield LLMEngineOutput(
                     finish_reason=FinishReason.CANCELLED).to_dict()
                 return
+            # Tracing: open a dispatch span under the wire traceparent and,
+            # on the FINAL delta, ship every span this process closed for
+            # the trace back to the frontend (LLMEngineOutput.spans) so one
+            # /debug/traces endpoint shows the cross-process timeline.
+            tr = get_tracer("worker")
+            tctx = trace_context_of(req.annotations)
+            span = tr.start_span("worker.dispatch", ctx=tctx,
+                                 request_id=req.request_id,
+                                 model=req.model) if tctx else None
             async for out in engine.generate(req):
                 if ctx.is_cancelled():
+                    if span is not None:
+                        tr.end_span(span, status="cancelled")
                     return
-                yield out.to_dict()
+                d = out.to_dict()
+                if out.finish_reason is not None and span is not None:
+                    tr.end_span(
+                        span,
+                        status="error" if out.error else "ok",
+                        finish_reason=str(out.finish_reason))
+                    d["spans"] = [
+                        s.to_dict()
+                        for s in tr.recorder.spans_for(tctx.trace_id)]
+                yield d
 
     if ns.wedgeable and ns.engine == "mocker":
         # Test hook: a control payload wedges/unwedges the mock engine's

@@ -43,6 +43,7 @@ from dynamo_tpu.engine.sampling import (
 from dynamo_tpu.engine.scheduler import Phase, PrefillWork, Scheduler, Seq, StepPlan
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.config import ModelConfig, resolve_model_config
+from dynamo_tpu.obs.tracer import get_tracer, trace_context_of
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
 from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.router.events import KvCacheEvent
@@ -746,6 +747,12 @@ class EngineCore:
         self.metrics = EngineMetrics()
         self._seqs: dict[str, Seq] = {}
         self.default_eos: list[int] = []
+        # Tracing: decode spans rotate every N generated tokens — one span
+        # (one allocation) per N steps, never per token (obs/tracer.py).
+        import os as _os
+        self._trace_stride = max(
+            int(_os.environ.get("DYN_TRACE_DECODE_STRIDE", "32")), 1)
+        self._trace_last_preempt = 0
         # Deadline clock for the current step window. On multi-host engines
         # the leader stamps it over the op stream so every rank evaluates
         # deadline expiry against the SAME instant — per-rank wall clocks
@@ -884,6 +891,14 @@ class EngineCore:
                       f"usable_kv_blocks={self.pool.num_blocks - 1})",
             )
         self._seqs[req.request_id] = seq
+        seq.trace_ctx = trace_context_of(getattr(req, "annotations", None))
+        if seq.trace_ctx is not None:
+            # Admission wait starts now; step_begin ends it when the first
+            # prefill chunk is planned (engine.queue → engine.prefill).
+            seq.trace_span = get_tracer().start_span(
+                "engine.queue", ctx=seq.trace_ctx,
+                request_id=req.request_id, model=req.model,
+                prompt_tokens=seq.prompt_len, priority=seq.qos_priority)
         if self.kvbm is not None:
             # Same matchable cap as the scheduler: leave ≥1 prompt token to
             # compute so decode has last-position state. Onboarding is an
@@ -901,6 +916,7 @@ class EngineCore:
         seq = self._seqs.get(request_id)
         if seq is None or seq.phase is Phase.FINISHED:
             return
+        self._trace_finish(seq, FinishReason.CANCELLED)
         self.sched.finish(seq, FinishReason.CANCELLED)
 
     def has_work(self) -> bool:
@@ -954,6 +970,7 @@ class EngineCore:
         if plan.empty:
             return None
         self.metrics.num_steps += 1
+        self._trace_plan(plan)
 
         for seq in [w.seq for w in plan.prefill] + plan.decode:
             if not seq.slot_initialized and seq.slot >= 0:
@@ -1028,6 +1045,88 @@ class EngineCore:
                     seq.inflight_samples += 1
             pending.batches.append((kind, rows, sample_rows, toks, lps))
         return pending
+
+    def _trace_plan(self, plan: StepPlan) -> None:
+        """Advance per-seq phase spans from the step plan. Spans are
+        observational only — multi-host ranks may record different wall
+        times but never make different decisions off them. Untraced seqs
+        (no obs.traceparent annotation) cost one None check here."""
+        tr = None
+        for w in plan.prefill:
+            s = w.seq
+            sp = s.trace_span
+            if s.trace_ctx is None or (sp is not None
+                                       and sp.name == "engine.prefill"):
+                continue  # untraced, or a later chunk of the same prefill
+            tr = tr or get_tracer()
+            if sp is not None:
+                # queue→prefill admit, or a preempt-resume out of decode.
+                extra = ({"tokens": s.trace_tokens}
+                         if sp.name == "engine.decode" and s.trace_tokens
+                         else {})
+                tr.end_span(sp, prefix_hit_blocks=s.prefix_hit_blocks,
+                            **extra)
+            s.trace_span = tr.start_span(
+                "engine.prefill", ctx=s.trace_ctx, request_id=s.request_id,
+                prompt_tokens=s.prompt_len,
+                prefix_hit_blocks=s.prefix_hit_blocks)
+            s.trace_tokens = 0
+        for s in plan.decode:
+            if s.trace_ctx is None:
+                continue
+            sp = s.trace_span
+            if sp is not None and sp.name == "engine.decode":
+                s.trace_tokens += plan.decode_window
+                if s.trace_tokens >= self._trace_stride:
+                    tr = tr or get_tracer()
+                    tr.end_span(sp, tokens=s.trace_tokens,
+                                batch=len(plan.decode))
+                    s.trace_span = tr.start_span(
+                        "engine.decode", ctx=s.trace_ctx,
+                        request_id=s.request_id)
+                    s.trace_tokens = 0
+                continue
+            tr = tr or get_tracer()
+            if sp is not None:  # prefill complete: decode begins
+                tr.end_span(sp)
+            s.trace_span = tr.start_span(
+                "engine.decode", ctx=s.trace_ctx, request_id=s.request_id,
+                batch=len(plan.decode))
+            s.trace_tokens = plan.decode_window
+
+    def _trace_finish(self, seq: Seq, reason: FinishReason | None) -> None:
+        sp = seq.trace_span
+        if sp is None:
+            return
+        seq.trace_span = None
+        status = "ok"
+        if reason is FinishReason.CANCELLED:
+            status = "cancelled"
+        elif reason is FinishReason.ERROR:
+            status = "error"
+        attrs: dict = {"finish_reason": str(reason) if reason else "",
+                       "output_tokens": seq.num_output_tokens}
+        if sp.name == "engine.decode" and seq.trace_tokens:
+            attrs["tokens"] = seq.trace_tokens
+        get_tracer().end_span(sp, status=status, **attrs)
+
+    def _record_step(self, t0: float, pending: "PendingStep") -> None:
+        """Always-on step profile: one ring append per engine step."""
+        n_pf = n_dec = 0
+        for kind, rows, *_ in pending.batches:
+            if kind == "prefill":
+                n_pf += len(rows)
+            else:
+                n_dec += len(rows)
+        pc = self.sched.preemption_count
+        get_tracer().recorder.steps.record(
+            time.time(), time.perf_counter() - t0,
+            num_prefill=n_pf, num_decode=n_dec,
+            num_waiting=self.sched.num_waiting,
+            num_preempted=pc - self._trace_last_preempt,
+            occupancy=(self.sched.num_running
+                       / max(self.engine_cfg.max_batch_size, 1)))
+        self._trace_last_preempt = pc
 
     def _plan_verify(self, decode_seqs: list
                      ) -> tuple[list, list[list[int]], list]:
@@ -1104,6 +1203,7 @@ class EngineCore:
         )
         if reason is not None:
             out.finish_reason = reason
+            self._trace_finish(seq, reason)
             self.sched.finish(seq, reason)
             self.metrics.num_requests_finished += 1
             del self._seqs[seq.request_id]
@@ -1114,6 +1214,7 @@ class EngineCore:
         """Materialize a dispatched step's tokens and apply value-dependent
         effects: append tokens, commit full blocks (hash chain), evaluate
         stop conditions, assemble per-request outputs."""
+        t0 = time.perf_counter()
         outputs: dict[str, LLMEngineOutput] = {}
         for kind, rows, sample_rows, toks_dev, lps_dev in pending.batches:
             if kind == "verify":
@@ -1147,6 +1248,7 @@ class EngineCore:
                 self._emit_and_finish(
                     seq, [int(x) for x in toks[i]], lps[i], outputs,
                     count_decode=(kind == "decode"))
+        self._record_step(t0, pending)
         return outputs
 
     def _finalize_verify(self, rows, chunks, toks_dev, lps_dev,
@@ -1206,6 +1308,7 @@ class EngineCore:
         for seq in self.sched.expire_waiting(now):
             self._seqs.pop(seq.request_id, None)
             self.metrics.deadline_cancelled += 1
+            self._trace_finish(seq, FinishReason.CANCELLED)
             outs[seq.request_id] = LLMEngineOutput(finish_reason=FinishReason.CANCELLED)
         return outs
 

@@ -77,6 +77,14 @@ class Seq:
     # prefill via expire_waiting, mid-decode via the engine's stop check).
     qos_priority: str = "standard"
     deadline_ts: float | None = None
+    # Tracing (obs/tracer.py): the wire TraceContext parsed off the
+    # request annotations, the one currently-open phase span
+    # (engine.queue → engine.prefill → engine.decode), and the token
+    # count inside the open decode-window span. The engine owns all
+    # transitions; the scheduler never touches these.
+    trace_ctx: object | None = None
+    trace_span: object | None = None
+    trace_tokens: int = 0
 
     def __post_init__(self) -> None:
         self.tokens = list(self.req.token_ids)

@@ -29,7 +29,14 @@ from dynamo_tpu.frontend.delta import (
     aggregate_completion,
 )
 from dynamo_tpu.frontend.model_manager import ModelEntry, ModelManager
-from dynamo_tpu.protocols.common import BackendOutput
+from dynamo_tpu.obs.bridge import SpanMetricsBridge
+from dynamo_tpu.obs.tracer import (
+    TRACE_KEY,
+    TRACE_ID_RESPONSE_HEADER,
+    TRACEPARENT_HEADER,
+    get_tracer,
+)
+from dynamo_tpu.protocols.common import BackendOutput, FinishReason
 from dynamo_tpu.protocols.openai import (
     ChatCompletionRequest,
     CompletionRequest,
@@ -41,7 +48,7 @@ from dynamo_tpu.protocols.openai import (
 from dynamo_tpu.protocols.sse import DONE_EVENT, encode_sse_json
 from dynamo_tpu.qos import QosConfig, QosGateway
 from dynamo_tpu.qos.deadline import CLIENT_HEADER, deadline_from, priority_from
-from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.logging import TraceContext, get_logger
 from dynamo_tpu.utils.metrics import MetricsRegistry
 from dynamo_tpu.utils.tls import validate_tls_pair
 
@@ -114,6 +121,12 @@ class HttpService:
         self._input_tokens = m.counter("frontend_input_tokens_total", "prompt tokens")
         self._model_requests = m.counter("frontend_model_requests_total",
                                          "completed requests per model")
+        # Tracing: the process-global tracer collects frontend + router
+        # spans; worker/engine spans arrive on the wire and are ingested
+        # in the generate loops. The bridge derives dynamo_request_*
+        # histograms from every closed span (obs/bridge.py).
+        self.tracer = get_tracer("frontend")
+        self.tracer.add_sink(SpanMetricsBridge(m))
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self.chat_completions)
         self.app.router.add_post("/v1/completions", self.completions)
@@ -125,6 +138,7 @@ class HttpService:
         self.app.router.add_get("/metrics", self.metrics_handler)
         self.app.router.add_post("/clear_kv_blocks", self.clear_kv_blocks)
         self.app.router.add_get("/engine_stats", self.engine_stats)
+        self.app.router.add_get("/debug/traces", self.debug_traces)
         # KServe v2 protocol rides the same app/port (reference serves its
         # KServe gRPC flavor as a separate ingress; see frontend/kserve.py).
         from dynamo_tpu.frontend.kserve import register_kserve
@@ -177,6 +191,21 @@ class HttpService:
 
     async def metrics_handler(self, request: web.Request) -> web.Response:
         return web.Response(text=self.metrics.expose(), content_type="text/plain")
+
+    async def debug_traces(self, request: web.Request) -> web.Response:
+        """Flight-recorder dump. ``?format=chrome`` (default) returns
+        Chrome trace-event JSON loadable in Perfetto; ``?format=jsonl``
+        one span per line for tools/trace_report.py; ``?trace_id=`` limits
+        either to one request's timeline (docs/OBSERVABILITY.md)."""
+        fmt = request.query.get("format", "chrome")
+        trace_id = request.query.get("trace_id") or None
+        rec = self.tracer.recorder
+        if fmt == "jsonl":
+            return web.Response(text=rec.dump_jsonl(trace_id=trace_id),
+                                content_type="application/x-ndjson")
+        if fmt != "chrome":
+            return _error(400, f"unknown format '{fmt}' (chrome|jsonl)")
+        return web.json_response(rec.dump_chrome(trace_id=trace_id))
 
     async def engine_stats(self, request: web.Request) -> web.Response:
         """Per-model engine stats (scheduler depth, KV usage, KVBM tiers) —
@@ -340,6 +369,8 @@ class HttpService:
         try:
             async for eo in entry.generate(pre):
                 now = time.monotonic()
+                if eo.spans:
+                    self.tracer.ingest(eo.spans)
                 if eo.token_ids:
                     if first:
                         self._ttft.observe(now - t0, model=req.model)
@@ -400,6 +431,39 @@ class HttpService:
             return _error(404, f"model '{req.model}' not found (have: {self.models.names()})")
 
         request_id = request.headers.get("x-request-id") or uuid.uuid4().hex
+        # Root span: inherits the caller's W3C traceparent when present,
+        # otherwise mints a fresh trace. Every hop downstream (router,
+        # worker, engine) parents under this id via the obs.traceparent
+        # request annotation (docs/OBSERVABILITY.md).
+        wire = TraceContext.parse(request.headers.get(TRACEPARENT_HEADER))
+        root = self.tracer.start_span("request", ctx=wire, fresh=True,
+                                      route=route, model=req.model,
+                                      request_id=request_id)
+        try:
+            resp = await self._serve_traced(request, req, payload, entry,
+                                            chat, route, request_id, root)
+        except BaseException as exc:
+            self.tracer.end_span(root, status="error",
+                                 error=type(exc).__name__)
+            raise
+        status = getattr(resp, "status", 200)
+        cancelled = bool(root.attrs.pop("_cancelled", False))
+        # Streamed engine errors keep HTTP 200 (headers already sent) but
+        # still mark the trace failed via the "error" attr.
+        failed = status >= 500 or bool(root.attrs.get("error"))
+        self.tracer.end_span(
+            root,
+            status=("cancelled" if cancelled else "error" if failed
+                    else "ok"),
+            http_status=status)
+        if not resp.prepared:  # streamed responses set these pre-prepare
+            resp.headers[TRACE_ID_RESPONSE_HEADER] = root.trace_id
+            resp.headers[TRACEPARENT_HEADER] = root.context().header()
+        return resp
+
+    async def _serve_traced(self, request: web.Request, req, payload: dict,
+                            entry: ModelEntry, chat: bool, route: str,
+                            request_id: str, root) -> web.StreamResponse:
         images = None
         if chat:
             try:
@@ -423,14 +487,20 @@ class HttpService:
                     self._requests.inc(route=route, status="400")
                     return _error(400, f"image encoding failed: {exc}")
         try:
-            if chat:
-                pre = entry.preprocessor.preprocess_chat(req, request_id,
-                                                         images=images)
-            else:
-                pre = entry.preprocessor.preprocess_completion(req, request_id)
+            with self.tracer.span("frontend.preprocess", parent=root,
+                                  model=req.model):
+                if chat:
+                    pre = entry.preprocessor.preprocess_chat(req, request_id,
+                                                             images=images)
+                else:
+                    pre = entry.preprocessor.preprocess_completion(req, request_id)
         except Exception as exc:
             self._requests.inc(route=route, status="400")
             return _error(400, f"preprocessing failed: {exc}")
+        # Downstream hops (router/worker/engine) parent under the root via
+        # the same wire-annotation mechanism as the QoS deadline keys.
+        pre.annotations[TRACE_KEY] = root.context().header()
+        root.attrs["input_tokens"] = len(pre.token_ids)
 
         # Logprob surface: the sampled token's logprob streams end-to-end;
         # alternatives (top_logprobs / completions logprobs>0) would need the
@@ -463,12 +533,22 @@ class HttpService:
         self._input_tokens.inc(len(pre.token_ids), model=req.model)
         self._model_requests.inc(model=req.model)
         t_start = time.monotonic()
+        # TTFT as a span: opened at dispatch, closed (idempotently — n>1
+        # runs race) on the first token by whichever path sees it first.
+        # Left unended (and so never recorded) when no token arrives.
+        ttft_span = self.tracer.start_span("request.ttft", parent=root,
+                                           model=req.model)
         try:
             if req.n > 1:
-                return await self._aggregate_n(req, entry, pre, chat, t_start, route)
+                return await self._aggregate_n(req, entry, pre, chat, t_start,
+                                               route, root, ttft_span)
             if req.stream:
-                return await self._stream_response(request, req, entry, pre, chat, t_start)
-            return await self._aggregate_response(req, entry, pre, chat, t_start, route)
+                return await self._stream_response(request, req, entry, pre,
+                                                   chat, t_start, root,
+                                                   ttft_span)
+            return await self._aggregate_response(req, entry, pre, chat,
+                                                  t_start, route, root,
+                                                  ttft_span)
         finally:
             self._inflight.inc(-1, model=req.model)
             self._req_dur.observe(time.monotonic() - t_start, model=req.model)
@@ -541,7 +621,8 @@ class HttpService:
         return StreamJail(tool_cfg=tool_cfg, reasoning=reasoning)
 
     async def _collect_outputs(self, entry: ModelEntry, pre, model: str,
-                               t_start: float) -> list[BackendOutput]:
+                               t_start: float, root=None,
+                               ttft_span=None) -> list[BackendOutput]:
         """Drive one generation to completion: observe TTFT/ITL, detokenize,
         stop at the jail's hidden stop. The single shared unary collection
         loop (used by both the n=1 and n>1 aggregators so metric/stop
@@ -552,22 +633,49 @@ class HttpService:
         prev = t_start
         async for eo in entry.generate(pre):
             now = time.monotonic()
+            if eo.spans:
+                self.tracer.ingest(eo.spans)
             if eo.token_ids:
                 if first:
                     self._ttft.observe(now - t_start, model=model)
                     first = False
+                    if ttft_span is not None:
+                        self.tracer.end_span(ttft_span)
+                    if root is not None:
+                        root.attrs.setdefault("ttft_s", now - t_start)
                 else:
                     self._itl.observe(now - prev, model=model)
                 prev = now
             if eo.error:
                 raise RuntimeError(eo.error)
+            if root is not None and eo.finish_reason is FinishReason.CANCELLED:
+                root.attrs["_cancelled"] = True
             outs.append(backend.step(eo))
             if backend.hit_stop:
                 break
+        if root is not None:
+            root.attrs["output_tokens"] = (
+                root.attrs.get("output_tokens", 0)
+                + sum(len(o.token_ids) for o in outs))
+            self._emit_detok_span(root, backend, model)
         return outs
 
+    def _emit_detok_span(self, root, backend: DetokenizerBackend,
+                         model: str) -> None:
+        """One aggregate frontend.detokenize span per request — the
+        accumulated per-delta wall time (DetokenizerBackend.elapsed_s)
+        rendered as a span ending now."""
+        if backend.elapsed_s <= 0:
+            return
+        end = time.time()
+        sp = self.tracer.start_span(
+            "frontend.detokenize", parent=root,
+            start=end - backend.elapsed_s, model=model, aggregate=True)
+        self.tracer.end_span(sp, end=end)
+
     async def _aggregate_n(self, req, entry: ModelEntry, pre, chat: bool,
-                           t_start: float, route: str) -> web.Response:
+                           t_start: float, route: str, root=None,
+                           ttft_span=None) -> web.Response:
         """n>1: run n INDEPENDENT generations concurrently (they batch
         together inside the engine's continuous scheduler) and merge their
         choices. Distinct request ids give each its own sampling slot;
@@ -580,7 +688,8 @@ class HttpService:
             sub.request_id = f"{pre.request_id}-n{i}"
             if sub.sampling_options.seed is not None:
                 sub.sampling_options.seed += i
-            return await self._collect_outputs(entry, sub, req.model, t_start)
+            return await self._collect_outputs(entry, sub, req.model, t_start,
+                                               root=root, ttft_span=ttft_span)
 
         tasks = [asyncio.ensure_future(one(i)) for i in range(req.n)]
         error: str | None = None
@@ -632,9 +741,11 @@ class HttpService:
                             content_type="application/json")
 
     async def _aggregate_response(self, req, entry: ModelEntry, pre, chat: bool,
-                                  t_start: float, route: str) -> web.Response:
+                                  t_start: float, route: str, root=None,
+                                  ttft_span=None) -> web.Response:
         try:
-            outs = await self._collect_outputs(entry, pre, req.model, t_start)
+            outs = await self._collect_outputs(entry, pre, req.model, t_start,
+                                               root=root, ttft_span=ttft_span)
         except RuntimeError as exc:  # engine error surfaced mid-stream
             self._requests.inc(route=route, status="500")
             if chat and self._audit.bus() is not None:
@@ -666,12 +777,14 @@ class HttpService:
         return web.Response(text=resp.model_dump_json(exclude_none=True), content_type="application/json")
 
     async def _stream_response(self, request: web.Request, req, entry: ModelEntry, pre,
-                               chat: bool, t_start: float) -> web.StreamResponse:
-        resp = web.StreamResponse(
-            status=200,
-            headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache",
-                     "x-request-id": pre.request_id},
-        )
+                               chat: bool, t_start: float, root=None,
+                               ttft_span=None) -> web.StreamResponse:
+        headers = {"Content-Type": "text/event-stream", "Cache-Control": "no-cache",
+                   "x-request-id": pre.request_id}
+        if root is not None:
+            headers[TRACE_ID_RESPONSE_HEADER] = root.trace_id
+            headers[TRACEPARENT_HEADER] = root.context().header()
+        resp = web.StreamResponse(status=200, headers=headers)
         await resp.prepare(request)
         backend = DetokenizerBackend(entry.tokenizer, stops=pre.stop_conditions.stop)
         wants_lp = _wants_logprobs(req, chat)
@@ -704,14 +817,22 @@ class HttpService:
                     disconnected = True
                     break
                 now = time.monotonic()
+                if eo.spans:
+                    self.tracer.ingest(eo.spans)
                 if eo.token_ids:
                     if first:
                         self._ttft.observe(now - t_start, model=req.model)
                         first = False
+                        if ttft_span is not None:
+                            self.tracer.end_span(ttft_span)
+                        if root is not None:
+                            root.attrs.setdefault("ttft_s", now - t_start)
                     else:
                         self._itl.observe(now - prev, model=req.model)
                     prev = now
                     ntokens += len(eo.token_ids)
+                if root is not None and eo.finish_reason is FinishReason.CANCELLED:
+                    root.attrs["_cancelled"] = True
                 if eo.error:
                     audit_error = eo.error
                     await resp.write(encode_sse_json({"error": {"message": eo.error, "code": 500}}))
@@ -796,6 +917,8 @@ class HttpService:
                 log.info("client disconnected mid-stream; aborting %s",
                          pre.request_id)
                 audit_error = "client disconnected"
+                if root is not None:
+                    root.attrs["_cancelled"] = True
                 self._requests.inc(route="chat" if chat else "completions",
                                    status="499")
                 return resp
@@ -837,6 +960,8 @@ class HttpService:
             # client went away — generator cleanup aborts the engine request
             log.info("client disconnected request_id=%s", pre.request_id)
             audit_error = audit_error or "client disconnected"
+            if root is not None:
+                root.attrs["_cancelled"] = True
             self._requests.inc(route="chat" if chat else "completions", status="499")
         finally:
             # Deterministic teardown: close the generation stream NOW (not at
@@ -858,6 +983,11 @@ class HttpService:
                               pre.request_id)
             finally:
                 self._output_tokens.inc(ntokens, model=req.model)
+                if root is not None:
+                    root.attrs["output_tokens"] = ntokens
+                    if audit_error and not root.attrs.get("_cancelled"):
+                        root.attrs["error"] = audit_error
+                    self._emit_detok_span(root, backend, req.model)
                 if chat and self._audit.bus() is not None:
                     # From finally so disconnects and engine errors are
                     # audited too — a compliance log that misses exactly the

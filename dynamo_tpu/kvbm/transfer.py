@@ -52,12 +52,16 @@ class BlockTransferEngine:
 
     def extract(self, cache_k: jax.Array, cache_v: jax.Array, ids: list[int]) -> list[np.ndarray]:
         """Gather blocks off the device; returns one host block per id."""
+        from dynamo_tpu.obs.tracer import get_tracer
+
         n = len(ids)
-        padded = jnp.asarray(_pad_pow2(list(ids)), jnp.int32)
-        k, v = self._extract(cache_k, cache_v, padded)
-        kv = np.stack([np.asarray(k), np.asarray(v)])  # [2, layers, n_pad, bs, kvh, hd]
-        per_block = np.moveaxis(kv, 2, 0)              # [n_pad, 2, layers, bs, kvh, hd]
-        return [np.ascontiguousarray(per_block[i]) for i in range(n)]
+        with get_tracer().span("kv.transfer", direction="extract",
+                               blocks=n):
+            padded = jnp.asarray(_pad_pow2(list(ids)), jnp.int32)
+            k, v = self._extract(cache_k, cache_v, padded)
+            kv = np.stack([np.asarray(k), np.asarray(v)])  # [2, layers, n_pad, bs, kvh, hd]
+            per_block = np.moveaxis(kv, 2, 0)              # [n_pad, 2, layers, bs, kvh, hd]
+            return [np.ascontiguousarray(per_block[i]) for i in range(n)]
 
     def inject(
         self,
@@ -68,12 +72,16 @@ class BlockTransferEngine:
     ) -> tuple[jax.Array, jax.Array]:
         """Scatter host blocks into the device cache (cache args are donated —
         callers must replace their references with the returned arrays)."""
+        from dynamo_tpu.obs.tracer import get_tracer
+
         assert len(ids) == len(blocks) and ids
-        padded = _pad_pow2(list(ids))
-        data = np.stack(blocks + [blocks[-1]] * (len(padded) - len(blocks)))
-        dk = np.moveaxis(data[:, 0], 0, 1)  # [layers, n_pad, bs, kvh, hd]
-        dv = np.moveaxis(data[:, 1], 0, 1)
-        return self._inject(
-            cache_k, cache_v, jnp.asarray(padded, jnp.int32),
-            jnp.asarray(dk, cache_k.dtype), jnp.asarray(dv, cache_v.dtype),
-        )
+        with get_tracer().span("kv.transfer", direction="inject",
+                               blocks=len(ids)):
+            padded = _pad_pow2(list(ids))
+            data = np.stack(blocks + [blocks[-1]] * (len(padded) - len(blocks)))
+            dk = np.moveaxis(data[:, 0], 0, 1)  # [layers, n_pad, bs, kvh, hd]
+            dv = np.moveaxis(data[:, 1], 0, 1)
+            return self._inject(
+                cache_k, cache_v, jnp.asarray(padded, jnp.int32),
+                jnp.asarray(dk, cache_k.dtype), jnp.asarray(dv, cache_v.dtype),
+            )

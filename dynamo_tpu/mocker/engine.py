@@ -19,6 +19,7 @@ from typing import AsyncIterator, Callable
 
 from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
+from dynamo_tpu.obs.tracer import get_tracer, trace_context_of
 from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.qos.config import class_rank
 from dynamo_tpu.qos.deadline import deadline_of, expired, priority_of
@@ -59,11 +60,17 @@ class _MockSeq:
     done: bool = False
     priority: str = "standard"
     deadline_ts: float | None = None
+    # Tracing mirrors the real engine (engine/engine.py _trace_plan):
+    # one open phase span per seq, decode spans rotated every N tokens.
+    trace_ctx: object | None = None
+    trace_span: object | None = None
+    trace_tokens: int = 0
 
     def __post_init__(self) -> None:
         ann = getattr(self.req, "annotations", None)
         self.priority = priority_of(ann, self.priority)
         self.deadline_ts = deadline_of(ann)
+        self.trace_ctx = trace_context_of(ann)
 
 
 class MockEngine:
@@ -71,7 +78,11 @@ class MockEngine:
 
     def __init__(self, args: MockEngineArgs | None = None,
                  event_sink: Callable[[KvCacheEvent], None] | None = None):
+        import os
+
         self.args = args or MockEngineArgs()
+        self._trace_stride = max(
+            int(os.environ.get("DYN_TRACE_DECODE_STRIDE", "32")), 1)
         self.pool = PrefixPool(
             self.args.num_blocks, self.args.block_size,
             event_sink=event_sink,
@@ -94,6 +105,26 @@ class MockEngine:
             self._task.cancel()
 
     # ------------------------------------------------------------------
+    def _trace_phase(self, seq: _MockSeq, name: str, **attrs) -> None:
+        """Close the seq's open phase span (if any) and open the next."""
+        if seq.trace_ctx is None:
+            return
+        tr = get_tracer()
+        self._trace_close(seq)
+        seq.trace_span = tr.start_span(
+            name, ctx=seq.trace_ctx, request_id=seq.req.request_id, **attrs)
+        seq.trace_tokens = 0
+
+    def _trace_close(self, seq: _MockSeq, status: str = "ok",
+                     **attrs) -> None:
+        sp = seq.trace_span
+        if sp is None:
+            return
+        seq.trace_span = None
+        if sp.name == "engine.decode" and seq.trace_tokens:
+            attrs.setdefault("tokens", seq.trace_tokens)
+        get_tracer().end_span(sp, status=status, **attrs)
+
     def _token_for(self, rid: str, i: int) -> int:
         digest = hashlib.md5(f"{rid}:{i}".encode()).digest()
         return int.from_bytes(digest[:4], "little") % self.args.vocab_size
@@ -106,6 +137,11 @@ class MockEngine:
             return
         seq = _MockSeq(req=req, block_seq=TokenBlockSequence.from_tokens(
             req.token_ids, self.args.block_size))
+        if seq.trace_ctx is not None:
+            seq.trace_span = get_tracer().start_span(
+                "engine.queue", ctx=seq.trace_ctx,
+                request_id=req.request_id, model=req.model,
+                prompt_tokens=len(req.token_ids), priority=seq.priority)
         self.waiting.append(seq)
         self._wake.set()
         try:
@@ -146,6 +182,7 @@ class MockEngine:
                     self.waiting.pop(0)
                     seq.done = True
                     self.deadline_cancelled += 1
+                    self._trace_close(seq, status="cancelled")
                     seq.queue.put_nowait(
                         LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
                     continue
@@ -163,6 +200,7 @@ class MockEngine:
                         # rather than busy-spinning on admission forever.
                         self.waiting.pop(0)
                         seq.done = True
+                        self._trace_close(seq, status="error")
                         seq.queue.put_nowait(LLMEngineOutput(
                             finish_reason=FinishReason.ERROR,
                             error="request needs more KV blocks than the pool holds"))
@@ -175,6 +213,9 @@ class MockEngine:
                 self.prefix_hits += len(matched)
                 self.waiting.pop(0)
                 self.running.append(seq)
+                self._trace_phase(seq, "engine.prefill",
+                                  prompt_tokens=len(seq.req.token_ids),
+                                  prefix_hit_blocks=len(matched))
 
             self.steps += 1
             prefills = [s for s in self.running if not s.prefilled and not s.done]
@@ -184,6 +225,8 @@ class MockEngine:
                 await asyncio.sleep(
                     new_tokens * a.prefill_us_per_token / 1e6 / a.speedup_ratio)
                 seq.prefilled = True
+                self._trace_phase(seq, "engine.decode",
+                                  batch=len(self.running))
                 self._commit(seq, len(seq.req.token_ids))
                 self._emit_token(seq)
                 continue
@@ -218,6 +261,11 @@ class MockEngine:
             return
         tok = self._token_for(seq.req.request_id, seq.generated)
         seq.generated += 1
+        seq.trace_tokens += 1
+        if (seq.trace_span is not None and seq.trace_tokens >= self._trace_stride
+                and seq.trace_span.name == "engine.decode"):
+            # One span per N decode tokens, mirroring the real engine.
+            self._trace_phase(seq, "engine.decode")
         seq.block_seq.append(tok)
         sc = seq.req.stop_conditions
         finish = None
@@ -240,6 +288,14 @@ class MockEngine:
 
     def _finish(self, seq: _MockSeq, reason) -> None:
         seq.done = True
+        status = "ok"
+        if reason is None or reason is FinishReason.CANCELLED:
+            status = "cancelled"
+        elif reason is FinishReason.ERROR:
+            status = "error"
+        self._trace_close(seq, status=status,
+                          output_tokens=seq.generated,
+                          finish_reason=str(reason) if reason else "")
         if seq in self.running:
             self.running.remove(seq)
         if seq.block_ids:

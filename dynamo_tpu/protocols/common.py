@@ -169,6 +169,10 @@ class LLMEngineOutput:
     # Disagg: prefill response carries transfer params back to decode.
     kv_transfer_params: dict[str, Any] | None = None
     error: str | None = None
+    # Tracing: the final delta ships the worker-side closed spans back to
+    # the frontend (obs/tracer.py), so one /debug/traces endpoint shows
+    # the cross-process timeline. Absent on all intermediate deltas.
+    spans: list[dict] | None = None
 
     def to_dict(self) -> dict:
         d: dict[str, Any] = {"token_ids": self.token_ids}
@@ -182,6 +186,8 @@ class LLMEngineOutput:
             d["kv_transfer_params"] = self.kv_transfer_params
         if self.error is not None:
             d["error"] = self.error
+        if self.spans is not None:
+            d["spans"] = self.spans
         return d
 
     @classmethod
@@ -194,6 +200,7 @@ class LLMEngineOutput:
             log_probs=d.get("log_probs"),
             kv_transfer_params=d.get("kv_transfer_params"),
             error=d.get("error"),
+            spans=d.get("spans"),
         )
 
 

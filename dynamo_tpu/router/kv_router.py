@@ -251,10 +251,22 @@ class KvPushRouter:
 
     # ------------------------------------------------------------------
     async def generate(self, request: PreprocessedRequest | dict) -> AsyncIterator[Any]:
+        from dynamo_tpu.obs.tracer import get_tracer, trace_context_of
+
         req = request if isinstance(request, PreprocessedRequest) else PreprocessedRequest.from_dict(request)
         worker_ids = self.client.instance_ids()
+        # The routing decision is a hop of its own: a micro span under the
+        # request's wire traceparent recording which worker won and why.
+        tctx = trace_context_of(req.annotations)
+        rspan = (get_tracer().start_span(
+            "router.schedule", ctx=tctx, request_id=req.request_id)
+            if tctx else None)
         wid, overlap = self.router.find_best_match(req.request_id, req.token_ids, worker_ids)
         req.estimated_prefix_hit_blocks = overlap
+        if rspan is not None:
+            get_tracer().end_span(rspan, worker_id=f"{wid:x}",
+                                  overlap_blocks=overlap,
+                                  candidates=len(worker_ids))
         log.debug("routed %s -> worker %x (overlap %d blocks)",
                   req.request_id, wid, overlap)
         first = True

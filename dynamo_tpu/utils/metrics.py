@@ -5,6 +5,12 @@ Fills the role of the reference's hierarchical MetricsRegistry
 metrics/prometheus_names.rs): counters/gauges/histograms with labels,
 hierarchical auto-labels (namespace/component/endpoint), and text
 exposition for a /metrics endpoint. Dependency-free.
+
+Exposition follows the Prometheus text format: one ``# HELP``/``# TYPE``
+header per metric family across the whole registry tree (child
+registries contribute samples, not duplicate headers), label values
+escaped per the spec, and histogram ``le`` bounds rendered via a single
+repr-stable formatter.
 """
 
 from __future__ import annotations
@@ -18,14 +24,29 @@ from typing import Callable, Iterable
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus exposition escaping: backslash, double-quote, newline."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 
+def _fmt_le(ub: float) -> str:
+    """Render a bucket upper bound. Shared by observe (bucket identity)
+    and expose so the printed ``le`` always names the float actually
+    compared against; repr() of a true float is shortest-round-trip."""
+    return "+Inf" if ub == math.inf else repr(float(ub))
+
+
 class Counter:
+    kind = "counter"
+
     def __init__(self, name: str, help_: str, const_labels: dict[str, str]):
         self.name, self.help = name, help_
         self.const = const_labels
@@ -37,37 +58,40 @@ class Counter:
             self._values[tuple(sorted(labels.items()))] += value
 
     def get(self, **labels: str) -> float:
-        return self._values.get(tuple(sorted(labels.items())), 0.0)
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def samples(self) -> Iterable[str]:
+        with self._lock:
+            values = sorted(self._values.items())
+        if not values:
+            yield f"{self.name}{_fmt_labels(self.const)} 0"
+        for key, v in values:
+            labels = {**self.const, **dict(key)}
+            yield f"{self.name}{_fmt_labels(labels)} {v}"
 
     def expose(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} counter"
-        if not self._values:
-            yield f"{self.name}{_fmt_labels(self.const)} 0"
-        for key, v in sorted(self._values.items()):
-            labels = {**self.const, **dict(key)}
-            yield f"{self.name}{_fmt_labels(labels)} {v}"
+        yield f"# TYPE {self.name} {self.kind}"
+        yield from self.samples()
 
 
 class Gauge(Counter):
+    kind = "gauge"
+
     def set(self, value: float, **labels: str) -> None:
         with self._lock:
             self._values[tuple(sorted(labels.items()))] = value
-
-    def expose(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} gauge"
-        if not self._values:
-            yield f"{self.name}{_fmt_labels(self.const)} 0"
-        for key, v in sorted(self._values.items()):
-            labels = {**self.const, **dict(key)}
-            yield f"{self.name}{_fmt_labels(labels)} {v}"
 
 
 class FuncGauge:
     """Gauge whose value is computed at scrape time from a callback —
     for live state (queue depths, tracked clients) that would otherwise
-    need a set() call on every mutation."""
+    need a set() call on every mutation. The callback is allowed to
+    raise (e.g. after its owner is torn down while the registry is still
+    scraped): both get() and exposition fall back to 0.0."""
+
+    kind = "gauge"
 
     def __init__(self, name: str, help_: str, const_labels: dict[str, str],
                  fn: "Callable[[], float]"):
@@ -76,24 +100,30 @@ class FuncGauge:
         self.fn = fn
 
     def get(self) -> float:
-        return float(self.fn())
+        try:
+            return float(self.fn())
+        except Exception:
+            return 0.0
+
+    def samples(self) -> Iterable[str]:
+        yield f"{self.name}{_fmt_labels(self.const)} {self.get()}"
 
     def expose(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} gauge"
-        try:
-            v = float(self.fn())
-        except Exception:
-            v = 0.0
-        yield f"{self.name}{_fmt_labels(self.const)} {v}"
+        yield f"# TYPE {self.name} {self.kind}"
+        yield from self.samples()
 
 
 class Histogram:
+    kind = "histogram"
+
     def __init__(self, name: str, help_: str, const_labels: dict[str, str],
                  buckets: tuple[float, ...] = DEFAULT_BUCKETS):
         self.name, self.help = name, help_
         self.const = const_labels
-        self.buckets = tuple(buckets) + (math.inf,)
+        # Normalize to true floats so observe's comparisons and expose's
+        # repr() agree even when callers pass numpy scalars / ints.
+        self.buckets = tuple(float(b) for b in buckets) + (math.inf,)
         self._counts: dict[tuple, list[int]] = {}
         self._sum: dict[tuple, float] = defaultdict(float)
         self._n: dict[tuple, int] = defaultdict(int)
@@ -112,25 +142,34 @@ class Histogram:
     def percentile(self, q: float, **labels: str) -> float:
         """Approximate percentile from bucket counts (for planner/tests)."""
         key = tuple(sorted(labels.items()))
-        counts = self._counts.get(key)
-        if not counts or self._n[key] == 0:
-            return 0.0
-        target = q * self._n[key]
+        with self._lock:
+            counts = self._counts.get(key)
+            if not counts or self._n[key] == 0:
+                return 0.0
+            counts = list(counts)
+            target = q * self._n[key]
         for i, c in enumerate(counts):
             if c >= target:
                 return self.buckets[i] if self.buckets[i] != math.inf else self.buckets[i - 1]
         return self.buckets[-2]
 
-    def expose(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} histogram"
-        for key in sorted(self._counts):
+    def samples(self) -> Iterable[str]:
+        with self._lock:
+            snap = {k: (list(c), self._sum[k], self._n[k])
+                    for k, c in self._counts.items()}
+        for key in sorted(snap):
+            counts, total, n = snap[key]
             labels = {**self.const, **dict(key)}
             for i, ub in enumerate(self.buckets):
-                lb = {**labels, "le": "+Inf" if ub == math.inf else repr(ub)}
-                yield f"{self.name}_bucket{_fmt_labels(lb)} {self._counts[key][i]}"
-            yield f"{self.name}_sum{_fmt_labels(labels)} {self._sum[key]}"
-            yield f"{self.name}_count{_fmt_labels(labels)} {self._n[key]}"
+                lb = {**labels, "le": _fmt_le(ub)}
+                yield f"{self.name}_bucket{_fmt_labels(lb)} {counts[i]}"
+            yield f"{self.name}_sum{_fmt_labels(labels)} {total}"
+            yield f"{self.name}_count{_fmt_labels(labels)} {n}"
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} {self.kind}"
+        yield from self.samples()
 
 
 @dataclass
@@ -175,10 +214,32 @@ class MetricsRegistry:
             self._metrics[key] = Histogram(self._full(name), help_, self.const_labels, buckets)
         return self._metrics[key]  # type: ignore[return-value]
 
-    def expose(self) -> str:
-        lines: list[str] = []
-        for m in self._metrics.values():
-            lines.extend(m.expose())  # type: ignore[attr-defined]
+    def _walk(self) -> Iterable[object]:
+        yield from self._metrics.values()
         for c in self._children:
-            lines.append(c.expose().rstrip("\n"))
+            yield from c._walk()
+
+    def expose(self) -> str:
+        """Merge metric families across the registry tree: each family
+        (full metric name) emits ONE # HELP/# TYPE header followed by the
+        samples from every registry contributing to it. The first
+        registration's kind/help wins; same-name metrics of a different
+        kind would be invalid exposition, so their samples are grouped
+        under the first header rather than emitting a duplicate TYPE."""
+        headers: dict[str, tuple[str, str]] = {}
+        by_name: dict[str, list[str]] = {}
+        order: list[str] = []
+        for m in self._walk():
+            name = m.name  # type: ignore[attr-defined]
+            if name not in headers:
+                headers[name] = (m.kind, m.help)  # type: ignore[attr-defined]
+                by_name[name] = []
+                order.append(name)
+            by_name[name].extend(m.samples())  # type: ignore[attr-defined]
+        lines: list[str] = []
+        for name in order:
+            kind, help_ = headers[name]
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {kind}")
+            lines.extend(by_name[name])
         return "\n".join(lines) + "\n"

@@ -1,0 +1,164 @@
+"""End-to-end tracing through real processes: coordinator + mocker
+worker + frontend. An inbound W3C ``traceparent`` header must produce
+one coherent cross-process timeline — frontend, router, and engine
+spans all sharing the caller's trace id — visible via ``/debug/traces``
+(Chrome trace JSON) and as ``dynamo_request_*`` histograms in
+``/metrics``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tests.utils_process import ManagedProcess, free_port
+
+TRACE_ID = "ab" * 16
+PARENT_SPAN = "cd" * 8
+TRACEPARENT = f"00-{TRACE_ID}-{PARENT_SPAN}-01"
+
+
+def http_call(url: str, payload: dict | None = None,
+              headers: dict | None = None, timeout: float = 30.0):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"content-type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read()), dict(resp.headers)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    coord_port = free_port()
+    http_port = free_port()
+    coordinator = ManagedProcess(
+        ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
+         "--port", str(coord_port)], name="coordinator").start()
+    time.sleep(1.0)
+    url = f"tcp://127.0.0.1:{coord_port}"
+    worker = ManagedProcess(
+        ["-m", "dynamo_tpu.components.worker", "--engine", "mocker",
+         "--coordinator", url, "--block-size", "4", "--speedup-ratio", "50",
+         "--max-model-len", "512", "--num-blocks", "128"],
+        name="worker").start()
+    worker.wait_for_line("WORKER_READY", 30)
+    frontend = ManagedProcess(
+        ["-m", "dynamo_tpu.components.frontend", "--coordinator", url,
+         "--host", "127.0.0.1", "--port", str(http_port), "--router-mode", "kv"],
+        name="frontend").start()
+    frontend.wait_for_line("FRONTEND_READY", 30)
+    base = f"http://127.0.0.1:{http_port}"
+    for _ in range(100):
+        if http_call(base + "/v1/models")[0]["data"]:
+            break
+        time.sleep(0.1)
+    yield {"base": base}
+    frontend.stop()
+    worker.stop()
+    coordinator.stop()
+
+
+def _spans_for_trace(base: str, trace_id: str) -> list[dict]:
+    doc, _ = http_call(f"{base}/debug/traces?format=chrome")
+    return [e for e in doc.get("traceEvents", [])
+            if e.get("ph") == "X" and e["args"].get("trace_id") == trace_id]
+
+
+def test_traceparent_propagates_across_hops(cluster):
+    base = cluster["base"]
+    resp, headers = http_call(base + "/v1/chat/completions", {
+        "model": "tiny-llama",
+        "messages": [{"role": "user", "content": "trace me end to end"}],
+        "max_tokens": 12,
+    }, headers={"traceparent": TRACEPARENT})
+    assert resp["choices"][0]["finish_reason"] == "length"
+    # the frontend echoes the trace identity back to the caller
+    assert headers.get("x-trace-id") == TRACE_ID
+    assert TRACE_ID in headers.get("traceparent", "")
+
+    # the root span closes just after the response is written; poll briefly
+    deadline = time.time() + 5
+    spans: list[dict] = []
+    while time.time() < deadline:
+        spans = _spans_for_trace(base, TRACE_ID)
+        if {"request", "router.schedule", "engine.queue",
+                "engine.decode"} <= {e["name"] for e in spans}:
+            break
+        time.sleep(0.1)
+    names = {e["name"] for e in spans}
+    # ≥4 hops on the SAME trace id: frontend root, router decision,
+    # engine admission, decode — plus the worker dispatch envelope
+    assert {"request", "router.schedule", "engine.queue",
+            "engine.decode"} <= names, names
+    assert "worker.dispatch" in names
+
+    # the inbound traceparent's span id is the root's parent
+    (root,) = [e for e in spans if e["name"] == "request"]
+    assert root["args"]["parent_id"] == PARENT_SPAN
+    assert root["args"]["status"] == "ok"
+    assert root["args"]["output_tokens"] == 12
+
+    # parentage chains back to the root within the trace
+    by_id = {e["args"]["span_id"]: e for e in spans}
+    for e in spans:
+        parent = e["args"].get("parent_id")
+        if e is root or parent is None:
+            continue
+        while parent not in (None, PARENT_SPAN):
+            assert parent in by_id, f"{e['name']} orphaned at {parent}"
+            e = by_id[parent]
+            parent = e["args"].get("parent_id")
+
+    # engine phases carry their structured attributes
+    (queue,) = [e for e in spans if e["name"] == "engine.queue"]
+    assert queue["args"]["prompt_tokens"] > 0
+    decode_tokens = sum(e["args"].get("tokens", 0)
+                        for e in spans if e["name"] == "engine.decode")
+    assert decode_tokens == 12
+
+
+def test_debug_traces_is_valid_chrome_json(cluster):
+    doc, headers = http_call(cluster["base"] + "/debug/traces")
+    assert "application/json" in headers.get("Content-Type", "")
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs
+    for e in evs:
+        assert e["ph"] in ("X", "M", "C")
+        if e["ph"] == "X":
+            assert e["dur"] >= 0 and "trace_id" in e["args"]
+    # ph:"M" metadata rows name the emitting components
+    procs = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert "frontend" in procs and "worker" in procs
+
+
+def test_debug_traces_jsonl_and_filter(cluster):
+    req = urllib.request.Request(
+        cluster["base"] + f"/debug/traces?format=jsonl&trace_id={TRACE_ID}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        body = resp.read().decode()
+    spans = [json.loads(line) for line in body.strip().splitlines()]
+    assert spans and all(s["trace_id"] == TRACE_ID for s in spans)
+    assert "request" in {s["name"] for s in spans}
+
+
+def test_phase_histograms_in_metrics(cluster):
+    with urllib.request.urlopen(cluster["base"] + "/metrics",
+                                timeout=10) as resp:
+        text = resp.read().decode()
+
+    def count_of(family: str) -> float:
+        return sum(float(line.rsplit(" ", 1)[1])
+                   for line in text.splitlines()
+                   if line.startswith(family + "_count"))
+
+    assert count_of("dynamo_request_ttft_seconds") >= 1
+    assert count_of("dynamo_request_queue_seconds") >= 1
+    assert count_of("dynamo_request_e2e_seconds") >= 1
+    assert text.count("# TYPE dynamo_request_ttft_seconds histogram") == 1

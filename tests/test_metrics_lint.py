@@ -1,0 +1,45 @@
+"""Tier-1 guard: every metric registration in the tree passes the
+static lint (valid ``dynamo_[a-z0-9_]*`` name, non-empty constant help
+text) — see tools/lint_metrics.py. Keeps dashboards grep-stable and the
+exposition Prometheus-valid as metrics are added."""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+from tools.lint_metrics import lint_tree
+
+
+def test_tree_passes_metrics_lint():
+    problems = lint_tree()
+    assert not problems, "\n".join(problems)
+
+
+def test_lint_catches_violations(tmp_path: Path):
+    (tmp_path / "bad.py").write_text(textwrap.dedent("""
+        def setup(reg, dyn):
+            reg.counter("Bad-Name", "help")          # invalid chars
+            reg.gauge("ok_gauge")                    # missing help
+            reg.histogram("ok_hist", "")             # empty help
+            reg.func_gauge("ok_fg", lambda: 0.0)     # func_gauge no help
+            reg.counter(dyn, "help")                 # dynamic name
+            h = reg.histogram                        # aliased registration
+            h("also_bad-", "help")
+    """))
+    problems = lint_tree(tmp_path)
+    assert len(problems) == 6, "\n".join(problems)
+    assert any("Bad-Name" in p for p in problems)
+    assert any("also_bad-" in p for p in problems)
+    assert any("not a string constant" in p for p in problems)
+    assert sum("help text" in p for p in problems) == 3
+
+
+def test_lint_accepts_clean_module(tmp_path: Path):
+    (tmp_path / "good.py").write_text(textwrap.dedent("""
+        def setup(reg):
+            reg.counter("requests_total", "requests served")
+            reg.func_gauge("depth", lambda: 1.0, "queue depth")
+            reg.histogram("lat_seconds", help_="latency", buckets=(0.1,))
+    """))
+    assert lint_tree(tmp_path) == []

@@ -1,0 +1,1 @@
+"""Developer CLIs: checkpoint prep, perf probes, trace/metrics tooling."""

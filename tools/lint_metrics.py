@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Static lint for Prometheus metric registrations.
+
+Walks the ``dynamo_tpu`` tree with ``ast`` and checks every
+``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` /
+``.func_gauge(...)`` call (including simple in-module aliases like
+``h = registry.histogram``):
+
+* the metric name must be a string constant matching
+  ``^[a-z][a-z0-9_]*$`` — the registry prepends ``dynamo_``, so the
+  exposed name stays ``dynamo_[a-z0-9_]+`` (Prometheus-valid and
+  grep-stable for dashboards);
+* the help text must be a non-empty string constant (``help_`` is the
+  2nd positional for counter/gauge/histogram, 3rd for func_gauge, or
+  the ``help_`` keyword).
+
+Run as a CLI (``python tools/lint_metrics.py [root]``) or from tests via
+``lint_tree()``. Exit status 1 and one line per violation on failure.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+METHODS = {"counter": 1, "gauge": 1, "histogram": 1, "func_gauge": 2}
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _const_str(node: ast.expr | None) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _check_call(call: ast.Call, method: str, path: Path,
+                problems: list[str]) -> None:
+    where = f"{path}:{call.lineno}"
+    help_idx = METHODS[method]
+
+    name = _const_str(call.args[0]) if call.args else None
+    if call.args and name is None:
+        # Dynamic names defeat static dashboards/grep; flag them.
+        problems.append(f"{where}: {method}() name is not a string constant")
+        return
+    if name is None:
+        problems.append(f"{where}: {method}() called without a metric name")
+        return
+    if not NAME_RE.match(name):
+        problems.append(
+            f"{where}: metric name {name!r} does not match "
+            f"[a-z][a-z0-9_]* (exposed as dynamo_<name>)")
+
+    help_node: ast.expr | None = None
+    for kw in call.keywords:
+        if kw.arg == "help_":
+            help_node = kw.value
+    if help_node is None and len(call.args) > help_idx:
+        help_node = call.args[help_idx]
+    help_text = _const_str(help_node)
+    if help_node is None or help_text is None or not help_text.strip():
+        problems.append(
+            f"{where}: metric {name!r} needs non-empty constant help text")
+
+
+def _lint_module(path: Path, problems: list[str]) -> None:
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError as exc:  # a broken module is its own violation
+        problems.append(f"{path}: syntax error: {exc}")
+        return
+
+    # First pass: in-module aliases of registration methods
+    # (e.g. ``h = registry.histogram`` in obs/bridge.py).
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr in METHODS):
+            aliases[node.targets[0].id] = node.value.attr
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in METHODS:
+            _check_call(node, fn.attr, path, problems)
+        elif isinstance(fn, ast.Name) and fn.id in aliases:
+            _check_call(node, aliases[fn.id], path, problems)
+
+
+def lint_tree(root: Path | None = None) -> list[str]:
+    """Lint every ``dynamo_tpu`` module under ``root``; return problems."""
+    if root is None:
+        root = Path(__file__).resolve().parent.parent / "dynamo_tpu"
+    problems: list[str] = []
+    for path in sorted(root.rglob("*.py")):
+        if "tests" in path.parts:
+            continue
+        _lint_module(path, problems)
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    root = Path(argv[1]) if len(argv) > 1 else None
+    problems = lint_tree(root)
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} metric lint violation(s)", file=sys.stderr)
+        return 1
+    print("metrics lint: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

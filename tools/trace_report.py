@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Offline trace analysis for flight-recorder dumps.
+
+Reads a span dump — JSONL (one span object per line, the
+``/debug/traces?format=jsonl`` output) or Chrome trace-event JSON (the
+default ``/debug/traces`` format) — and prints:
+
+* a per-phase latency table: count / p50 / p95 / max, grouped by span
+  name, durations in milliseconds;
+* the slowest ``request`` spans with their per-phase breakdown so a
+  tail-latency outlier can be attributed to queueing vs prefill vs
+  decode vs KV transfer at a glance.
+
+Dependency-free; pairs with ``benchmarks/loadgen.py --trace-out``.
+
+Usage::
+
+    python tools/trace_report.py trace.json [--top 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+
+def load_spans(path: Path) -> list[dict]:
+    """Parse JSONL or Chrome trace JSON into plain span dicts with
+    name/trace_id/start/end (epoch seconds)."""
+    text = path.read_text()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None  # multi-line → treat as JSONL below
+    if isinstance(doc, dict) and "traceEvents" in doc:  # Chrome format
+        spans = []
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            args = ev.get("args", {})
+            start = ev.get("ts", 0) / 1e6
+            spans.append({
+                "name": ev.get("name", ""),
+                "trace_id": args.get("trace_id", ""),
+                "span_id": args.get("span_id", ""),
+                "parent_id": args.get("parent_id"),
+                "start": start,
+                "end": start + ev.get("dur", 0) / 1e6,
+                "status": args.get("status", "ok"),
+                "attrs": {k: v for k, v in args.items()
+                          if k not in ("trace_id", "span_id", "parent_id",
+                                       "status")},
+            })
+        return spans
+    spans = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        d.setdefault("attrs", {})
+        spans.append(d)
+    return spans
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def phase_table(spans: list[dict]) -> str:
+    by_name: dict[str, list[float]] = defaultdict(list)
+    for s in spans:
+        dur = max(float(s.get("end", 0)) - float(s.get("start", 0)), 0.0)
+        by_name[s.get("name", "?")].append(dur * 1e3)
+    rows = [("phase", "count", "p50 ms", "p95 ms", "max ms")]
+    for name in sorted(by_name):
+        vals = sorted(by_name[name])
+        rows.append((name, str(len(vals)), f"{_pct(vals, 0.50):.2f}",
+                     f"{_pct(vals, 0.95):.2f}", f"{vals[-1]:.2f}"))
+    widths = [max(len(r[i]) for r in rows) for i in range(5)]
+    lines = []
+    for i, r in enumerate(rows):
+        lines.append("  ".join(c.ljust(widths[j]) if j == 0 else
+                               c.rjust(widths[j]) for j, c in enumerate(r)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def slowest_requests(spans: list[dict], top: int) -> str:
+    by_trace: dict[str, list[dict]] = defaultdict(list)
+    for s in spans:
+        by_trace[s.get("trace_id", "")].append(s)
+    roots = [s for s in spans if s.get("name") == "request"]
+    roots.sort(key=lambda s: float(s.get("end", 0)) - float(s.get("start", 0)),
+               reverse=True)
+    out = []
+    for root in roots[:top]:
+        dur = (float(root.get("end", 0)) - float(root.get("start", 0))) * 1e3
+        attrs = root.get("attrs", {})
+        rid = attrs.get("request_id", root.get("trace_id", "?")[:16])
+        out.append(f"request {rid}  {dur:.2f} ms  status={root.get('status')}"
+                   f"  model={attrs.get('model', '?')}"
+                   f"  in={attrs.get('input_tokens', '?')}"
+                   f"  out={attrs.get('output_tokens', '?')}")
+        children = [s for s in by_trace.get(root.get("trace_id", ""), [])
+                    if s is not root]
+        children.sort(key=lambda s: float(s.get("start", 0)))
+        t0 = float(root.get("start", 0))
+        for c in children:
+            cdur = (float(c.get("end", 0)) - float(c.get("start", 0))) * 1e3
+            off = (float(c.get("start", 0)) - t0) * 1e3
+            extra = ""
+            if c.get("status") not in (None, "ok"):
+                extra = f"  [{c['status']}]"
+            out.append(f"    +{off:8.2f} ms  {c.get('name', '?'):24s}"
+                       f" {cdur:8.2f} ms{extra}")
+    return "\n".join(out) if out else "(no request spans in dump)"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("dump", type=Path,
+                   help="JSONL or Chrome trace JSON span dump")
+    p.add_argument("--top", type=int, default=5,
+                   help="slowest requests to break down (default 5)")
+    args = p.parse_args(argv)
+
+    spans = load_spans(args.dump)
+    if not spans:
+        print(f"no spans found in {args.dump}", file=sys.stderr)
+        return 1
+    print(f"{len(spans)} spans, "
+          f"{len({s.get('trace_id') for s in spans})} traces\n")
+    print(phase_table(spans))
+    print(f"\nslowest requests (top {args.top}):")
+    print(slowest_requests(spans, args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
