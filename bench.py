@@ -20,7 +20,18 @@ NEVER outlives it: every stage gets the remaining budget, the decode loop
 breaks early when short on time (reporting what it measured), and on any
 failure the JSON line is emitted well before a driver-side timeout could
 rc-124 us with nothing on stdout. A bench that cannot reach a device exits
-NONZERO with the error in the JSON — it never reports value 0 with rc 0.
+NONZERO with the error in the JSON — it never reports value 0 with rc 0,
+and a null value ALWAYS carries an ``error`` (plus a ``probe_log`` tail of
+the child's stderr when one exists).
+
+One persistent child does both probe and bench: it prints a
+``DYN_BENCH_PROBE_OK <platform> <kind>`` marker the moment jax can see a
+device, then runs the bench in the SAME interpreter — the expensive device
+init (cold axon-tunnel attach >150s) is paid once, not once for a probe
+subprocess and again for the bench. The parent waits for the marker within
+the probe budget, kills + respawns on a hang, then waits for the JSON line.
+``--no-probe`` (or DYN_BENCH_SKIP_PROBE=1) skips the marker wait entirely
+for environments where device init is known-fast (CPU CI).
 
 The JSON also records which attention implementation actually served the
 decode steps (``attn_impl``) and the platform/device kind, so a silent
@@ -33,6 +44,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 _START = time.monotonic()
@@ -49,6 +61,9 @@ WINDOW = int(os.environ.get("DYN_BENCH_WINDOW", "8"))
 # read per decode step, doubling the bandwidth roofline the score is
 # normalized against — the JSON reports the ACTUAL param bytes either way.
 QUANT = os.environ.get("DYN_BENCH_QUANT", "none")
+# KV-cache storage dtype ("bfloat16" | "int8"): int8 halves decode's KV
+# reads and doubles cache capacity (engine/cache.py); the JSON records it.
+KV_DTYPE = os.environ.get("DYN_BENCH_KV_DTYPE", "bfloat16")
 # Platform: by default the ambient JAX_PLATFORMS is respected (the driver's
 # TPU environment reaches the chip through the axon PJRT plugin, whose
 # platform name is "axon" — overriding to "tpu" would disable it). Setting
@@ -82,46 +97,70 @@ def _platform_env() -> dict:
     return env
 
 
-def fail(stage: str, error: str) -> None:
-    print(json.dumps({
+def fail(stage: str, error: str, probe_log: str = "") -> None:
+    """Emit the failure JSON line. A null value ALWAYS carries ``error``;
+    ``probe_log`` (child stderr tail) rides along whenever one exists so a
+    driver log shows WHY the device never came up without a re-run."""
+    out = {
         "metric": METRIC,
         "value": None,
         "unit": "tok/s/chip",
         "vs_baseline": None,
         "error": f"{stage}: {error.strip()[-2000:]}",
-    }))
+    }
+    if probe_log.strip():
+        out["probe_log"] = probe_log.strip()[-2000:]
+    print(json.dumps(out))
     sys.exit(1)
 
 
-def probe_devices() -> None:
-    """Initialize jax in a subprocess (a wedged TPU tunnel can't hang the
-    bench itself), bounded by the overall deadline. Raises on failure."""
-    code = "import jax; d = jax.devices()[0]; print(d.platform, '|', getattr(d, 'device_kind', '?'))"
-    env = dict(os.environ, **_platform_env())
-    last = "no attempts made"
-    for attempt in range(1, PROBE_RETRIES + 1):
-        budget = min(PROBE_TIMEOUT, remaining() - 30.0)
-        if budget <= 5.0:
-            raise RuntimeError(f"deadline exhausted before probe attempt {attempt}; last: {last}")
-        t0 = time.monotonic()
+PROBE_MARKER = "DYN_BENCH_PROBE_OK"
+
+
+def _spawn_child(budget: float):
+    """Start the probe+bench child; reader threads collect its output and
+    flip ``marker`` the moment the device-ready line appears."""
+    env = dict(os.environ, **_platform_env(), _DYN_BENCH_CHILD="1")
+    # Child-side deadline sits inside the parent's kill timeout so the child
+    # exits cleanly (emitting its JSON) before the parent would SIGKILL it —
+    # killing a process mid-TPU-dispatch can wedge the device tunnel.
+    env["DYN_BENCH_DEADLINE"] = str(max(budget - 10.0, 10.0))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)], env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    state = {"out": [], "err": [], "marker": threading.Event()}
+
+    def read_out():
+        for line in iter(proc.stdout.readline, ""):
+            state["out"].append(line)
+            if line.startswith(PROBE_MARKER):
+                state["marker"].set()
+        proc.stdout.close()
+
+    def read_err():
+        for line in iter(proc.stderr.readline, ""):
+            state["err"].append(line)
+        proc.stderr.close()
+
+    threads = [threading.Thread(target=read_out, daemon=True),
+               threading.Thread(target=read_err, daemon=True)]
+    for t in threads:
+        t.start()
+    state["threads"] = threads
+    return proc, state
+
+
+def _reap(proc, state) -> str:
+    """Kill (if alive) and drain; returns the stderr text."""
+    if proc.poll() is None:
+        proc.kill()
         try:
-            out = subprocess.run(
-                [sys.executable, "-c", code], capture_output=True,
-                timeout=budget, text=True, env=env,
-            )
+            proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
-            last = f"attempt {attempt}: device init timed out after {budget:.0f}s"
-            print(last, file=sys.stderr)
-            continue
-        if out.returncode == 0:
-            print(f"device probe ok in {time.monotonic() - t0:.1f}s: "
-                  f"{out.stdout.strip()}", file=sys.stderr)
-            return
-        last = (f"attempt {attempt}: device init failed rc={out.returncode}: "
-                f"{out.stderr.strip()[-800:]}")
-        print(last, file=sys.stderr)
-        time.sleep(min(5.0 * attempt, 15.0))
-    raise RuntimeError(f"device probe failed after {PROBE_RETRIES} attempts; last: {last}")
+            pass
+    for t in state["threads"]:
+        t.join(timeout=5)
+    return "".join(state["err"])
 
 
 def run_bench(deadline_at: float) -> dict:
@@ -155,6 +194,7 @@ def run_bench(deadline_at: float) -> dict:
         allow_random_weights=True,
         enable_prefix_caching=False,
         quantization=QUANT,
+        kv_dtype=KV_DTYPE,
     ))
     for i in range(BATCH):
         toks = [(7 * i + 11 * j) % 32000 + 5 for j in range(PROMPT_LEN)]
@@ -214,6 +254,7 @@ def run_bench(deadline_at: float) -> dict:
         "decode_steps_timed": measured // BATCH,
         "roofline_tok_s": round(roofline, 1),
         "quantization": QUANT,
+        "kv_dtype": KV_DTYPE,
         "param_gib": round(param_bytes / (1 << 30), 3),
         # provenance: the all-greedy batch rides the argmax-only step
         # variant (bit-identical streams; engine/engine.py fast_greedy)
@@ -226,53 +267,76 @@ def main() -> None:
         # Child: env was set at spawn, so the PJRT plugin saw it at
         # interpreter start (setting JAX_PLATFORMS after startup is ignored —
         # the axon plugin configures jax programmatically via sitecustomize).
+        # The device init doubles as the probe: print the marker the moment
+        # jax sees a device, then keep going — same interpreter, one init.
         deadline_at = time.monotonic() + remaining()
         try:
+            import jax
+
+            d = jax.devices()[0]
+            print(f"{PROBE_MARKER} {d.platform} "
+                  f"{getattr(d, 'device_kind', '?')}", flush=True)
             result = run_bench(deadline_at)
         except Exception as exc:  # noqa: BLE001
             import traceback
             traceback.print_exc()
             fail("run_bench", f"{type(exc).__name__}: {exc}")
             return
-        print(json.dumps(result))
+        print(json.dumps(result), flush=True)
         return
 
-    try:
-        probe_devices()
-    except Exception as exc:  # noqa: BLE001 - converted to the JSON contract
-        fail("device_probe", str(exc))
-    budget = remaining() - 15.0
-    if budget <= 30.0:
-        # Require real headroom: the child needs its 10s clean-exit margin
-        # below the parent kill timeout to actually mean something.
-        fail("bench_child", "deadline exhausted after device probe")
-    env = dict(os.environ, **_platform_env(), _DYN_BENCH_CHILD="1")
-    # Child-side deadline sits inside the parent's kill timeout so the child
-    # exits cleanly (emitting its JSON) before the parent would SIGKILL it —
-    # killing a process mid-TPU-dispatch can wedge the device tunnel.
-    env["DYN_BENCH_DEADLINE"] = str(max(budget - 10.0, 10.0))
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)], env=env, text=True,
-            capture_output=True, timeout=budget,
-        )
-    except subprocess.TimeoutExpired as exc:
-        err = exc.stderr
-        if isinstance(err, bytes):
-            err = err.decode(errors="replace")
-        sys.stderr.write((err or "")[-4000:])
-        fail("bench_child", f"bench hung for {exc.timeout:.0f}s after a successful device probe")
-        return
-    sys.stderr.write(proc.stderr[-8000:])
-    if not any(ln.startswith("{") for ln in proc.stdout.splitlines()):
-        # Child died without emitting its JSON line (SIGKILL, OOM, libtpu
-        # abort) — synthesize one so the contract holds even then.
-        fail("bench_child",
-             f"child exited rc={proc.returncode} with no JSON; stderr tail: "
-             + proc.stderr[-1500:])
-        return
-    sys.stdout.write(proc.stdout)
-    sys.exit(proc.returncode)
+    skip_probe = ("--no-probe" in sys.argv[1:]
+                  or os.environ.get("DYN_BENCH_SKIP_PROBE") == "1")
+    attempts = 1 if skip_probe else max(PROBE_RETRIES, 1)
+    probe_log = ""
+    last = "no attempts made"
+    for attempt in range(1, attempts + 1):
+        budget = remaining() - 15.0
+        if budget <= 30.0:
+            # Require real headroom: the child needs its 10s clean-exit
+            # margin below the parent kill timeout to mean something.
+            fail("bench_child",
+                 f"deadline exhausted before attempt {attempt}; last: {last}",
+                 probe_log)
+        proc, state = _spawn_child(budget)
+        if not skip_probe:
+            probe_budget = min(PROBE_TIMEOUT, budget - 30.0)
+            if not state["marker"].wait(probe_budget):
+                rc = proc.poll()
+                probe_log = _reap(proc, state)
+                last = (f"attempt {attempt}: device init failed rc={rc}"
+                        if rc is not None else
+                        f"attempt {attempt}: no device within {probe_budget:.0f}s")
+                print(last, file=sys.stderr)
+                time.sleep(min(5.0 * attempt, 15.0))
+                continue
+            # Marker seen — the SAME process now runs the bench; no second
+            # cold init. Re-derive the wait from what's actually left.
+        try:
+            proc.wait(timeout=max(remaining() - 5.0, 10.0))
+        except subprocess.TimeoutExpired:
+            probe_log = _reap(proc, state)
+            sys.stderr.write(probe_log[-4000:])
+            fail("bench_child",
+                 f"bench hung after {'spawn' if skip_probe else 'a successful device probe'}",
+                 probe_log)
+            return
+        stderr_text = _reap(proc, state)
+        sys.stderr.write(stderr_text[-8000:])
+        out_lines = state["out"]
+        if not any(ln.startswith("{") for ln in out_lines):
+            # Child died without emitting its JSON line (SIGKILL, OOM,
+            # libtpu abort) — synthesize one so the contract holds.
+            fail("bench_child",
+                 f"child exited rc={proc.returncode} with no JSON; stderr "
+                 "tail: " + stderr_text[-1500:], stderr_text)
+            return
+        sys.stdout.write("".join(
+            ln for ln in out_lines if not ln.startswith(PROBE_MARKER)))
+        sys.exit(proc.returncode)
+    fail("device_probe",
+         f"device probe failed after {attempts} attempt(s); last: {last}",
+         probe_log)
 
 
 if __name__ == "__main__":

@@ -311,6 +311,37 @@ async def fetch_traces(url: str, path: str) -> None:
         print(f"loadgen: trace fetch failed: {exc}", file=sys.stderr)
 
 
+async def probe_kv_quant(url: str) -> bool | None:
+    """Best-effort read of dynamo_engine_kv_quant_enabled from <url>/metrics
+    (the gauge lives on whatever status server the url fronts; a frontend
+    without a metrics proxy just yields None — never a failure)."""
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{url}/metrics",
+                                   timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                if resp.status != 200:
+                    return None
+                text = await resp.text()
+        for line in text.splitlines():
+            if line.startswith("dynamo_engine_kv_quant_enabled"):
+                return bool(float(line.split()[-1]))
+    except Exception:
+        return None
+    return None
+
+
+def _record_kv_dtype(result: dict, url: str, kv_dtype: str | None) -> None:
+    if kv_dtype is None:
+        return
+    result["kv_dtype"] = kv_dtype
+    observed = asyncio.run(probe_kv_quant(url))
+    if observed is not None:
+        result["kv_quant_enabled"] = observed
+        if observed != (kv_dtype == "int8"):
+            print(f"loadgen: WARNING --kv-dtype={kv_dtype} but engine "
+                  f"reports kv_quant_enabled={observed}", file=sys.stderr)
+
+
 def main(argv: list[str] | None = None) -> dict:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", default="http://127.0.0.1:8000")
@@ -332,6 +363,11 @@ def main(argv: list[str] | None = None) -> dict:
                          "deadline (must never reach prefill)")
     ap.add_argument("--chips", type=int, default=1,
                     help="chips serving the endpoint (for tok/s/chip)")
+    ap.add_argument("--kv-dtype", choices=["bfloat16", "int8"], default=None,
+                    help="KV-cache dtype the serving engine was launched "
+                         "with; recorded in the result JSON and checked "
+                         "against the engine's dynamo_engine_kv_quant_enabled "
+                         "gauge when /metrics is reachable")
     ap.add_argument("--out", default=None, help="also write the JSON here")
     ap.add_argument("--trace-out", default=None,
                     help="after the run, fetch <url>/debug/traces (Chrome "
@@ -343,6 +379,7 @@ def main(argv: list[str] | None = None) -> dict:
         result = asyncio.run(run_overload(
             ns.url, ns.model, ns.arrival_rate, ns.requests, ns.isl, ns.osl,
             ns.priority_mix, ns.expired_frac))
+        _record_kv_dtype(result, ns.url, ns.kv_dtype)
         print(json.dumps(result))
         if ns.out:
             with open(ns.out, "w") as f:
@@ -355,6 +392,7 @@ def main(argv: list[str] | None = None) -> dict:
         ns.url, ns.model, ns.concurrency, ns.requests, ns.isl, ns.osl, ns.warmup))
     result["chips"] = ns.chips
     result["output_tok_s_per_chip"] = round(result["output_tok_s"] / ns.chips, 2)
+    _record_kv_dtype(result, ns.url, ns.kv_dtype)
     print(json.dumps(result))
     if ns.out:
         with open(ns.out, "w") as f:

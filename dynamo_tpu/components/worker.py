@@ -65,6 +65,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--quantization", choices=["none", "int8"], default="none",
                    help="weight-only quantization (int8: per-channel scales, "
                         "bf16 compute; halves decode HBM traffic)")
+    p.add_argument("--kv-dtype", choices=["bfloat16", "int8"],
+                   default="bfloat16",
+                   help="paged KV cache storage dtype (int8: per-block-per-"
+                        "head scales, in-kernel dequant; halves KV bytes so "
+                        "auto-sizing fits ~2x the blocks)")
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--speedup-ratio", type=float, default=10.0, help="mocker only")
     p.add_argument("--no-kv-events", action="store_true")
@@ -247,6 +252,7 @@ async def amain(ns: argparse.Namespace) -> None:
             sp=ns.sp,
             decode_window=ns.decode_window,
             quantization=ns.quantization,
+            kv_dtype=ns.kv_dtype,
             spec_ngram=ns.spec_ngram,
             spec_k=ns.spec_k,
             allow_random_weights=ns.allow_random_weights,

@@ -5,6 +5,13 @@ The device tier (G1) of the KV block story: cache tensors are
 over the mesh "model" axis on kv_heads. Block 0 is reserved as the trash
 block for padding writes (models/llama.py). Host/disk tiers and offload live
 in dynamo_tpu.kvbm (reference: lib/llm/src/block_manager/).
+
+With ``kv_dtype="int8"`` each cache becomes a two-leaf pytree
+``{"q": int8 payload [L, NB, BS, KH, D], "s": float32 scales [L, NB, KH]}``
+— symmetric per-(layer, block, kv_head) quantization, mirroring the
+``{"q", "so"}`` weight-quant idiom in models/llama.py. Everything downstream
+(scan over layers, donation, shard_map in_specs) treats the cache as a
+pytree, so the plain-array fast path is structurally unchanged.
 """
 
 from __future__ import annotations
@@ -17,7 +24,10 @@ from jax.sharding import Mesh, NamedSharding
 
 from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.models.config import ModelConfig
-from dynamo_tpu.parallel.mesh import kv_cache_spec
+from dynamo_tpu.parallel.mesh import kv_cache_spec, kv_scale_spec
+
+#: scales are float32 — 4 bytes per (layer, block, kv_head), k and v each
+_SCALE_ITEMSIZE = 4
 
 
 @dataclass
@@ -28,9 +38,13 @@ class KVCacheSpec:
     num_kv_heads: int
     head_dim: int
     dtype: str = "bfloat16"
+    #: "int8" enables quantized storage; any other value means the cache is
+    #: stored at ``dtype`` (model precision) exactly as before.
+    kv_dtype: str = "bfloat16"
 
     @classmethod
-    def for_model(cls, cfg: ModelConfig, num_blocks: int, block_size: int) -> "KVCacheSpec":
+    def for_model(cls, cfg: ModelConfig, num_blocks: int, block_size: int,
+                  kv_dtype: str = "bfloat16") -> "KVCacheSpec":
         return cls(
             num_blocks=num_blocks,
             block_size=block_size,
@@ -38,20 +52,46 @@ class KVCacheSpec:
             num_kv_heads=cfg.num_kv_heads,
             head_dim=cfg.head_dim,
             dtype=cfg.dtype,
+            kv_dtype=kv_dtype,
         )
+
+    @property
+    def quantized(self) -> bool:
+        return self.kv_dtype == "int8"
 
     @property
     def shape(self) -> tuple[int, int, int, int, int]:
         return (self.num_layers, self.num_blocks, self.block_size, self.num_kv_heads, self.head_dim)
 
+    @property
+    def scale_shape(self) -> tuple[int, int, int]:
+        """Quantization scale tensor [layers, blocks, kv_heads] (int8 mode)."""
+        return (self.num_layers, self.num_blocks, self.num_kv_heads)
+
     def bytes_per_block(self) -> int:
+        if self.quantized:
+            payload = 2 * self.num_layers * self.block_size * self.num_kv_heads * self.head_dim
+            scales = 2 * self.num_layers * self.num_kv_heads * _SCALE_ITEMSIZE
+            return payload + scales
         itemsize = jnp.dtype(self.dtype).itemsize
         # k + v, all layers
         return 2 * self.num_layers * self.block_size * self.num_kv_heads * self.head_dim * itemsize
 
 
-def allocate_cache(spec: KVCacheSpec, mesh: Mesh | None = None) -> tuple[jax.Array, jax.Array]:
-    """Allocate zeroed K and V cache arrays (sharded if a mesh is given)."""
+def allocate_cache(spec: KVCacheSpec, mesh: Mesh | None = None):
+    """Allocate zeroed K and V caches (sharded if a mesh is given).
+
+    Returns plain arrays, or ``{"q", "s"}`` pytrees when ``spec.quantized``
+    (payload and scales sharded with per-leaf out_shardings)."""
+    if spec.quantized:
+        def qzeros():
+            return {"q": jnp.zeros(spec.shape, jnp.int8),
+                    "s": jnp.zeros(spec.scale_shape, jnp.float32)}
+        if mesh is not None:
+            sh = {"q": NamedSharding(mesh, kv_cache_spec()),
+                  "s": NamedSharding(mesh, kv_scale_spec())}
+            qzeros = jax.jit(qzeros, out_shardings=sh)
+        return qzeros(), qzeros()
     if mesh is not None:
         sharding = NamedSharding(mesh, kv_cache_spec())
         zeros = jax.jit(
@@ -60,6 +100,13 @@ def allocate_cache(spec: KVCacheSpec, mesh: Mesh | None = None) -> tuple[jax.Arr
         return zeros(), zeros()
     z = jnp.zeros(spec.shape, jnp.dtype(spec.dtype))
     return z, jnp.zeros_like(z)
+
+
+def cache_payload(cache) -> jax.Array:
+    """The int8 payload leaf of a quantized cache, or the array itself —
+    use wherever shard/box geometry of the [L, NB, BS, KH, D] tensor is
+    needed without caring about quantization."""
+    return cache["q"] if isinstance(cache, dict) else cache
 
 
 @dataclass

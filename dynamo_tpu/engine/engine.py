@@ -87,9 +87,16 @@ class EngineMetrics:
     # QoS: requests cancelled because their deadline passed (either while
     # waiting — before any prefill — or mid-decode via the stop check).
     deadline_cancelled: int = 0
+    # KV-cache footprint (set once at engine construction): total device
+    # bytes of the paged cache and whether int8 KV quantization is on —
+    # exported as dynamo_engine_kv_cache_bytes / dynamo_engine_kv_quant_enabled.
+    kv_cache_bytes: int = 0
+    kv_quant_enabled: bool = False
 
     def snapshot(self, sched: Scheduler, pool: PrefixPool) -> dict:
         return {
+            "kv_cache_bytes": self.kv_cache_bytes,
+            "kv_quant_enabled": self.kv_quant_enabled,
             "num_waiting": sched.num_waiting,
             "num_running": sched.num_running,
             "kv_usage": pool.usage,
@@ -190,7 +197,8 @@ class ModelRunner:
 
             self.params = quantize_params_int8(self.params, cfg)
         num_blocks = engine_cfg.num_blocks or self._auto_num_blocks()
-        self.spec = KVCacheSpec.for_model(cfg, num_blocks, engine_cfg.block_size)
+        self.spec = KVCacheSpec.for_model(cfg, num_blocks, engine_cfg.block_size,
+                                          kv_dtype=engine_cfg.kv_dtype)
         self.cache_k, self.cache_v = allocate_cache(self.spec, mesh)
         maxb = engine_cfg.max_batch_size
         # Row maxb is the trash row: padding/non-sampling rows write their
@@ -234,7 +242,8 @@ class ModelRunner:
             budget = int((limit - in_use) * 0.85)
         except Exception:
             budget = 0
-        spec = KVCacheSpec.for_model(self.cfg, 1, ec.block_size)
+        spec = KVCacheSpec.for_model(self.cfg, 1, ec.block_size,
+                                     kv_dtype=ec.kv_dtype)
         if budget > 0:
             n = max(budget // spec.bytes_per_block(), 16)
         else:
@@ -313,10 +322,13 @@ class ModelRunner:
             return {}
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from dynamo_tpu.parallel.mesh import kv_cache_spec
+        from dynamo_tpu.parallel.mesh import kv_cache_spec, kv_scale_spec
 
         repl = NamedSharding(self.mesh, P())
         cache = NamedSharding(self.mesh, kv_cache_spec())
+        if self.spec.quantized:
+            # Quantized caches are {"q","s"} pytrees; shard each leaf.
+            cache = {"q": cache, "s": NamedSharding(self.mesh, kv_scale_spec())}
         return {"out_shardings": (cache, cache, repl, repl, repl, repl, repl)}
 
     def _build_window_fn(self, b: int, nblk: int, w: int,
@@ -427,7 +439,17 @@ class ModelRunner:
         else:
             window = 1  # windows are a decode-dispatch concept
             b, t = _bucket(n, (1, 2, 4, 8)), _pow2_bucket(t_max, 16, ec.prefill_chunk)
-        nblk_need = max(len(s.block_ids) for s, _, _ in rows)
+        # Block-table width from the batch's max KV coverage — NOT the max
+        # allocated table length: every query/context position this step
+        # touches is < start + length (+ window-1 for fused decode windows),
+        # so blocks past that are pure waste (the Pallas kernel still burns
+        # one HBM DMA per table entry per step, and the dense path gathers
+        # them). Pow2-bucketed to bound the number of compiled programs.
+        bsz = ec.block_size
+        nblk_need = max(
+            min(len(s.block_ids),
+                -(-(start + length + window - 1) // bsz))
+            for s, start, length in rows)
         nblk = min(_pow2_bucket(max(nblk_need, 1), 4, self.max_nblk), self.max_nblk)
         # Sequence-parallel prefill: a batch of fresh full-prompt chunks
         # (every row starts at 0) on a seq>1 mesh rides ring attention.
@@ -468,7 +490,8 @@ class ModelRunner:
                 tokens[i, : len(chunk)] = chunk
             q_start[i] = start
             q_len[i] = length
-            bt[i, : len(seq.block_ids)] = seq.block_ids
+            ids = seq.block_ids[:nblk]  # beyond-coverage blocks never read
+            bt[i, : len(ids)] = ids
             slots[i] = max(seq.slot, 0)
             so = seq.req.sampling_options
             temp[i] = so.temperature if so.temperature is not None else 1.0
@@ -568,10 +591,13 @@ class ModelRunner:
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            from dynamo_tpu.parallel.mesh import kv_cache_spec
+            from dynamo_tpu.parallel.mesh import kv_cache_spec, kv_scale_spec
 
             repl = NamedSharding(self.mesh, P())
             cache = NamedSharding(self.mesh, kv_cache_spec())
+            if self.spec.quantized:
+                cache = {"q": cache,
+                         "s": NamedSharding(self.mesh, kv_scale_spec())}
             kw["out_shardings"] = (cache, cache, repl, repl)
         return jax.jit(verify, donate_argnums=(1, 2), **kw)
 
@@ -586,7 +612,12 @@ class ModelRunner:
         # clamp: _pow2_bucket's hi stops further doubling but doesn't cap
         # the result — a 5-token chunk must not mint (and pay for) T=8
         t = min(_pow2_bucket(t_max, 2, ec.spec_k + 1), ec.spec_k + 1)
-        nblk_need = max(len(s.block_ids) for s, _, _ in rows)
+        # Same coverage-based table-width bucketing as dispatch(): the
+        # verify chunk reads nothing past start + len(chunk).
+        bsz = ec.block_size
+        nblk_need = max(
+            min(len(seq.block_ids), -(-(start + len(c)) // bsz))
+            for (seq, start, _), c in zip(rows, chunks))
         nblk = min(_pow2_bucket(max(nblk_need, 1), 4, self.max_nblk), self.max_nblk)
 
         tokens = np.zeros((b, t), np.int32)
@@ -597,7 +628,8 @@ class ModelRunner:
             tokens[i, : len(chunks[i])] = chunks[i]
             q_start[i] = start
             q_len[i] = len(chunks[i])
-            bt[i, : len(seq.block_ids)] = seq.block_ids
+            ids = seq.block_ids[:nblk]
+            bt[i, : len(ids)] = ids
 
         key = ("verify", b, t, nblk)
         if key not in self._step_fns:
@@ -721,6 +753,10 @@ class EngineCore:
             raise ValueError(
                 f"unknown quantization {engine_cfg.quantization!r} "
                 "(supported: none, int8)")
+        if engine_cfg.kv_dtype not in ("bfloat16", "", "int8"):
+            raise ValueError(
+                f"unknown kv_dtype {engine_cfg.kv_dtype!r} "
+                "(supported: bfloat16 [model-precision cache], int8)")
         if mesh is None and any(v != 1 for v in engine_cfg.mesh_shape().values()):
             mesh = make_mesh(MeshConfig(dp=engine_cfg.dp, pp=engine_cfg.pp,
                                         sp=engine_cfg.sp, tp=engine_cfg.tp,
@@ -744,7 +780,11 @@ class EngineCore:
             spec_lookahead=(engine_cfg.spec_k if engine_cfg.spec_ngram > 0
                             else 0),
         )
-        self.metrics = EngineMetrics()
+        self.metrics = EngineMetrics(
+            kv_cache_bytes=(self.runner.spec.bytes_per_block()
+                            * self.runner.spec.num_blocks),
+            kv_quant_enabled=self.runner.spec.quantized,
+        )
         self._seqs: dict[str, Seq] = {}
         self.default_eos: list[int] = []
         # Tracing: decode spans rotate every N generated tokens — one span
@@ -1397,9 +1437,10 @@ class EngineCore:
 
     def my_box(self) -> tuple[int, int, int, int]:
         """This rank's (layer, head) extents of the global cache."""
+        from dynamo_tpu.engine.cache import cache_payload
         from dynamo_tpu.kvbm.distributed import local_box
 
-        starts, stops = local_box(self.runner.cache_k)
+        starts, stops = local_box(cache_payload(self.runner.cache_k))
         return (starts[0], stops[0], starts[3], stops[3])
 
     def start_shard_server(self, advertise_host: str, on_release=None) -> str:
@@ -1434,8 +1475,12 @@ class EngineCore:
         data = None
         try:
             if block_ids:
+                # Sharded staging box-slices 6-d float data (disagg/
+                # sharded.py) — quantized caches stage dequantized blocks;
+                # the importer requantizes at its inject boundary.
                 blocks = self.transfer.extract(
-                    self.runner.cache_k, self.runner.cache_v, block_ids)
+                    self.runner.cache_k, self.runner.cache_v, block_ids,
+                    dequant=self.runner.spec.quantized)
                 data = np.stack(blocks)
         except Exception as exc:  # noqa: BLE001 — vote handles divergence
             log.warning("stage_export extract failed: %s", exc)

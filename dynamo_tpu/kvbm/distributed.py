@@ -36,8 +36,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from dynamo_tpu.engine.cache import KVCacheSpec
-from dynamo_tpu.kvbm.transfer import BlockTransferEngine, _extract, _inject, _pad_pow2
+from dynamo_tpu.engine.cache import KVCacheSpec, cache_payload
+from dynamo_tpu.kvbm.transfer import (
+    BlockTransferEngine, _extract, _extract_deq, _extract_q, _inject,
+    _inject_q, _inject_quant, _is_packed, _pad_pow2, dequantize_block,
+    pack_kv_block, unpack_kv_block)
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("kvbm.distributed")
@@ -80,59 +83,117 @@ class ShardedBlockTransferEngine(BlockTransferEngine):
         # Gather output [layers, n_pad, bs, kvh, hd] keeps the cache's
         # layer/head sharding so no collective materializes full blocks.
         out_spec = NamedSharding(mesh, P("pipe", None, None, "model", None))
+        # Gathered scale sidecar [layers, n_pad, kvh] shares the payload's
+        # layer/head partitions (parallel/mesh.kv_scale_spec minus blocks).
+        scale_spec = NamedSharding(mesh, P("pipe", None, "model"))
         self._extract = jax.jit(_extract,
                                 out_shardings=(out_spec, out_spec))
         self._inject = jax.jit(_inject, donate_argnums=(0, 1))
+        self._extract_q = jax.jit(
+            _extract_q,
+            out_shardings=(out_spec, scale_spec, out_spec, scale_spec))
+        self._extract_deq = jax.jit(_extract_deq,
+                                    out_shardings=(out_spec, out_spec))
+        self._inject_q = jax.jit(_inject_q, donate_argnums=(0, 1))
+        # Requantization reduces over (block_size, head_dim) only — both
+        # unsharded — so the on-device quantize stays shard-local too.
+        self._inject_quant = jax.jit(_inject_quant, donate_argnums=(0, 1))
         self._out_spec = out_spec
+        self._scale_spec = scale_spec
 
-    def extract(self, cache_k, cache_v, ids) -> list[np.ndarray]:
+    def extract(self, cache_k, cache_v, ids, dequant: bool = False) -> list[np.ndarray]:
         n = len(ids)
         padded = jnp.asarray(_pad_pow2(list(ids)), jnp.int32)
-        k, v = self._extract(cache_k, cache_v, padded)
+        if isinstance(cache_k, dict) and not dequant:
+            kq, ks, vq, vs = self._extract_q(cache_k, cache_v, padded)
+            kq, _ = assemble_local(kq)   # [L_loc, n_pad, bs, H_loc, hd]
+            ks, _ = assemble_local(ks)   # [L_loc, n_pad, H_loc]
+            vq, _ = assemble_local(vq)
+            vs, _ = assemble_local(vs)
+            return [pack_kv_block(kq[:, i], ks[:, i], vq[:, i], vs[:, i])
+                    for i in range(n)]
+        if isinstance(cache_k, dict):
+            k, v = self._extract_deq(cache_k, cache_v, padded)
+        else:
+            k, v = self._extract(cache_k, cache_v, padded)
         k_local, _ = assemble_local(k)   # [L_loc, n_pad, bs, H_loc, hd]
         v_local, _ = assemble_local(v)
         kv = np.stack([k_local, v_local])          # [2, L_loc, n_pad, ...]
         per_block = np.moveaxis(kv, 2, 0)          # [n_pad, 2, L_loc, bs, H_loc, hd]
         return [np.ascontiguousarray(per_block[i]) for i in range(n)]
 
+    def _make_global(self, local, dtype, gshape, offs, out_spec):
+        """Global scatter operand: every rank contributes its box. The local
+        data covers exactly this process's (layers, heads) slice."""
+        local = np.asarray(local, dtype)
+
+        def cb(index):
+            sl = tuple(
+                slice((idx.start or 0) - o,
+                      (idx.stop if idx.stop is not None else dim) - o)
+                for idx, o, dim in zip(index, offs, gshape))
+            return np.ascontiguousarray(local[sl])
+        return jax.make_array_from_callback(gshape, out_spec, cb)
+
     def inject(self, cache_k, cache_v, ids, blocks):
         assert len(ids) == len(blocks) and ids
         padded = _pad_pow2(list(ids))
-        data = np.stack(blocks + [blocks[-1]] * (len(padded) - len(blocks)))
+        pad = [blocks[-1]] * (len(padded) - len(blocks))
+        quant_cache = isinstance(cache_k, dict)
+        payload_ref = cache_payload(cache_k)
+        L, BS, KH, D = (payload_ref.shape[0], payload_ref.shape[2],
+                        payload_ref.shape[3], payload_ref.shape[4])
+        starts, stops = local_box(payload_ref)
+        loc_shape = (stops[0] - starts[0], BS, stops[3] - starts[3], D)
+        packed = _is_packed(blocks[0])
+        if quant_cache and packed:
+            ups = [unpack_kv_block(b, loc_shape) for b in blocks + pad]
+            payload = np.stack([p for p, _ in ups])  # [n,2,L_loc,BS,H_loc,D]
+            scales = np.stack([s for _, s in ups])   # [n,2,L_loc,H_loc]
+            p_gshape = (L, len(padded), BS, KH, D)
+            p_offs = (starts[0], 0, 0, starts[3], 0)
+            s_gshape = (L, len(padded), KH)
+            s_offs = (starts[0], 0, starts[3])
+            mk_p = lambda x: self._make_global(
+                np.moveaxis(x, 0, 1), np.int8, p_gshape, p_offs, self._out_spec)
+            mk_s = lambda x: self._make_global(
+                np.moveaxis(x, 0, 1), np.float32, s_gshape, s_offs,
+                self._scale_spec)
+            return self._inject_q(
+                cache_k, cache_v, jnp.asarray(padded, jnp.int32),
+                mk_p(payload[:, 0]), mk_s(scales[:, 0]),
+                mk_p(payload[:, 1]), mk_s(scales[:, 1]))
+        if packed:
+            # int8 snapshot into a float engine: dequantize the local shard.
+            blocks = [dequantize_block(b, loc_shape, payload_ref.dtype)
+                      for b in blocks]
+            pad = [blocks[-1]] * len(pad)
+        data = np.stack(list(blocks) + pad)
         dk_local = np.ascontiguousarray(np.moveaxis(data[:, 0], 0, 1))
         dv_local = np.ascontiguousarray(np.moveaxis(data[:, 1], 0, 1))
-        # Global scatter operand: every rank contributes its box. The local
-        # block data covers exactly this process's (layers, heads) slice of
-        # the global [L, n_pad, bs, H, hd] operand.
-        gshape = (cache_k.shape[0], len(padded), cache_k.shape[2],
-                  cache_k.shape[3], cache_k.shape[4])
-        starts, _ = local_box(cache_k)
+        gshape = (L, len(padded), BS, KH, D)
         offs = (starts[0], 0, 0, starts[3], 0)  # sharded axes: layers, heads
-
-        def make(local):
-            local = np.asarray(local, cache_k.dtype)
-
-            def cb(index):
-                sl = tuple(
-                    slice((idx.start or 0) - o,
-                          (idx.stop if idx.stop is not None else dim) - o)
-                    for idx, o, dim in zip(index, offs, gshape))
-                return np.ascontiguousarray(local[sl])
-            return jax.make_array_from_callback(gshape, self._out_spec, cb)
-
+        dtype = jnp.float32 if quant_cache else payload_ref.dtype
+        dk = self._make_global(dk_local, dtype, gshape, offs, self._out_spec)
+        dv = self._make_global(dv_local, dtype, gshape, offs, self._out_spec)
+        if quant_cache:
+            # Float blocks into an int8 engine: requantize on device.
+            return self._inject_quant(
+                cache_k, cache_v, jnp.asarray(padded, jnp.int32), dk, dv)
         return self._inject(
-            cache_k, cache_v, jnp.asarray(padded, jnp.int32),
-            make(dk_local), make(dv_local))
+            cache_k, cache_v, jnp.asarray(padded, jnp.int32), dk, dv)
 
 
-def local_block_spec(spec: KVCacheSpec, cache_k: jax.Array) -> tuple[KVCacheSpec, str]:
+def local_block_spec(spec: KVCacheSpec, cache_k) -> tuple[KVCacheSpec, str]:
     """Per-rank tier geometry + shard fingerprint.
 
     The returned spec's ``num_layers``/``num_kv_heads`` are this rank's
     local extents, so tier arenas size to the shard actually stored; the
     fingerprint pins (starts, extents) so a restarted process only reads a
-    disk tier written for the SAME shard of the SAME topology."""
-    starts, stops = local_box(cache_k)
+    disk tier written for the SAME shard of the SAME topology.
+    ``kv_dtype`` carries through the replace, so quantized engines get
+    quantized (packed) shard tiers."""
+    starts, stops = local_box(cache_payload(cache_k))
     local = dataclasses.replace(
         spec,
         num_layers=stops[0] - starts[0],

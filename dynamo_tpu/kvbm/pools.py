@@ -43,8 +43,20 @@ class TierStats:
                 "stores": self.stores, "evictions": self.evictions}
 
 
-def block_shape(spec: KVCacheSpec) -> tuple[int, int, int, int, int]:
+def block_shape(spec: KVCacheSpec) -> tuple[int, ...]:
+    """Host-side shape of one tiered block. Quantized specs store the packed
+    flat layout (int8 payload + f32 scale sidecar — see kvbm.transfer), so
+    their tier footprint really is ``bytes_per_block()``, i.e. ~half bf16."""
+    if spec.quantized:
+        return (spec.bytes_per_block(),)
     return (2, spec.num_layers, spec.block_size, spec.num_kv_heads, spec.head_dim)
+
+
+def block_dtype(spec: KVCacheSpec) -> np.dtype:
+    """Element dtype of the host-side block (uint8 for packed quantized)."""
+    if spec.quantized:
+        return np.dtype(np.uint8)
+    return np.dtype(jnp.dtype(spec.dtype))
 
 
 class HostBlockPool:
@@ -66,7 +78,7 @@ class HostBlockPool:
         self.spec = spec
         self.capacity = capacity_blocks
         self.overflow = overflow
-        self._arena = np.zeros((capacity_blocks, *block_shape(spec)), jnp.dtype(spec.dtype))
+        self._arena = np.zeros((capacity_blocks, *block_shape(spec)), block_dtype(spec))
         self._free: list[int] = list(range(capacity_blocks - 1, -1, -1))
         self._lru: OrderedDict[int, int] = OrderedDict()  # seq_hash -> slot, LRU order
         self.stats = TierStats()
@@ -81,6 +93,9 @@ class HostBlockPool:
         if seq_hash in self._lru:
             self._lru.move_to_end(seq_hash)
             return
+        from dynamo_tpu.kvbm.transfer import ensure_block_format
+
+        block = ensure_block_format(block, self.spec)
         if not self._free:
             victim_hash, victim_slot = self._lru.popitem(last=False)
             self.stats.evictions += 1
@@ -129,7 +144,7 @@ class DiskBlockPool:
         self.path = Path(path)
         self.path.mkdir(parents=True, exist_ok=True)
         self.capacity_bytes = capacity_bytes
-        self._block_bytes = int(np.prod(block_shape(spec))) * jnp.dtype(spec.dtype).itemsize
+        self._block_bytes = int(np.prod(block_shape(spec))) * block_dtype(spec).itemsize
         self._lru: OrderedDict[int, None] = OrderedDict()
         self.stats = TierStats()
         # Sequence hashes cover token content only — a directory written by a
@@ -137,7 +152,7 @@ class DiskBlockPool:
         # served. The MANIFEST records model identity + layout; any mismatch
         # purges the tier.
         manifest = self.path / "MANIFEST"
-        want = f"{fingerprint}|{block_shape(spec)}|{spec.dtype}"
+        want = f"{fingerprint}|{block_shape(spec)}|{spec.dtype}|{spec.kv_dtype}"
         have = manifest.read_text() if manifest.exists() else None
         if have != want:
             if have is not None:
@@ -164,6 +179,9 @@ class DiskBlockPool:
         if seq_hash in self._lru:
             self._lru.move_to_end(seq_hash)
             return
+        from dynamo_tpu.kvbm.transfer import ensure_block_format
+
+        block = ensure_block_format(block, self.spec)
         while (len(self._lru) + 1) * self._block_bytes > self.capacity_bytes and self._lru:
             victim, _ = self._lru.popitem(last=False)
             if self.overflow is not None:
@@ -174,7 +192,7 @@ class DiskBlockPool:
                     raw = np.empty(0, np.uint8)
                 if raw.size == self._block_bytes:
                     self.overflow.put(victim, raw.view(
-                        jnp.dtype(self.spec.dtype)).reshape(block_shape(self.spec)))
+                        block_dtype(self.spec)).reshape(block_shape(self.spec)))
             self._file(victim).unlink(missing_ok=True)
             self.stats.evictions += 1
         np.ascontiguousarray(block).view(np.uint8).tofile(self._file(seq_hash))
@@ -195,4 +213,4 @@ class DiskBlockPool:
             return None
         self._lru.move_to_end(seq_hash)
         self.stats.hits += 1
-        return raw.view(jnp.dtype(self.spec.dtype)).reshape(block_shape(self.spec))
+        return raw.view(block_dtype(self.spec)).reshape(block_shape(self.spec))

@@ -39,7 +39,7 @@ import msgpack
 import numpy as np
 
 from dynamo_tpu.engine.cache import KVCacheSpec
-from dynamo_tpu.kvbm.pools import TierStats, block_shape
+from dynamo_tpu.kvbm.pools import TierStats, block_dtype, block_shape
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("kvbm.remote")
@@ -151,7 +151,7 @@ class RemoteBlockServer:
 
 def tier_namespace(spec: KVCacheSpec, fingerprint: str = "") -> str:
     """Same identity recipe as the disk tier's MANIFEST."""
-    return f"{fingerprint}|{block_shape(spec)}|{spec.dtype}"
+    return f"{fingerprint}|{block_shape(spec)}|{spec.dtype}|{spec.kv_dtype}"
 
 
 class RemoteBlockPool:
@@ -187,7 +187,7 @@ class RemoteBlockPool:
         self._broken_until = 0.0
         self._last_len = 0
         self.stats = TierStats()
-        self._dtype = np.dtype(spec.dtype)
+        self._dtype = block_dtype(spec)
 
     # -- wire -------------------------------------------------------------
     def _connect(self) -> socket.socket:

@@ -10,10 +10,22 @@ Block ids are padded up to power-of-two buckets so the number of distinct
 compiled programs stays bounded (same static-shape discipline as the engine
 step functions).
 
-Host-side block format: one ``np.ndarray`` of shape
-``[2, layers, block_size, kv_heads, head_dim]`` (index 0 = K, 1 = V) —
-the unit stored by the host/disk tiers and shipped across DCN for
-disaggregated prefill→decode handoff (dynamo_tpu.disagg).
+Host-side block formats:
+
+* float caches: one ``np.ndarray`` of shape
+  ``[2, layers, block_size, kv_heads, head_dim]`` (index 0 = K, 1 = V).
+* int8-quantized caches (engine/cache.py ``{"q","s"}`` pytrees): one FLAT
+  ``uint8`` array of ``spec.bytes_per_block()`` bytes — the int8 payload
+  ``[2, L, BS, KH, D]`` followed by the float32 scales ``[2, L, KH]``
+  (``pack_kv_block``/``unpack_kv_block``). Half the host/disk/DCN footprint
+  of the bf16 block.
+
+``inject`` accepts either format against either cache kind and converts at
+the boundary (mixed-precision import: a bf16 snapshot flows into an int8
+engine by on-device requantization, an int8 snapshot into a float engine by
+host-side dequantization). ``extract(dequant=True)`` yields float blocks
+from a quantized cache — the sharded disagg staging path needs the
+box-sliceable 6-d layout (disagg/sharded.py).
 """
 
 from __future__ import annotations
@@ -21,6 +33,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+#: divide-guard for quantization scales (matches models/llama._KV_SCALE_EPS)
+_EPS = 1e-8
 
 
 def _pad_pow2(ids: list[int], cap: int = 256) -> list[int]:
@@ -43,44 +58,185 @@ def _inject(ck, cv, ids, dk, dv):
     return ck.at[:, ids].set(dk), cv.at[:, ids].set(dv)
 
 
+# -- quantized-cache device programs -----------------------------------------
+
+def _extract_q(ck, cv, ids):
+    """Gather payload + scales: ([L,n,BS,KH,D] int8, [L,n,KH] f32) × k,v."""
+    return (ck["q"][:, ids], ck["s"][:, ids],
+            cv["q"][:, ids], cv["s"][:, ids])
+
+
+def _dequant_slice(c, ids):
+    g = c["q"][:, ids].astype(jnp.float32)            # [L, n, BS, KH, D]
+    return g * c["s"][:, ids][:, :, None, :, None]
+
+
+def _extract_deq(ck, cv, ids):
+    return _dequant_slice(ck, ids), _dequant_slice(cv, ids)
+
+
+def _inject_q(ck, cv, ids, kq, ks, vq, vs):
+    return ({"q": ck["q"].at[:, ids].set(kq), "s": ck["s"].at[:, ids].set(ks)},
+            {"q": cv["q"].at[:, ids].set(vq), "s": cv["s"].at[:, ids].set(vs)})
+
+
+def _quantize_lnh(x):
+    """[L, n, BS, KH, D] float → (int8 payload, [L, n, KH] scales):
+    symmetric per-(layer, block, kv-head) abs-max, the same scheme
+    models/llama._scatter_kv_quant commits at write time."""
+    x = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=(2, 4))
+    s = jnp.maximum(amax / 127.0, _EPS)
+    q = jnp.clip(jnp.round(x / s[:, :, None, :, None]), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def _inject_quant(ck, cv, ids, dk, dv):
+    kq, ks = _quantize_lnh(dk)
+    vq, vs = _quantize_lnh(dv)
+    return _inject_q(ck, cv, ids, kq, ks, vq, vs)
+
+
+# -- host-side block (de)packing ---------------------------------------------
+
+def pack_kv_block(kq: np.ndarray, ks: np.ndarray,
+                  vq: np.ndarray, vs: np.ndarray) -> np.ndarray:
+    """(payload [L,BS,KH,D] int8 + scales [L,KH] f32) × k,v → flat uint8."""
+    payload = np.ascontiguousarray(np.stack([kq, vq]).astype(np.int8))
+    scales = np.ascontiguousarray(np.stack([ks, vs]).astype(np.float32))
+    return np.concatenate([payload.reshape(-1).view(np.uint8),
+                           scales.reshape(-1).view(np.uint8)])
+
+
+def unpack_kv_block(flat: np.ndarray,
+                    shape: tuple[int, int, int, int]) -> tuple[np.ndarray, np.ndarray]:
+    """flat uint8 → (payload [2,L,BS,KH,D] int8, scales [2,L,KH] f32)."""
+    L, BS, KH, D = shape
+    split = 2 * L * BS * KH * D
+    payload = flat[:split].view(np.int8).reshape(2, L, BS, KH, D)
+    scales = flat[split:].view(np.float32).reshape(2, L, KH)
+    return payload, scales
+
+
+def quantize_block(block: np.ndarray) -> np.ndarray:
+    """Float host block [2, L, BS, KH, D] → packed flat uint8."""
+    x = np.asarray(block, np.float32)
+    amax = np.abs(x).max(axis=(2, 4))                       # [2, L, KH]
+    s = np.maximum(amax / 127.0, _EPS).astype(np.float32)
+    q = np.clip(np.round(x / s[:, :, None, :, None]), -127, 127).astype(np.int8)
+    return pack_kv_block(q[0], s[0], q[1], s[1])
+
+
+def dequantize_block(flat: np.ndarray, shape: tuple[int, int, int, int],
+                     dtype) -> np.ndarray:
+    """Packed flat uint8 → float host block [2, L, BS, KH, D] of ``dtype``."""
+    payload, scales = unpack_kv_block(flat, shape)
+    out = payload.astype(np.float32) * scales[:, :, None, :, None]
+    return np.ascontiguousarray(out.astype(dtype))
+
+
+def _is_packed(block: np.ndarray) -> bool:
+    return block.ndim == 1 and block.dtype == np.uint8
+
+
+def ensure_block_format(block: np.ndarray, spec) -> np.ndarray:
+    """Convert a host block to ``spec``'s native format (mixed-precision
+    import boundary): packed uint8 for quantized specs, float
+    [2, L, BS, KH, D] of ``spec.dtype`` otherwise. No-op when it already
+    matches."""
+    shape = (spec.num_layers, spec.block_size, spec.num_kv_heads,
+             spec.head_dim)
+    if spec.quantized:
+        return block if _is_packed(block) else quantize_block(block)
+    if _is_packed(block):
+        return dequantize_block(block, shape, jnp.dtype(spec.dtype))
+    return block
+
+
 class BlockTransferEngine:
     """Bucketed, jit-compiled block gather (extract) / scatter (inject)."""
 
     def __init__(self) -> None:
         self._extract = jax.jit(_extract)
         self._inject = jax.jit(_inject, donate_argnums=(0, 1))
+        self._extract_q = jax.jit(_extract_q)
+        self._extract_deq = jax.jit(_extract_deq)
+        self._inject_q = jax.jit(_inject_q, donate_argnums=(0, 1))
+        self._inject_quant = jax.jit(_inject_quant, donate_argnums=(0, 1))
 
-    def extract(self, cache_k: jax.Array, cache_v: jax.Array, ids: list[int]) -> list[np.ndarray]:
-        """Gather blocks off the device; returns one host block per id."""
+    def extract(self, cache_k, cache_v, ids: list[int],
+                dequant: bool = False) -> list[np.ndarray]:
+        """Gather blocks off the device; returns one host block per id.
+        Quantized caches yield packed flat-uint8 blocks unless ``dequant``
+        (then: float blocks, for the box-sliced disagg staging path)."""
         from dynamo_tpu.obs.tracer import get_tracer
 
         n = len(ids)
         with get_tracer().span("kv.transfer", direction="extract",
                                blocks=n):
             padded = jnp.asarray(_pad_pow2(list(ids)), jnp.int32)
-            k, v = self._extract(cache_k, cache_v, padded)
+            if isinstance(cache_k, dict) and not dequant:
+                kq, ks, vq, vs = self._extract_q(cache_k, cache_v, padded)
+                kq, ks = np.asarray(kq), np.asarray(ks)  # [L,n,BS,KH,D]/[L,n,KH]
+                vq, vs = np.asarray(vq), np.asarray(vs)
+                return [pack_kv_block(kq[:, i], ks[:, i], vq[:, i], vs[:, i])
+                        for i in range(n)]
+            if isinstance(cache_k, dict):
+                k, v = self._extract_deq(cache_k, cache_v, padded)
+            else:
+                k, v = self._extract(cache_k, cache_v, padded)
             kv = np.stack([np.asarray(k), np.asarray(v)])  # [2, layers, n_pad, bs, kvh, hd]
             per_block = np.moveaxis(kv, 2, 0)              # [n_pad, 2, layers, bs, kvh, hd]
             return [np.ascontiguousarray(per_block[i]) for i in range(n)]
 
     def inject(
         self,
-        cache_k: jax.Array,
-        cache_v: jax.Array,
+        cache_k,
+        cache_v,
         ids: list[int],
         blocks: list[np.ndarray],
-    ) -> tuple[jax.Array, jax.Array]:
+    ):
         """Scatter host blocks into the device cache (cache args are donated —
-        callers must replace their references with the returned arrays)."""
+        callers must replace their references with the returned arrays).
+        Accepts packed or float blocks against either cache kind; format
+        conversion happens here (mixed-precision import)."""
         from dynamo_tpu.obs.tracer import get_tracer
 
         assert len(ids) == len(blocks) and ids
         with get_tracer().span("kv.transfer", direction="inject",
                                blocks=len(ids)):
+            quant_cache = isinstance(cache_k, dict)
             padded = _pad_pow2(list(ids))
-            data = np.stack(blocks + [blocks[-1]] * (len(padded) - len(blocks)))
+            pad = [blocks[-1]] * (len(padded) - len(blocks))
+            packed = _is_packed(blocks[0])
+            if quant_cache and packed:
+                cq = cache_k["q"]
+                shape = (cq.shape[0], cq.shape[2], cq.shape[3], cq.shape[4])
+                ups = [unpack_kv_block(b, shape) for b in blocks + pad]
+                payload = np.stack([p for p, _ in ups])    # [n,2,L,BS,KH,D]
+                scales = np.stack([s for _, s in ups])     # [n,2,L,KH]
+                return self._inject_q(
+                    cache_k, cache_v, jnp.asarray(padded, jnp.int32),
+                    jnp.asarray(np.moveaxis(payload[:, 0], 0, 1)),
+                    jnp.asarray(np.moveaxis(scales[:, 0], 0, 1)),
+                    jnp.asarray(np.moveaxis(payload[:, 1], 0, 1)),
+                    jnp.asarray(np.moveaxis(scales[:, 1], 0, 1)),
+                )
+            if packed:
+                # int8 snapshot into a float engine: dequantize on host.
+                L, BS, KH, D = (cache_k.shape[0], cache_k.shape[2],
+                                cache_k.shape[3], cache_k.shape[4])
+                blocks = [dequantize_block(b, (L, BS, KH, D), cache_k.dtype)
+                          for b in blocks]
+                pad = [blocks[-1]] * len(pad)
+            data = np.stack(list(blocks) + pad)
             dk = np.moveaxis(data[:, 0], 0, 1)  # [layers, n_pad, bs, kvh, hd]
             dv = np.moveaxis(data[:, 1], 0, 1)
+            if quant_cache:
+                # Float blocks into an int8 engine: requantize on device.
+                return self._inject_quant(
+                    cache_k, cache_v, jnp.asarray(padded, jnp.int32),
+                    jnp.asarray(dk, jnp.float32), jnp.asarray(dv, jnp.float32))
             return self._inject(
                 cache_k, cache_v, jnp.asarray(padded, jnp.int32),
                 jnp.asarray(dk, cache_k.dtype), jnp.asarray(dv, cache_v.dtype),

@@ -71,6 +71,10 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                    help="max proposed tokens per verify step")
     p.add_argument("--quantization", choices=["none", "int8"], default="none",
                    help="weight-only quantization (int8)")
+    p.add_argument("--kv-dtype", choices=["bfloat16", "int8"],
+                   default="bfloat16",
+                   help="paged KV cache storage dtype (int8: in-kernel "
+                        "dequant, ~2x KV capacity)")
     p.add_argument("--decode-window", type=int, default=1,
                    help="decode steps fused per device dispatch (stop checks "
                         "lag by up to window-1 tokens; output is unchanged)")
@@ -112,6 +116,7 @@ def build_local_engine(ns: argparse.Namespace) -> tuple[AsyncJaxEngine, EngineCo
         sp=ns.sp,
         decode_window=ns.decode_window,
         quantization=ns.quantization,
+        kv_dtype=ns.kv_dtype,
         spec_ngram=ns.spec_ngram,
         spec_k=ns.spec_k,
         allow_random_weights=ns.allow_random_weights,

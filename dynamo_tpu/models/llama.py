@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.parallel.mesh import shard_map_compat
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("models.llama")
@@ -154,12 +155,22 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _scatter_kv(cache: jax.Array, new: jax.Array, slot_idx: jax.Array) -> jax.Array:
+#: floor for quantization scales — avoids div-by-zero on all-zero updates
+#: (e.g. trash-block padding writes) while keeping real scales untouched.
+_KV_SCALE_EPS = 1e-8
+
+
+def _scatter_kv(cache, new: jax.Array, slot_idx: jax.Array):
     """Write new KV [B,T,KH,D] into paged cache [NB,BS,KH,D] at flat slots.
 
     slot_idx: [B,T] flat slot index (block*block_size + offset); padding
     tokens point at the trash block (block 0).
+
+    Quantized caches ({"q": int8 [NB,BS,KH,D], "s": f32 [NB,KH]}) quantize
+    at scatter time, symmetric per-block-per-head (engine/cache.py).
     """
+    if isinstance(cache, dict):
+        return _scatter_kv_quant(cache, new, slot_idx)
     nb, bs, kh, d = cache.shape
     flat = cache.reshape(nb * bs, kh, d)
     idx = slot_idx.reshape(-1)
@@ -168,12 +179,66 @@ def _scatter_kv(cache: jax.Array, new: jax.Array, slot_idx: jax.Array) -> jax.Ar
     return flat.reshape(nb, bs, kh, d)
 
 
-def _gather_kv(cache: jax.Array, block_tables: jax.Array) -> jax.Array:
+def _scatter_kv_quant(cache: dict, new: jax.Array, slot_idx: jax.Array) -> dict:
+    """Int8 scatter: abs-max over the block update sets/merges the block's
+    per-head scale, existing rows of touched blocks are rescaled to the new
+    scale, then the new rows are quantized and written.
+
+    A write at block offset 0 marks the block as freshly (re)tenanted and
+    resets its scale — otherwise a recycled block would inherit the previous
+    tenant's (possibly much larger) scale forever. Mid-block writes merge via
+    max so already-committed rows never lose range. Rows past the write
+    frontier hold stale garbage but every reader masks by kv_len.
+    """
+    q, s = cache["q"], cache["s"]
+    nb, bs, kh, d = q.shape
+    idx = slot_idx.reshape(-1)                                   # [N]
+    vals = new.reshape(-1, kh, d).astype(jnp.float32)            # [N,KH,D]
+    blk = jnp.clip(idx // bs, 0, nb - 1)
+    off = idx % bs
+
+    row_amax = jnp.max(jnp.abs(vals), axis=-1)                   # [N,KH]
+    upd_amax = jnp.zeros((nb, kh), jnp.float32).at[blk].max(row_amax)
+    resets = jnp.zeros((nb,), jnp.int32).at[blk].max(
+        (off == 0).astype(jnp.int32)) > 0                        # fresh tenant
+    s_cand = upd_amax / 127.0
+    s_new = jnp.where(resets[:, None], s_cand, jnp.maximum(s, s_cand))
+    s_new = jnp.maximum(s_new, jnp.where(upd_amax > 0, _KV_SCALE_EPS, s_new))
+
+    # Rescale the already-written rows of every touched block. Gathering per
+    # token row (duplicates write identical values) keeps shapes static; cost
+    # is bounded by (tokens-in-update × block_size), not by NB.
+    ratio = jnp.where(s_new > 0, s / jnp.maximum(s_new, _KV_SCALE_EPS), 0.0)
+    old = q[blk].astype(jnp.float32)                             # [N,BS,KH,D]
+    requant = jnp.clip(jnp.round(old * ratio[blk][:, None, :, None]),
+                       -127, 127).astype(jnp.int8)
+    q = q.at[blk].set(requant, mode="drop")
+
+    # Quantize and write the new rows (overwrites the rescaled slots).
+    s_rows = jnp.maximum(s_new[blk], _KV_SCALE_EPS)              # [N,KH]
+    q_rows = jnp.clip(jnp.round(vals / s_rows[:, :, None]), -127, 127)
+    flat = q.reshape(nb * bs, kh, d)
+    flat = flat.at[idx].set(q_rows.astype(jnp.int8), mode="drop")
+    return {"q": flat.reshape(nb, bs, kh, d), "s": s_new}
+
+
+def _gather_kv(cache, block_tables: jax.Array) -> jax.Array:
     """Gather context KV: cache [NB,BS,KH,D], block_tables [B,NBLK] →
-    [B, NBLK*BS, KH, D] laid out in position order."""
+    [B, NBLK*BS, KH, D] laid out in position order. Quantized caches are
+    dequantized on gather (dense fallback path)."""
+    if isinstance(cache, dict):
+        g = cache["q"][block_tables].astype(jnp.float32)  # [B,NBLK,BS,KH,D]
+        g = g * cache["s"][block_tables][:, :, None, :, None]
+        b, nblk, bs, kh, d = g.shape
+        return g.reshape(b, nblk * bs, kh, d)
     g = cache[block_tables]  # [B, NBLK, BS, KH, D]
     b, nblk, bs, kh, d = g.shape
     return g.reshape(b, nblk * bs, kh, d)
+
+
+def _cache_block_size(cache) -> int:
+    """block_size from a per-layer-stacked cache (plain array or {"q","s"})."""
+    return (cache["q"] if isinstance(cache, dict) else cache).shape[2]
 
 
 def paged_attention(
@@ -284,7 +349,7 @@ def forward(
     decode (T=1).
     """
     b, t = token_ids.shape
-    bs = cache_k.shape[2]
+    bs = _cache_block_size(cache_k)
     tp = mesh.shape.get("model", 1) if mesh is not None else 1
     dp = mesh.shape.get("data", 1) if mesh is not None else 1
     sp = mesh.shape.get("seq", 1) if mesh is not None else 1
@@ -442,7 +507,7 @@ def forward_pp(
     if cfg.num_layers % pp != 0:
         raise ValueError(f"num_layers={cfg.num_layers} not divisible by pp={pp}")
     b, t = token_ids.shape
-    bs = cache_k.shape[2]
+    bs = _cache_block_size(cache_k)
     nblk = block_tables.shape[1]
     from jax.sharding import PartitionSpec as P
 
@@ -523,7 +588,7 @@ def forward_pp(
         # Only the last stage accumulated into `out`; the psum replicates it.
         return lax.psum(out, "pipe"), ck_loc, cv_loc
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         pp_fn, mesh=mesh,
         in_specs=(P("pipe"), P("pipe"), P("pipe"), P(), P(), P(), P(), P(), P()),
         out_specs=(P(), P("pipe"), P("pipe")),
@@ -597,12 +662,15 @@ def _forward_pp_sequential(params, cfg, positions, kv_lens, slot, block_tables,
                 cfg, lp_stack, ck_local, cv_local, h, positions, slot,
                 block_tables, kv_lens)
             keep = s == i
-            ck_local = jnp.where(keep, ck_new, ck_local)
-            cv_local = jnp.where(keep, cv_new, cv_local)
+            # tree_map: quantized caches are {"q","s"} pytrees.
+            ck_local = jax.tree.map(lambda a, b: jnp.where(keep, a, b),
+                                    ck_new, ck_local)
+            cv_local = jax.tree.map(lambda a, b: jnp.where(keep, a, b),
+                                    cv_new, cv_local)
             h = lax.psum(jnp.where(keep, h_out, jnp.zeros_like(h_out)), "pipe")
         return h, ck_local, cv_local
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         pp_fn, mesh=mesh,
         in_specs=(P("pipe"), P("pipe"), P("pipe"), P()),
         out_specs=(P(), P("pipe"), P("pipe")),

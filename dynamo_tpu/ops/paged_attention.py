@@ -46,12 +46,26 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
+from dynamo_tpu.parallel.mesh import shard_map_compat
+
 NEG_INF = -1e30
 _SCRATCH_CAP_BYTES = 4 * 2**20  # online-softmax VMEM scratch budget
 
+# jax renamed TPUCompilerParams → CompilerParams; accept both.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
-def _kernel(bt_ref, qs_ref, kl_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-            bs: int, kh: int, rep: int):
+
+def _kernel(*refs, bs: int, kh: int, rep: int, quant: bool):
+    if quant:
+        # Scales ride the scalar-prefetch channel with the block table, so
+        # dequant needs no extra DMA: the int8 block is widened in-register
+        # and the per-(block, head) scale folds into the MXU results.
+        (bt_ref, qs_ref, kl_ref, ks_ref, vs_ref,
+         q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        (bt_ref, qs_ref, kl_ref,
+         q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref) = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     qi = pl.program_id(1)
     j = pl.program_id(2)
@@ -82,6 +96,10 @@ def _kernel(bt_ref, qs_ref, kl_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, 
             scores = lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             )                                                         # [R, BS]
+            if quant:
+                # Symmetric per-(block, head) scale: constant over the
+                # contraction, so scaling the int8 matmul result is exact.
+                scores = scores * ks_ref[bt_ref[b, j], ki]
             scores = jnp.where(visible, scores, NEG_INF)
 
             m_prev = m_ref[ki, :, :1]                                 # [R, 1]
@@ -95,6 +113,8 @@ def _kernel(bt_ref, qs_ref, kl_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, 
             pv = lax.dot_general(
                 p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
             )                                                         # [R, D]
+            if quant:
+                pv = pv * vs_ref[bt_ref[b, j], ki]
             acc_ref[ki] = acc_ref[ki] * alpha + pv
             m_ref[ki] = jnp.broadcast_to(m_new, m_ref.shape[1:])
             l_ref[ki] = jnp.broadcast_to(l_new, l_ref.shape[1:])
@@ -109,15 +129,26 @@ def _kernel(bt_ref, qs_ref, kl_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, 
 
 def paged_attention_kernel(
     q: jax.Array,             # [B, T, H, D]
-    k_cache: jax.Array,       # [NB, BS, KH, D]
-    v_cache: jax.Array,
+    k_cache,                  # [NB, BS, KH, D] — or {"q": int8, "s": f32 [NB, KH]}
+    v_cache,
     block_tables: jax.Array,  # [B, NBLK] int32
     q_start: jax.Array,       # [B] int32 first query position
     kv_lens: jax.Array,       # [B] int32 valid context length
     *,
     interpret: bool = False,
 ) -> jax.Array:
-    """Flash paged attention over a block-table cache. Returns [B, T, H, D]."""
+    """Flash paged attention over a block-table cache. Returns [B, T, H, D].
+
+    Quantized caches (``{"q", "s"}`` — engine/cache.py) DMA int8 blocks
+    (half the HBM bytes of bf16) and fold the per-(block, kv-head) dequant
+    scale into the per-block MXU matmuls; no widened KV tensor ever exists
+    in HBM.
+    """
+    quant = isinstance(k_cache, dict)
+    if quant:
+        k_scale = k_cache["s"].astype(jnp.float32)   # [NB, KH]
+        v_scale = v_cache["s"].astype(jnp.float32)
+        k_cache, v_cache = k_cache["q"], v_cache["q"]
     b, t, h, d = q.shape
     nb, bs, kh, _ = k_cache.shape
     nblk = block_tables.shape[1]
@@ -137,15 +168,27 @@ def paged_attention_kernel(
         rchunk //= 2
     nq = r // rchunk
 
+    if quant:
+        # Index maps see all scalar-prefetch refs after the grid indices.
+        qmap = lambda bi, qi, j, bt, qp, kl, ks, vs: (bi, 0, qi, 0)      # noqa: E731
+        kvmap = lambda bi, qi, j, bt, qp, kl, ks, vs: (bt[bi, j], 0, 0, 0)  # noqa: E731
+        scalars = (block_tables.astype(jnp.int32), q_start.astype(jnp.int32),
+                   kv_lens.astype(jnp.int32), k_scale, v_scale)
+    else:
+        qmap = lambda bi, qi, j, bt, qp, kl: (bi, 0, qi, 0)              # noqa: E731
+        kvmap = lambda bi, qi, j, bt, qp, kl: (bt[bi, j], 0, 0, 0)       # noqa: E731
+        scalars = (block_tables.astype(jnp.int32), q_start.astype(jnp.int32),
+                   kv_lens.astype(jnp.int32))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,  # block_tables, q_start, kv_lens
+        num_scalar_prefetch=len(scalars),  # block_tables, q_start, kv_lens[, scales]
         grid=(b, nq, nblk),
         in_specs=[
-            pl.BlockSpec((1, kh, rchunk, d), lambda bi, qi, j, bt, qp, kl: (bi, 0, qi, 0)),
-            pl.BlockSpec((1, bs, kh, d), lambda bi, qi, j, bt, qp, kl: (bt[bi, j], 0, 0, 0)),
-            pl.BlockSpec((1, bs, kh, d), lambda bi, qi, j, bt, qp, kl: (bt[bi, j], 0, 0, 0)),
+            pl.BlockSpec((1, kh, rchunk, d), qmap),
+            pl.BlockSpec((1, bs, kh, d), kvmap),
+            pl.BlockSpec((1, bs, kh, d), kvmap),
         ],
-        out_specs=pl.BlockSpec((1, kh, rchunk, d), lambda bi, qi, j, bt, qp, kl: (bi, 0, qi, 0)),
+        out_specs=pl.BlockSpec((1, kh, rchunk, d), qmap),
         scratch_shapes=[
             pltpu.VMEM((kh, rchunk, d), jnp.float32),
             pltpu.VMEM((kh, rchunk, 128), jnp.float32),
@@ -153,15 +196,14 @@ def paged_attention_kernel(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, bs=bs, kh=kh, rep=rep),
+        functools.partial(_kernel, bs=bs, kh=kh, rep=rep, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kh, t * rep, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), q_start.astype(jnp.int32), kv_lens.astype(jnp.int32),
-      qs, k_cache, v_cache)
+    )(*scalars, qs, k_cache, v_cache)
     # [B, KH, T*REP, D] → [B, T, H, D]
     return out.reshape(b, kh, t, rep, d).transpose(0, 2, 1, 3, 4).reshape(b, t, h, d)
 
@@ -169,8 +211,8 @@ def paged_attention_kernel(
 def paged_attention_sharded(
     mesh,
     q: jax.Array,             # [B, T, H, D] — H sharded on "model"
-    k_cache: jax.Array,       # [NB, BS, KH, D] — KH sharded on "model"
-    v_cache: jax.Array,
+    k_cache,                  # [NB, BS, KH, D] (KH on "model") or {"q","s"}
+    v_cache,
     block_tables: jax.Array,  # [B, NBLK]
     q_start: jax.Array,       # [B]
     kv_lens: jax.Array,       # [B]
@@ -184,13 +226,18 @@ def paged_attention_sharded(
 
     Batch rides the "data" axis (size-1 no-op on pure-TP meshes).
     """
-    fn = jax.shard_map(
+    cache_spec = P(None, None, "model", None)
+    if isinstance(k_cache, dict):
+        # Quantized cache pytree: payload sharded on kv_heads, scales on
+        # their matching head axis — each shard dequantizes its own heads.
+        cache_spec = {"q": P(None, None, "model", None), "s": P(None, "model")}
+    fn = shard_map_compat(
         functools.partial(paged_attention_kernel, interpret=interpret),
         mesh=mesh,
         in_specs=(
             P("data", None, "model", None),
-            P(None, None, "model", None),
-            P(None, None, "model", None),
+            cache_spec,
+            cache_spec,
             P("data", None),
             P("data"),
             P("data"),

@@ -85,6 +85,26 @@ def kv_cache_spec() -> P:
     return P("pipe", None, None, "model", None)
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax versions: older releases only ship
+    ``jax.experimental.shard_map.shard_map`` and spell the replication-check
+    knob ``check_rep`` instead of ``check_vma``."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
+def kv_scale_spec() -> P:
+    """Per-(layer, block, kv_head) dequant scales [layers, blocks, kv_heads]
+    for the int8 KV cache — sharded exactly like the payload's corresponding
+    axes so scale lookups stay local to the shard that owns the heads."""
+    return P("pipe", None, "model")
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

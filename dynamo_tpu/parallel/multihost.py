@@ -373,7 +373,7 @@ def follower_loop(core_factory: Callable[[dict], Any], sock: socket.socket) -> N
 # other.
 _HELLO_FIELDS = (
     "model", "dtype", "attn_impl", "allow_random_weights", "quantization",
-    "num_blocks", "block_size",
+    "kv_dtype", "num_blocks", "block_size",
     "max_batch_size", "max_model_len", "prefill_chunk", "max_tokens_per_step",
     "decode_bucket", "decode_window", "seed", "enable_prefix_caching",
     "dp", "pp", "tp", "ep", "sp", "pp_microbatches",

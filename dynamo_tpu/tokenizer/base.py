@@ -15,8 +15,13 @@ reference's zero-GPU test path (reference: lib/llm/src/gguf.rs).
 from __future__ import annotations
 
 import os
+import re
 from pathlib import Path
 from typing import Protocol, Sequence
+
+# SentencePiece byte-fallback pieces: literal "<0xHH>" vocab entries that
+# stand for one raw byte (llama-family vocabs keep 256 of them).
+_SP_BYTE_RE = re.compile(r"^<0x([0-9A-Fa-f]{2})>$")
 
 
 class BaseTokenizer(Protocol):
@@ -181,9 +186,12 @@ def guided_vocab(tok, size: int | None = None) -> list[str]:
     Built from the tokenizer's own vocab in one pass instead of V per-id
     ``decode([i])`` round-trips: byte-level BPE pieces are mapped through
     the GPT-2 byte decoder (exact text, leading-space markers included),
-    sentencepiece pieces get their ▁ marker substituted, and special tokens
-    decode to "" so the masker never trial-feeds control markup. ``size``
-    pads/truncates to the MODEL vocab (sharding may round it up)."""
+    sentencepiece pieces get their ▁ marker substituted (including the
+    ``<0xHH>`` byte-fallback pieces: ASCII bytes become their character,
+    non-ASCII bytes — partial UTF-8 sequences — stay "" so the masker never
+    matches half a codepoint), and special tokens decode to "" so the
+    masker never trial-feeds control markup. ``size`` pads/truncates to the
+    MODEL vocab (sharding may round it up)."""
     if isinstance(tok, ByteTokenizer):
         v = size or tok.vocab_size
         pieces = [""] * v
@@ -194,11 +202,34 @@ def guided_vocab(tok, size: int | None = None) -> list[str]:
     if inner is not None and hasattr(inner, "get_vocab"):
         vocab = inner.get_vocab()
         v = size or max(len(inner), max(vocab.values(), default=-1) + 1)
+        # get_vocab() can miss ids (added tokens, holes); backfill the gaps
+        # from convert_ids_to_tokens so those ids aren't silently "" =
+        # always-allowed for every grammar.
+        have = {idx for idx in vocab.values() if 0 <= idx < v}
+        conv = getattr(inner, "convert_ids_to_tokens", None)
+        if conv is not None and len(have) < v:
+            for idx in range(v):
+                if idx in have:
+                    continue
+                try:
+                    piece = conv(idx)
+                except (IndexError, KeyError, ValueError, OverflowError):
+                    continue
+                if isinstance(piece, str) and piece:
+                    vocab.setdefault(piece, idx)
         pieces = [""] * v
         dec = _byte_decoder()
         special = set(getattr(inner, "all_special_ids", None) or [])
         for piece, idx in vocab.items():
             if not (0 <= idx < v) or idx in special:
+                continue
+            m = _SP_BYTE_RE.match(piece)
+            if m is not None:
+                b = int(m.group(1), 16)
+                # A lone non-ASCII byte is a UTF-8 fragment — no text a
+                # grammar could match; leave it disallowed rather than
+                # emitting U+FFFD into every charset check.
+                pieces[idx] = chr(b) if b < 0x80 else ""
                 continue
             if all(ch in dec for ch in piece):
                 pieces[idx] = bytes(dec[ch] for ch in piece).decode(

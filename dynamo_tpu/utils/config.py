@@ -111,6 +111,13 @@ class EngineConfig:
     # compute on the MXU) — the roofline-doubling lever for the
     # bandwidth-bound decode metric.
     quantization: str = "none"
+    # KV-cache storage dtype (engine/cache.py): "bfloat16" (store at model
+    # precision — the default) | "int8" (symmetric per-block-per-kv-head
+    # quantization: payload + f32 scale sidecar). int8 halves the paged
+    # cache's bytes_per_block, so auto-sizing fits ~2x the blocks in the
+    # same HBM budget and decode's KV reads move half the bytes; dequant
+    # folds into the paged-attention kernel's per-block matmuls.
+    kv_dtype: str = "bfloat16"
     enable_prefix_caching: bool = True
     kv_event_publishing: bool = True
     # KVBM tiers (reference: lib/llm/src/block_manager.rs CacheLevel):

@@ -253,3 +253,48 @@ def test_response_format_preprocessor_mapping():
     got = pre._sampling(req({"type": "json_schema",
                              "json_schema": {"name": "x", "schema": sch}}))
     assert got.guided_json == sch
+
+
+class _SpVocabStub:
+    """Minimal HF-tokenizer shape: sentencepiece-style vocab with byte-
+    fallback pieces plus an added token that get_vocab() omits."""
+
+    all_special_ids = [0]
+
+    def __init__(self):
+        self._vocab = {
+            "<s>": 0,          # special → must stay ""
+            "▁hello": 1,
+            "<0x41>": 2,       # ASCII byte-fallback → "A"
+            "<0xE2>": 3,       # non-ASCII UTF-8 fragment → disallowed ""
+            "world": 4,
+        }                       # id 5 intentionally missing (added token)
+
+    def get_vocab(self):
+        return dict(self._vocab)
+
+    def __len__(self):
+        return 6
+
+    def convert_ids_to_tokens(self, idx):
+        if idx == 5:
+            return "▁added"
+        inv = {v: k for k, v in self._vocab.items()}
+        if idx not in inv:
+            raise IndexError(idx)
+        return inv[idx]
+
+
+def test_guided_vocab_sentencepiece_byte_fallback():
+    from dynamo_tpu.tokenizer.base import guided_vocab
+
+    class Wrap:
+        _tok = _SpVocabStub()
+
+    pieces = guided_vocab(Wrap())
+    assert pieces[0] == ""          # special token never matchable
+    assert pieces[1] == " hello"    # ▁ marker → leading space
+    assert pieces[2] == "A"         # <0x41> byte-fallback → its character
+    assert pieces[3] == ""          # lone non-ASCII byte stays disallowed
+    assert pieces[4] == "world"
+    assert pieces[5] == " added"    # backfilled via convert_ids_to_tokens

@@ -28,6 +28,21 @@ from pathlib import Path
 METHODS = {"counter": 1, "gauge": 1, "histogram": 1, "func_gauge": 2}
 NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
+# Status-provider exports: runtime/status.py exposes every numeric leaf of a
+# provider's snapshot dict as ``dynamo_<provider>_<key>`` — names that never
+# pass through .counter()/.gauge() and so are invisible to the AST walk
+# above. This is the declared surface (the engine's EngineMetrics.snapshot
+# keys); the same naming rule applies so dashboards can grep one prefix.
+PROVIDER_METRICS = {
+    "engine": (
+        "kv_cache_bytes", "kv_quant_enabled",
+        "num_waiting", "num_running", "kv_usage", "kv_total_blocks",
+        "num_steps", "prefill_tokens", "decode_tokens",
+        "requests_finished", "preemptions", "prefix_hit_rate",
+        "spec_proposed", "spec_accepted", "deadline_cancelled",
+    ),
+}
+
 
 def _const_str(node: ast.expr | None) -> str | None:
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
@@ -92,6 +107,51 @@ def _lint_module(path: Path, problems: list[str]) -> None:
             _check_call(node, aliases[fn.id], path, problems)
 
 
+def _snapshot_keys(path: Path) -> set[str] | None:
+    """Constant keys of EngineMetrics.snapshot's returned dict (None if the
+    module/shape isn't found — e.g. linting a partial tree in tests)."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError):
+        return None
+    for cls in ast.walk(tree):
+        if not (isinstance(cls, ast.ClassDef) and cls.name == "EngineMetrics"):
+            continue
+        for fn in cls.body:
+            if not (isinstance(fn, ast.FunctionDef) and fn.name == "snapshot"):
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and isinstance(node.value, ast.Dict):
+                    return {k.value for k in node.value.keys
+                            if isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)}
+    return None
+
+
+def _lint_provider_metrics(root: Path, problems: list[str]) -> None:
+    """The status-provider surface: names must be Prometheus-valid under the
+    dynamo_ prefix, and the declared engine list must match what
+    EngineMetrics.snapshot actually returns (no silent drift either way)."""
+    for provider, keys in PROVIDER_METRICS.items():
+        for key in keys:
+            if not NAME_RE.match(f"{provider}_{key}"):
+                problems.append(
+                    f"PROVIDER_METRICS: {provider}/{key} does not match "
+                    f"[a-z][a-z0-9_]* (exposed as dynamo_{provider}_{key})")
+    actual = _snapshot_keys(root / "engine" / "engine.py")
+    if actual is None:
+        return
+    declared = set(PROVIDER_METRICS.get("engine", ()))
+    for key in sorted(actual - declared):
+        problems.append(
+            f"EngineMetrics.snapshot exports {key!r} but it is missing from "
+            "tools/lint_metrics.py PROVIDER_METRICS['engine']")
+    for key in sorted(declared - actual):
+        problems.append(
+            f"PROVIDER_METRICS['engine'] declares {key!r} but "
+            "EngineMetrics.snapshot does not export it")
+
+
 def lint_tree(root: Path | None = None) -> list[str]:
     """Lint every ``dynamo_tpu`` module under ``root``; return problems."""
     if root is None:
@@ -101,6 +161,7 @@ def lint_tree(root: Path | None = None) -> list[str]:
         if "tests" in path.parts:
             continue
         _lint_module(path, problems)
+    _lint_provider_metrics(root, problems)
     return problems
 
 
