@@ -22,7 +22,11 @@ failure the JSON line is emitted well before a driver-side timeout could
 rc-124 us with nothing on stdout. A bench that cannot reach a device exits
 NONZERO with the error in the JSON — it never reports value 0 with rc 0,
 and a null value ALWAYS carries an ``error`` (plus a ``probe_log`` tail of
-the child's stderr when one exists).
+the child's stderr when one exists). When every device-probe attempt fails,
+the parent runs one last reduced-size ``JAX_PLATFORMS=cpu`` child and
+reports ITS number under the original metric name, marked
+``fallback: "cpu_probe"`` with the probe error attached — a liveness
+datapoint beats ``value: null``, and the marker keeps it honest.
 
 One persistent child does both probe and bench: it prints a
 ``DYN_BENCH_PROBE_OK <platform> <kind>`` marker the moment jax can see a
@@ -117,10 +121,14 @@ def fail(stage: str, error: str, probe_log: str = "") -> None:
 PROBE_MARKER = "DYN_BENCH_PROBE_OK"
 
 
-def _spawn_child(budget: float):
+def _spawn_child(budget: float, extra_env: dict | None = None):
     """Start the probe+bench child; reader threads collect its output and
     flip ``marker`` the moment the device-ready line appears."""
-    env = dict(os.environ, **_platform_env(), _DYN_BENCH_CHILD="1")
+    env = dict(os.environ)
+    env.update(_platform_env())
+    if extra_env:
+        env.update(extra_env)
+    env["_DYN_BENCH_CHILD"] = "1"
     # Child-side deadline sits inside the parent's kill timeout so the child
     # exits cleanly (emitting its JSON) before the parent would SIGKILL it —
     # killing a process mid-TPU-dispatch can wedge the device tunnel.
@@ -161,6 +169,60 @@ def _reap(proc, state) -> str:
     for t in state["threads"]:
         t.join(timeout=5)
     return "".join(state["err"])
+
+
+def _cpu_fallback(probe_error: str, probe_log: str) -> None:
+    """Device probe exhausted its attempts: run a reduced-size CPU bench so
+    the JSON line carries a real number instead of ``value: null``. The
+    result keeps the ORIGINAL metric name (dashboards key on it) but is
+    explicitly marked ``fallback: "cpu_probe"`` and carries the probe error,
+    so the CPU number can never masquerade as a chip result."""
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "DYN_BENCH_PLATFORM": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",  # the wedged tunnel is WHY we're here
+    }
+    # Reduced sizes unless the operator pinned them: the fallback is a
+    # smoke-level liveness number, not a CPU throughput study.
+    for var, small in (("DYN_BENCH_BATCH", "4"), ("DYN_BENCH_PROMPT", "32"),
+                       ("DYN_BENCH_DECODE", "16"), ("DYN_BENCH_WINDOW", "1")):
+        if var not in os.environ:
+            env[var] = small
+    # Floor of 150s even when the probe retries ate the deadline: a fallback
+    # child SIGKILLed mid-compile would leave exactly the null this path
+    # exists to avoid, and CPU compile of the reduced config fits in it.
+    budget = max(remaining() - 10.0, 150.0)
+    proc, state = _spawn_child(budget, extra_env=env)
+    try:
+        proc.wait(timeout=budget)
+    except subprocess.TimeoutExpired:
+        stderr_text = _reap(proc, state)
+        fail("device_probe", probe_error + "; cpu fallback bench hung",
+             probe_log or stderr_text)
+        return
+    stderr_text = _reap(proc, state)
+    sys.stderr.write(stderr_text[-4000:])
+    line = next((ln for ln in state["out"] if ln.startswith("{")), None)
+    if line is None:
+        fail("device_probe",
+             probe_error
+             + f"; cpu fallback exited rc={proc.returncode} with no JSON",
+             probe_log or stderr_text)
+        return
+    try:
+        out = json.loads(line)
+    except json.JSONDecodeError:
+        fail("device_probe", probe_error + "; cpu fallback emitted bad JSON",
+             probe_log or stderr_text)
+        return
+    out["fallback_metric"] = out.get("metric")  # reduced-size child's name
+    out["metric"] = METRIC
+    out["fallback"] = "cpu_probe"
+    out["probe_error"] = probe_error.strip()[-2000:]
+    if probe_log.strip():
+        out["probe_log"] = probe_log.strip()[-2000:]
+    print(json.dumps(out))
+    sys.exit(proc.returncode)
 
 
 def run_bench(deadline_at: float) -> dict:
@@ -334,9 +396,9 @@ def main() -> None:
         sys.stdout.write("".join(
             ln for ln in out_lines if not ln.startswith(PROBE_MARKER)))
         sys.exit(proc.returncode)
-    fail("device_probe",
-         f"device probe failed after {attempts} attempt(s); last: {last}",
-         probe_log)
+    _cpu_fallback(
+        f"device probe failed after {attempts} attempt(s); last: {last}",
+        probe_log)
 
 
 if __name__ == "__main__":

@@ -92,6 +92,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "round-robin when --prefill-endpoint points at a "
                         "standalone dynamo_tpu.components.router, which is "
                         "KV-aware itself")
+    p.add_argument("--no-kv-stream", action="store_true",
+                   help="disable chunk-streamed KV handoff on a prefill "
+                        "worker (fall back to one staged transfer at end "
+                        "of prefill)")
+    p.add_argument("--kv-transfer-ttl", type=float, default=60.0,
+                   help="seconds a KV transfer may sit without progress "
+                        "(registration, wave, or pull) before its pins are "
+                        "released")
     p.add_argument("--min-prefill-blocks", type=int, default=2,
                    help="decode mode: prompt blocks below which prefill stays local")
     # Multi-host engine (reference: lib/llm/src/engines.rs:29-44 MultiNodeConfig).
@@ -286,6 +294,10 @@ async def amain(ns: argparse.Namespace) -> None:
         raise SystemExit("--disagg requires --engine jax (KV handoff needs a real cache)")
 
     kv_source = None
+    if ns.disagg != "none":
+        from dynamo_tpu.disagg.metrics import install_kv_metrics
+
+        install_kv_metrics(rt.metrics)
     if ns.disagg == "prefill":
         from dynamo_tpu.disagg.handlers import PrefillHandler
         from dynamo_tpu.disagg.source import KvTransferSource
@@ -294,10 +306,12 @@ async def amain(ns: argparse.Namespace) -> None:
         # source — plus every follower rank's (ready-ack addresses); a
         # decode engine of any topology pulls its own box slices from them.
         kv_source = KvTransferSource(
-            engine, advertise_host=rt.advertise_address.rsplit(":", 1)[0],
+            engine, ttl_s=ns.kv_transfer_ttl,
+            advertise_host=rt.advertise_address.rsplit(":", 1)[0],
             extra_shards=follower_shards)
         kv_source.start()
-        prefill = PrefillHandler(engine, kv_source, block_size=ns.block_size)
+        prefill = PrefillHandler(engine, kv_source, block_size=ns.block_size,
+                                 stream=not ns.no_kv_stream)
         handler = prefill.generate
     elif ns.disagg == "decode":
         from dynamo_tpu.disagg.handlers import DisaggDecodeHandler

@@ -6,14 +6,24 @@ calls the prefill pool, then generates locally with the handed-off KV; the
 prefill worker generates exactly one token and returns transfer metadata.
 The TRT-LLM PREFILL_FIRST strategy routes through prefill first — here we
 implement the decode-first (vLLM) pattern.
+
+The streamed handoff (default) turns the prefill response into an event
+stream: one announce event up front (transfer id + shard endpoints + full
+expected hash chain), one availability event per staged wave while the
+prefill is still computing, then the final message with the voted
+``kv_transfer_params``. A decode worker that understands the events pulls
+waves as they land (StreamedKvConsumer); one that doesn't can ignore them
+and use the final params exactly as before — single-wave transfers are
+byte-identical to the legacy staged pull either way.
 """
 
 from __future__ import annotations
 
+import asyncio
 import copy
 from typing import Any, AsyncIterator, Callable
 
-from dynamo_tpu.disagg.receiver import pull_and_import
+from dynamo_tpu.disagg.receiver import StreamedKvConsumer, pull_and_import
 from dynamo_tpu.disagg.source import KvTransferSource
 from dynamo_tpu.engine.engine import AsyncJaxEngine
 from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
@@ -25,36 +35,105 @@ log = get_logger("disagg")
 
 class PrefillHandler:
     """Wraps an engine as a prefill-only worker: compute prompt KV, discard
-    the sampled token, pin + advertise the blocks for pulling."""
+    the sampled token, pin + advertise the blocks for pulling — streaming
+    wave availability to the caller while the prefill is still running."""
 
     def __init__(self, engine: AsyncJaxEngine, source: KvTransferSource,
-                 block_size: int):
+                 block_size: int, stream: bool = True):
         self.engine = engine
         self.source = source
         self.block_size = block_size
+        self.stream = stream
 
     async def generate(self, payload: dict, ctx) -> AsyncIterator[dict]:
         req = PreprocessedRequest.from_dict(payload)
         # Prefill-only: one step past the prompt, sampling result discarded
         # (the decode side samples its own first token from the handed-off KV).
         req.stop_conditions = StopConditions(max_tokens=1, ignore_eos=True)
-        async for out in self.engine.generate(req):
-            if ctx.is_cancelled():
-                return
-            if out.finish_reason is not None and out.error:
-                yield out.to_dict()
-                return
         # The decode scheduler can match at most (prompt_len-1)//block_size
         # blocks (it must recompute ≥1 token for last-position state), so a
         # final exactly-full block would be transferred but never matched —
         # don't ship it.
         cap = (len(req.token_ids) - 1) // self.block_size
         hashes = compute_block_hashes_for_tokens(req.token_ids, self.block_size)[:cap]
+        if self.stream and hashes:
+            async for out in self._generate_streamed(req, hashes, ctx):
+                yield out
+            return
+        async for out in self.engine.generate(req):
+            if ctx.is_cancelled():
+                return
+            if out.finish_reason is not None and out.error:
+                yield out.to_dict()
+                return
         params = await self.source.register(hashes)
         result: dict[str, Any] = {"token_ids": [], "finish_reason": "stop"}
         if params is not None:
             result["kv_transfer_params"] = params
         yield result
+
+    async def _generate_streamed(self, req: PreprocessedRequest,
+                                 hashes: list[int],
+                                 ctx) -> AsyncIterator[dict]:
+        """Register the transfer up front, run the prefill concurrently, and
+        relay wave availability events as they land. The engine pump and
+        the wave listener share one queue so a single await drives both."""
+        events: asyncio.Queue = asyncio.Queue()
+        reg = await self.source.register_streaming(req.request_id, hashes,
+                                                   events)
+        xid = reg["xfer_id"]
+
+        async def pump() -> None:
+            try:
+                async for out in self.engine.generate(req):
+                    if out.finish_reason is not None and out.error:
+                        await events.put(("error", out))
+                        return
+            finally:
+                await events.put(("done", None))
+
+        task = asyncio.create_task(pump())
+        announced = 0
+        error_out = None
+        handed_off = False
+        try:
+            yield {"kv_transfer_stream": {
+                "xfer_id": xid, "shards": reg["shards"],
+                "block_hashes": hashes, "ready": 0}}
+            while True:
+                kind, val = await events.get()
+                if ctx.is_cancelled():
+                    return
+                if kind == "wave":
+                    val = min(int(val), len(hashes))
+                    if val > announced:
+                        announced = val
+                        yield {"kv_transfer_stream": {"xfer_id": xid,
+                                                      "ready": val}}
+                elif kind == "error":
+                    error_out = val
+                elif kind == "done":
+                    break
+            if error_out is not None:
+                yield error_out.to_dict()
+                return
+            # The final wave may have been staged without its event being
+            # consumed yet — the voted covered count is authoritative.
+            covered = await self.source.finish_streaming(xid)
+            handed_off = True  # TTL owns the transfer from here
+            result: dict[str, Any] = {"token_ids": [], "finish_reason": "stop"}
+            if covered:
+                result["kv_transfer_params"] = {
+                    "xfer_id": xid, "block_hashes": hashes[:covered],
+                    "shards": reg["shards"], "streamed": True}
+            yield result
+        finally:
+            task.cancel()
+            if not handed_off:
+                # Cancelled, errored, or the caller dropped the stream:
+                # release pins for shipped and not-yet-staged waves alike.
+                asyncio.get_running_loop().create_task(
+                    self.source.abort_streaming(xid))
 
 
 class DisaggDecodeHandler:
@@ -86,10 +165,36 @@ class DisaggDecodeHandler:
         pre = copy.deepcopy(req)
         pre.request_id = f"{req.request_id}-prefill"
         pre.annotations["disagg"] = "prefill"
+        consumer: StreamedKvConsumer | None = None
         params = None
-        async for out in self.prefill_call(pre.to_dict(), pre.request_id):
-            if isinstance(out, dict) and out.get("kv_transfer_params"):
-                params = out["kv_transfer_params"]
+        try:
+            async for out in self.prefill_call(pre.to_dict(), pre.request_id):
+                if not isinstance(out, dict):
+                    continue
+                ev = out.get("kv_transfer_stream")
+                if ev is not None:
+                    if consumer is None and ev.get("shards"):
+                        consumer = StreamedKvConsumer(self.engine, ev)
+                    elif consumer is not None and ev.get("ready"):
+                        await consumer.advance(int(ev["ready"]))
+                if out.get("kv_transfer_params"):
+                    params = out["kv_transfer_params"]
+        except Exception:
+            if consumer is not None:
+                await consumer.abort()
+            raise
+        if consumer is not None:
+            try:
+                n = await consumer.finish(params)
+            except Exception:
+                await consumer.abort()
+                raise
+            if n == 0 and params is None:
+                # The prefill stream ended without handing anything off
+                # (e.g. its engine errored before the first wave).
+                raise RuntimeError(
+                    "prefill worker returned no kv_transfer_params")
+            return
         if params is None:
             raise RuntimeError("prefill worker returned no kv_transfer_params")
         await pull_and_import(self.engine, params)

@@ -13,16 +13,21 @@ module adds is the *data* path between two engines whose meshes may differ
 (the flagship recipe hands tp16-prefill KV to tp32-decode):
 
 - ``StagingStore``  — host-memory staging of each rank's LOCAL cache shard
-  of the pinned blocks, keyed by transfer id. Staged at register time (one
-  replayed ``kv_stage`` op), so serving a pull never touches device state.
+  of the pinned blocks, keyed by transfer id. Entries may be filled in one
+  shot (the legacy ``kv_stage`` op) or grow wave-by-wave while the prefill
+  is still running (``begin``/``append``/``finalize`` driven by the
+  per-chunk ``kv_stage_wave`` ops); ``wait_for`` gives serve threads a
+  consistent snapshot of whatever prefix is staged so far.
 - ``ShardServer``   — a per-rank daemon thread serving box-sliced reads of
   staged shards over the framed sync-socket protocol multihost.py already
-  uses. Every prefill rank (leader AND followers) runs one.
-- ``fetch_box``     — the decode-rank side: dial every prefill shard whose
-  (layer, head) box intersects mine, pull exactly the intersecting slices,
-  and assemble my local per-block contribution. Rank-to-rank, no central
-  hop — the same locality NIXL's GPU↔GPU transfers have, ridden over
-  DCN-facing TCP instead.
+  uses. Every prefill rank (leader AND followers) runs one. A connection
+  may issue many requests (one per wave); a mid-stream client disconnect
+  closes only that connection, never the staged transfer.
+- ``ShardClient``   — the decode-rank side: a persistent per-shard
+  connection with bounded reconnect/retry, pulling exactly the
+  intersecting slices of the waves that are ready. Rank-to-rank, no
+  central hop — the same locality NIXL's GPU↔GPU transfers have, ridden
+  over DCN-facing TCP instead.
 
 Boxes are global (layer_start, layer_end, head_start, head_end) extents;
 the shard geometry comes from ``kvbm.distributed.local_box``. A
@@ -33,6 +38,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -57,7 +63,13 @@ def box_intersection(a: Box, b: Box) -> Box | None:
 class Staged:
     """One rank's staged shard of a transfer: data[n, 2, L_loc, bs, H_loc, hd]
     covering ``box`` of the global (layer, head) space, for ``hashes`` (with
-    ``parents`` the chain links import needs)."""
+    ``parents`` the chain links import needs).
+
+    A streamed transfer declares the full expected chain up front (``begin``)
+    and grows ``n_ready`` as waves land; only rows below ``n_ready`` are
+    published (append never touches them again), so serve threads may read
+    them without copying. ``ready`` stays the legacy completion event: set
+    once the transfer is complete (or dropped)."""
 
     ready: threading.Event = field(default_factory=threading.Event)
     hashes: list[int] = field(default_factory=list)
@@ -65,15 +77,20 @@ class Staged:
     data: np.ndarray | None = None
     box: Box = (0, 0, 0, 0)
     dtype: str = "bfloat16"
+    n_ready: int = 0
+    complete: bool = False
+    dropped: bool = False
 
 
 class StagingStore:
     """Thread-safe xfer_id → Staged. Entries may be created by an early
-    pull (placeholder, unset event) or by the stage op (fills + sets)."""
+    pull (placeholder), by the one-shot stage op (``fill``), or by a
+    streamed transfer (``begin`` + per-wave ``append`` + ``finalize``)."""
 
     def __init__(self) -> None:
         self._entries: dict[str, Staged] = {}
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
 
     def get_or_create(self, xfer_id: str) -> Staged:
         with self._lock:
@@ -82,33 +99,114 @@ class StagingStore:
                 entry = self._entries[xfer_id] = Staged()
             return entry
 
+    # -- streamed path -------------------------------------------------
+    def begin(self, xfer_id: str, hashes: list[int],
+              parents: list[int | None], box: Box, dtype: str) -> None:
+        """Declare the full expected chain of a streamed transfer. The data
+        array is allocated lazily on the first append (its per-block shape
+        isn't known until a wave is extracted)."""
+        entry = self.get_or_create(xfer_id)
+        with self._cond:
+            if entry.dropped:
+                return
+            entry.hashes, entry.parents = list(hashes), list(parents)
+            entry.box, entry.dtype = box, dtype
+            entry.n_ready, entry.complete = 0, False
+            self._cond.notify_all()
+
+    def append(self, xfer_id: str, start: int, wave: np.ndarray) -> bool:
+        """Publish one wave of rows [start, start+len(wave)). Waves must be
+        contiguous with what's already staged (start ≤ n_ready); a gap means
+        the caller lost a wave and the stream is broken — refused."""
+        entry = self.get_or_create(xfer_id)
+        with self._cond:
+            if entry.dropped or entry.complete:
+                return False
+            if start > entry.n_ready:
+                log.warning("staging %s: wave gap (start %d > ready %d)",
+                            xfer_id, start, entry.n_ready)
+                return False
+            stop = start + wave.shape[0]
+            if stop > len(entry.hashes):
+                return False
+            if entry.data is None:
+                entry.data = np.empty((len(entry.hashes), *wave.shape[1:]),
+                                      dtype=wave.dtype)
+                entry.dtype = str(wave.dtype)
+            entry.data[start:stop] = wave
+            entry.n_ready = max(entry.n_ready, stop)
+            self._cond.notify_all()
+            return True
+
+    def finalize(self, xfer_id: str, covered: int) -> None:
+        """Close a streamed transfer at ``covered`` blocks (the mesh-wide
+        voted minimum — may trim waves a minority of ranks staged)."""
+        entry = self.get_or_create(xfer_id)
+        with self._cond:
+            if not entry.dropped:
+                entry.n_ready = min(entry.n_ready, covered)
+                entry.complete = True
+            self._cond.notify_all()
+        entry.ready.set()
+
+    def wait_for(self, xfer_id: str, want: int | None,
+                 timeout: float) -> tuple | None:
+        """Block until ``want`` blocks are staged (or the transfer is
+        complete/dropped), then return a consistent snapshot
+        ``(hashes[:m], parents[:m], data view [:m], box, dtype)`` of the
+        published prefix. ``want=None`` waits for completion (the legacy
+        whole-transfer pull). Returns None on timeout/drop/empty."""
+        entry = self.get_or_create(xfer_id)
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if entry.dropped:
+                    return None
+                if entry.complete or (want is not None and entry.n_ready >= want):
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                self._cond.wait(left)
+            m = entry.n_ready if want is None else min(want, entry.n_ready)
+            if entry.data is None or m == 0:
+                return None
+            return (entry.hashes[:m], entry.parents[:m], entry.data[:m],
+                    entry.box, entry.dtype)
+
+    # -- one-shot path -------------------------------------------------
     def fill(self, xfer_id: str, hashes: list[int], parents: list[int | None],
              data: np.ndarray, box: Box) -> None:
         entry = self.get_or_create(xfer_id)
-        with self._lock:  # publish all fields atomically (see snapshot)
+        with self._cond:  # publish all fields atomically (see snapshot)
             entry.hashes, entry.parents = hashes, parents
             entry.dtype = str(data.dtype)
             entry.data, entry.box = data, box
+            entry.n_ready, entry.complete = len(hashes), True
+            self._cond.notify_all()
         entry.ready.set()
 
     def snapshot(self, xfer_id: str):
-        """Consistent read of a staged entry's fields (or None if not
-        staged). Serve threads that wake from a TIMED-OUT ready.wait() can
-        race a concurrent fill(); reading under the same lock fill()
-        publishes under means they see all-or-nothing, never fresh data
+        """Consistent read of a staged entry's published prefix (or None if
+        nothing is staged). Reading under the same lock fill()/append()
+        publish under means readers see all-or-nothing, never fresh data
         paired with a stale dtype/box."""
         entry = self.get_or_create(xfer_id)
         with self._lock:
-            if entry.data is None:
+            if entry.data is None or entry.n_ready == 0:
                 return None
-            return (entry.hashes, entry.parents, entry.data, entry.box,
-                    entry.dtype)
+            m = entry.n_ready
+            return (entry.hashes[:m], entry.parents[:m], entry.data[:m],
+                    entry.box, entry.dtype)
 
     def drop(self, xfer_id: str) -> None:
-        with self._lock:
+        with self._cond:
             entry = self._entries.pop(xfer_id, None)
+            if entry is not None:
+                entry.dropped = True
+                entry.data = None
+                self._cond.notify_all()
         if entry is not None:
-            entry.data = None
             entry.ready.set()  # unblock any waiter; it will see data=None
 
     def drop_if_empty(self, xfer_id: str) -> None:
@@ -124,17 +222,27 @@ class StagingStore:
 class ShardServer:
     """Serve box-sliced reads of staged shards. One per prefill rank.
 
-    Protocol (framed msgpack, multihost.py codec):
-      request  {"xfer_id", "ls", "le", "hs", "he"}
-      reply    {"hashes", "parents", "box": [ls, le, hs, he], "dtype"}
-               then one {"i": idx, "d": bytes} frame per block (the
-               requested slice, C-contiguous), then {"end": true}
+    Protocol (framed msgpack, multihost.py codec); a connection may carry
+    MANY requests back-to-back (the streamed consumer reuses one socket per
+    shard across waves):
+      request  {"xfer_id", "ls", "le", "hs", "he"[, "start", "stop"]}
+               — no "stop": wait for the complete transfer (legacy pull);
+               with "stop": wait until blocks [start, stop) are staged and
+               serve exactly that window of the chain (a wave pull racing
+               the staging of later waves).
+      reply    {"hashes", "parents", "box": [ls, le, hs, he], "dtype",
+               "start": s} then one {"i": idx, "d": bytes} frame per block
+               (idx relative to "start"; the requested slice,
+               C-contiguous), then {"end": true}
       release  {"xfer_id", "release": true} → {"ok": true} — the decode
                side's done-ack, honored only by the LEADER's server (the
                shards[0] convention): ``on_release`` forwards it to the
                KvTransferSource, which broadcasts the replayed unpin.
       error    {"error": msg}
-    """
+
+    A client disconnect (clean EOF or reset) mid-conversation closes only
+    that connection; the staged transfer stays, so the consumer can
+    reconnect and retry the same window."""
 
     def __init__(self, store: StagingStore, host: str = "0.0.0.0",
                  stage_timeout: float = 60.0, on_release=None):
@@ -170,40 +278,21 @@ class ShardServer:
     def _serve_one(self, conn: socket.socket) -> None:
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            req = recv_frame(conn)
-            if req is None:
-                return
-            if req.get("release"):
-                if self.on_release is not None:
-                    self.on_release(req["xfer_id"])
-                send_frame(conn, {"ok": True})
-                return
-            entry = self.store.get_or_create(req["xfer_id"])
-            entry.ready.wait(self.stage_timeout)
-            snap = self.store.snapshot(req["xfer_id"])
-            if snap is not None:
-                hashes, parents, data, box, dtype = snap
-            else:
-                data = None
-            if data is None:
-                self.store.drop_if_empty(req["xfer_id"])
-                send_frame(conn, {"error": f"transfer {req['xfer_id']} not "
-                                           "staged (expired or never registered)"})
-                return
-            want = (req["ls"], req["le"], req["hs"], req["he"])
-            inter = box_intersection(want, box)
-            if inter is None:
-                send_frame(conn, {"error": f"no overlap: want {want}, "
-                                           f"have {box}"})
-                return
-            ls, le, hs, he = inter
-            sl = data[:, :, ls - box[0]:le - box[0], :, hs - box[2]:he - box[2], :]
-            send_frame(conn, {"hashes": hashes, "parents": parents,
-                              "box": list(inter), "dtype": dtype})
-            for i in range(sl.shape[0]):
-                send_frame(conn, {"i": i,
-                                  "d": np.ascontiguousarray(sl[i]).tobytes()})
-            send_frame(conn, {"end": True})
+            while True:
+                req = recv_frame(conn)
+                if req is None:  # client done with this connection
+                    return
+                if req.get("release"):
+                    if self.on_release is not None:
+                        self.on_release(req["xfer_id"])
+                    send_frame(conn, {"ok": True})
+                    continue
+                if not self._serve_pull(conn, req):
+                    continue  # application error sent; connection reusable
+        except (BrokenPipeError, ConnectionResetError, ConnectionAbortedError):
+            # Mid-stream client disconnect: only this connection dies; the
+            # staged transfer is untouched and a reconnect can re-pull.
+            log.debug("shard client disconnected mid-stream")
         except Exception as exc:  # noqa: BLE001 — a handler thread must not
             # die silently; best-effort error frame, then close.
             log.warning("shard serve failed: %s", exc)
@@ -217,6 +306,42 @@ class ShardServer:
             except OSError:
                 pass
 
+    def _serve_pull(self, conn: socket.socket, req: dict) -> bool:
+        """Answer one pull request; False means an error frame was sent and
+        the connection stays usable for the next request."""
+        xid = req["xfer_id"]
+        start = int(req.get("start", 0))
+        stop = req.get("stop")  # None → wait for the complete transfer
+        snap = self.store.wait_for(xid, stop, self.stage_timeout)
+        if snap is None:
+            self.store.drop_if_empty(xid)
+            send_frame(conn, {"error": f"transfer {xid} not staged "
+                                       "(expired, trimmed, or never registered)"})
+            return False
+        hashes, parents, data, box, dtype = snap
+        m = len(hashes)
+        if start >= m:
+            send_frame(conn, {"error": f"window [{start}:{stop}) beyond "
+                                       f"staged prefix {m}"})
+            return False
+        end = m if stop is None else min(int(stop), m)
+        want = (req["ls"], req["le"], req["hs"], req["he"])
+        inter = box_intersection(want, box)
+        if inter is None:
+            send_frame(conn, {"error": f"no overlap: want {want}, have {box}"})
+            return False
+        ls, le, hs, he = inter
+        sl = data[start:end, :,
+                  ls - box[0]:le - box[0], :, hs - box[2]:he - box[2], :]
+        send_frame(conn, {"hashes": hashes[start:end],
+                          "parents": parents[start:end],
+                          "box": list(inter), "dtype": dtype, "start": start})
+        for i in range(sl.shape[0]):
+            send_frame(conn, {"i": i,
+                              "d": np.ascontiguousarray(sl[i]).tobytes()})
+        send_frame(conn, {"end": True})
+        return True
+
 
 def send_release(addr: str, xfer_id: str, timeout: float = 10.0) -> None:
     """Tell the transfer's owner (the leader shard server, shards[0]) the
@@ -228,37 +353,103 @@ def send_release(addr: str, xfer_id: str, timeout: float = 10.0) -> None:
         recv_frame(conn)
 
 
-def fetch_slice(addr: str, xfer_id: str, box: Box,
-                timeout: float = 30.0) -> tuple[list[int], list[int | None],
-                                                np.ndarray, Box]:
-    """Pull the slice of ``box`` one shard server holds. Synchronous —
-    called from the engine-core thread inside the replayed import op."""
-    host, _, port = addr.rpartition(":")
-    with socket.create_connection((host, int(port)), timeout=timeout) as conn:
+class ShardClient:
+    """Persistent connection to one shard server with bounded
+    reconnect/retry. Socket-level failures (reset, timeout, truncated
+    stream) reconnect with exponential backoff; application error frames
+    (no such transfer, no box overlap) raise immediately — retrying can't
+    fix them. NOT thread-safe: the streamed consumer chains its wave
+    fetches on one thread per transfer."""
+
+    def __init__(self, addr: str, timeout: float = 30.0, retries: int = 3,
+                 backoff: float = 0.05):
+        self.addr = addr
+        self.timeout = timeout
+        self.retries = max(1, retries)
+        self.backoff = backoff
+        self._conn: socket.socket | None = None
+
+    def _connect(self) -> socket.socket:
+        host, _, port = self.addr.rpartition(":")
+        conn = socket.create_connection((host, int(port)), timeout=self.timeout)
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        conn.settimeout(timeout)
-        send_frame(conn, {"xfer_id": xfer_id, "ls": box[0], "le": box[1],
-                          "hs": box[2], "he": box[3]})
+        conn.settimeout(self.timeout)
+        return conn
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def fetch(self, xfer_id: str, box: Box, start: int | None = None,
+              stop: int | None = None) -> tuple[list[int], list[int | None],
+                                                np.ndarray, Box]:
+        """Pull blocks [start, stop) of the slice of ``box`` this shard
+        holds (the whole staged transfer when stop is None). Synchronous."""
+        last: Exception | None = None
+        for attempt in range(self.retries):
+            if attempt:
+                time.sleep(self.backoff * (2 ** (attempt - 1)))
+            try:
+                if self._conn is None:
+                    self._conn = self._connect()
+                return self._fetch_once(self._conn, xfer_id, box, start, stop)
+            except (OSError, EOFError) as exc:
+                last = exc
+                self.close()
+        raise RuntimeError(f"shard pull {self.addr} failed after "
+                           f"{self.retries} attempt(s): {last}")
+
+    def _fetch_once(self, conn: socket.socket, xfer_id: str, box: Box,
+                    start: int | None, stop: int | None):
+        req = {"xfer_id": xfer_id, "ls": box[0], "le": box[1],
+               "hs": box[2], "he": box[3]}
+        if start is not None:
+            req["start"] = int(start)
+        if stop is not None:
+            req["stop"] = int(stop)
+        send_frame(conn, req)
         meta = recv_frame(conn)
-        if meta is None or "error" in meta:
-            raise RuntimeError(f"shard pull {addr} failed: "
-                               f"{(meta or {}).get('error', 'connection closed')}")
+        if meta is None:
+            raise EOFError("connection closed before reply")  # retryable
+        if "error" in meta:
+            raise RuntimeError(f"shard pull {self.addr} failed: {meta['error']}")
         got: Box = tuple(meta["box"])  # type: ignore[assignment]
         n = len(meta["hashes"])
         out = None  # [n, flat] — reshaped by assemble_local (bs/hd caller-known)
         count = 0
         while True:
             frame = recv_frame(conn)
-            if frame is None or frame.get("end"):
+            if frame is None:
+                raise EOFError(f"truncated stream: got {count}/{n} blocks")
+            if frame.get("end"):
                 break
             arr = np.frombuffer(frame["d"], dtype=np.dtype(meta["dtype"]))
             if out is None:
                 out = np.empty((n, arr.size), dtype=arr.dtype)
             out[frame["i"]] = arr
             count += 1
-        if out is None or count != n:
-            raise RuntimeError(f"shard pull {addr}: got {count}/{n} blocks")
+        if count != n or (out is None and n):
+            raise EOFError(f"shard pull {self.addr}: got {count}/{n} blocks")
+        if out is None:
+            out = np.empty((0, 0), dtype=np.dtype(meta["dtype"]))
         return meta["hashes"], meta["parents"], out, got
+
+
+def fetch_slice(addr: str, xfer_id: str, box: Box, timeout: float = 30.0,
+                start: int | None = None, stop: int | None = None,
+                ) -> tuple[list[int], list[int | None], np.ndarray, Box]:
+    """One-shot pull of the slice of ``box`` one shard server holds —
+    a throwaway ShardClient (callers that pull many waves should hold a
+    ShardClient and reuse its connection)."""
+    client = ShardClient(addr, timeout=timeout, retries=2)
+    try:
+        return client.fetch(xfer_id, box, start, stop)
+    finally:
+        client.close()
 
 
 def assemble_local(my_box: Box, pieces: list[tuple[np.ndarray, Box]],

@@ -8,8 +8,19 @@ cache shard to host staging (engine.stage_export), where the per-rank
 shard servers (disagg/sharded.py) serve box-sliced pulls. The transfer
 params advertise the full shard list, so a decode engine of ANY topology
 (single-host or multi-host, different tp) can assemble its own boxes.
-Unpulled transfers expire after a TTL so an aborted decode can't leak
-pinned device blocks (the release is a replayed op too).
+
+Streamed transfers (register_streaming) flip the order: the transfer is
+registered ONCE up front with the full expected hash chain, then the
+engine's step loop stages each committed prefill chunk as a wave
+(kv_stage_wave ops) while later chunks are still computing; wave
+completions are announced to the PrefillHandler so the decode side can
+pull blocks that exist before the prompt is done.
+
+Transfers expire after a TTL measured from their last progress (stream
+registration, wave landing, or stream end), so an aborted decode — or a
+prefill that dies mid-stream — can't leak pinned device blocks (the
+release is a replayed op too, covering shipped and not-yet-staged waves
+alike).
 """
 
 from __future__ import annotations
@@ -47,6 +58,8 @@ class KvTransferSource:
         self.shards: list[dict] | None = None
         self._transfers: dict[str, _Transfer] = {}
         self._gc_task: asyncio.Task | None = None
+        self._wave_queues: dict[str, asyncio.Queue] = {}
+        self._listener_loop: asyncio.AbstractEventLoop | None = None
 
     def start(self) -> None:
         if self._gc_task is None:
@@ -98,12 +111,81 @@ class KvTransferSource:
             seq_hashes=covered, deadline=time.monotonic() + self.ttl_s)
         return {"xfer_id": xid, "block_hashes": covered, "shards": shards}
 
+    # -- streamed registration -----------------------------------------
+    def _ensure_stream_listener(self) -> None:
+        """Hook the engine-core wave detector (AsyncJaxEngine._run) to this
+        source: wave completions are marshaled from the engine thread onto
+        the event loop and fanned out to the per-transfer queues the
+        PrefillHandler consumes."""
+        loop = asyncio.get_running_loop()
+        if self._listener_loop is loop:
+            return
+        self._listener_loop = loop
+
+        def on_wave(xid: str, staged: int) -> None:  # engine-core thread
+            q = self._wave_queues.get(xid)
+            xfer = self._transfers.get(xid)
+            if xfer is not None:
+                # A live stream is making progress — a slow prefill must
+                # not expire its own transfer mid-stream (TTL measures
+                # time since last progress; see _gc_loop).
+                xfer.deadline = time.monotonic() + self.ttl_s
+            if q is not None:
+                loop.call_soon_threadsafe(q.put_nowait, ("wave", staged))
+
+        self.engine.core._stream_listener = on_wave
+
+    async def register_streaming(self, request_id: str, seq_hashes: list[int],
+                                 events: asyncio.Queue) -> dict | None:
+        """Open a streamed transfer for ``request_id``'s full expected hash
+        chain BEFORE prefill runs. Waves land via the engine's per-chunk
+        stage hook and are announced as ``("wave", staged_count)`` items on
+        ``events``. Returns the announce params (id + chain + shard
+        endpoints) or None for an empty chain."""
+        if not seq_hashes:
+            return None
+        shards = self._ensure_shards()
+        self._ensure_stream_listener()
+        xid = uuid.uuid4().hex
+        self._wave_queues[xid] = events
+        await self.engine.run_op(
+            "kv_stream_begin",
+            {"xfer_id": xid, "request_id": request_id,
+             "hashes": list(seq_hashes)})
+        self._transfers[xid] = _Transfer(
+            seq_hashes=list(seq_hashes),
+            deadline=time.monotonic() + self.ttl_s)
+        return {"xfer_id": xid, "block_hashes": list(seq_hashes),
+                "shards": shards}
+
+    async def finish_streaming(self, xid: str) -> int:
+        """Prefill finished: vote + trim the stream on every rank. Returns
+        the covered (pullable) block count; 0 releases the transfer
+        entirely (nothing for the decode side to pull)."""
+        self._wave_queues.pop(xid, None)
+        covered = await self.engine.run_op("kv_stream_end", {"xfer_id": xid})
+        covered = int(covered or 0)
+        xfer = self._transfers.get(xid)
+        if covered and xfer is not None:
+            xfer.seq_hashes = xfer.seq_hashes[:covered]
+            xfer.deadline = time.monotonic() + self.ttl_s
+        else:
+            await self._release(xid)
+        return covered
+
+    async def abort_streaming(self, xid: str) -> None:
+        """Mid-stream abort (cancelled request, errored prefill): release
+        pins for shipped AND not-yet-staged waves on every rank."""
+        self._wave_queues.pop(xid, None)
+        await self._release(xid)
+
     async def release(self, xfer_id: str) -> None:
         """Decode-side ack: the pull completed (or was abandoned) — unpin
         and drop staging on every rank."""
         await self._release(xfer_id)
 
     async def _release(self, xid: str) -> None:
+        self._wave_queues.pop(xid, None)
         if self._transfers.pop(xid, None) is not None:
             await self.engine.run_op("kv_release", {"xfer_id": xid})
 

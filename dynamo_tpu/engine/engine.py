@@ -1399,7 +1399,8 @@ class EngineCore:
         blocks = self.transfer.extract(self.runner.cache_k, self.runner.cache_v, ids)
         return [(h, par, data) for (h, par), data in zip(kept, blocks)]
 
-    def import_blocks(self, plan: list[tuple[int, int | None, np.ndarray]]) -> int:
+    def import_blocks(self, plan: list[tuple[int, int | None, np.ndarray]],
+                      span_attrs: dict | None = None) -> int:
         """Inject externally-received blocks as matchable cache entries —
         the decode side of disaggregated serving. Hashes already on device
         are skipped (and MRU-protected)."""
@@ -1409,7 +1410,7 @@ class EngineCore:
         filtered = plan_onboard(self.pool, [h for h, _, _ in plan], by_hash.get)
         flush = self.kvbm.flush_pending if self.kvbm is not None else None
         return inject_and_commit(self.runner, self.pool, self.transfer, filtered,
-                                 flush=flush)
+                                 flush=flush, span_attrs=span_attrs)
 
     def pin_blocks(self, seq_hashes: list[int]) -> list[int]:
         """Incref the device-resident prefix of a chain so it survives until
@@ -1498,19 +1499,149 @@ class EngineCore:
         return n
 
     def release_export(self, xfer_id: str) -> None:
+        """Unpin + unstage one transfer — final ack AND mid-stream abort.
+        For a still-streaming transfer this also tears down the stream
+        state, so pins already shipped, staged-but-unpulled, and
+        not-yet-staged waves all release together (later kv_stage_wave ops
+        for this id become no-ops)."""
+        st = getattr(self, "_streams_by_xid", {}).pop(xfer_id, None)
+        if st is not None:
+            self._stream_exports.pop(st.request_id, None)
         self.staging.drop(xfer_id)
         ids = self._staged_pins.pop(xfer_id, None)
         if ids:
             self.pool.release(ids)
 
-    def _fetch_local(self, params: dict):
-        """The network half of a pull: fetch + assemble this rank's box.
-        Touches no engine state — safe off the core thread. Returns
-        (hashes, parents, local_blocks) or None on any failure."""
+    # -- streamed (wave-granular) export ------------------------------
+    # The prefill side of the chunk-streamed handoff: kv_stream_begin
+    # declares the full expected chain once, the leader's step loop emits
+    # one kv_stage_wave exec op after each finalize that commits new
+    # blocks (AsyncJaxEngine._run), and kv_stream_end votes + trims. All
+    # three are replayed ops, so pins/staging stay rank-identical; the
+    # per-wave extract failure of a single rank is absorbed by pinning
+    # regardless and voting the covered count down at stream end.
+
+    def _ensure_streams(self) -> None:
+        if getattr(self, "_stream_exports", None) is None:
+            self._stream_exports: dict[str, _StreamExport] = {}
+            self._streams_by_xid: dict[str, _StreamExport] = {}
+
+    def stream_begin(self, xfer_id: str, request_id: str,
+                     seq_hashes: list[int]) -> int:
+        """Open a streamed export for ``request_id``'s chain. No device
+        work — the staging entry just declares the expected hashes so
+        early pulls can wait on waves."""
+        touch = self.staging  # ensure _staged_pins exists on every path
+        self._ensure_streams()
+        st = _StreamExport(xfer_id=xfer_id, request_id=request_id,
+                           hashes=list(seq_hashes))
+        self._stream_exports[request_id] = st
+        self._streams_by_xid[xfer_id] = st
+        self._staged_pins.setdefault(xfer_id, [])
+        parents: list[int | None] = [None, *st.hashes[:-1]]
+        touch.begin(xfer_id, st.hashes, parents, self.my_box(),
+                    str(jnp.dtype(self.runner.spec.dtype)))
+        return len(st.hashes)
+
+    def stream_wave_targets(self) -> list[tuple[str, int, int]]:
+        """Leader-side wave detection (engine-core thread, after
+        step_finalize): chains whose committed-block prefix grew past what
+        has been staged. Also caches each stream's Seq while it is still
+        registered, so the final wave (committed by the finalize that
+        finishes the request) is still visible after _seqs drops it."""
+        streams = getattr(self, "_stream_exports", None)
+        if not streams:
+            return []
+        out: list[tuple[str, int, int]] = []
+        for rid, st in list(streams.items()):
+            if st.seq is None:
+                st.seq = self._seqs.get(rid)
+            if st.seq is None:
+                continue
+            avail = min(st.seq.committed_blocks, len(st.hashes))
+            if avail > st.requested:
+                out.append((st.xfer_id, st.requested, avail))
+                st.requested = avail
+        return out
+
+    def stage_wave(self, xfer_id: str, start: int, stop: int) -> int:
+        """Stage blocks [start, stop) of a streamed chain: pin the new
+        wave, extract this rank's shard slice, append to staging. NO vote
+        here — pin decisions derive from pool state (rank-identical by
+        replay); a local extract failure freezes this rank's staged count
+        and stream_end's vote trims everyone to the minimum. Returns the
+        blocks staged so far on this rank."""
+        st = getattr(self, "_streams_by_xid", {}).get(xfer_id)
+        if st is None:  # released/aborted while the op was in flight
+            return 0
+        stop = min(stop, len(st.hashes))
+        if stop <= start:
+            return st.staged
+        # Pin [start, stop) without double-pinning earlier waves:
+        # match_prefix increfs the whole resident prefix, so drop the refs
+        # below start. The committed prefix can't shrink between the
+        # finalize that committed it and this op (no allocate in between),
+        # so len(ids) == stop on every rank in the healthy case.
+        ids = self.pool.match_prefix(st.hashes[:stop])
+        if start:
+            self.pool.release(ids[:start])
+        keep = ids[start:]
+        self._staged_pins.setdefault(xfer_id, []).extend(keep)
+        if st.failed:
+            return st.staged
+        if len(ids) < stop:
+            log.warning("stage_wave %s: only %d/%d blocks resident; "
+                        "freezing stream", xfer_id, len(ids), stop)
+            st.failed = True
+            return st.staged
+        try:
+            blocks = self.transfer.extract(
+                self.runner.cache_k, self.runner.cache_v, keep,
+                dequant=self.runner.spec.quantized,
+                span_attrs={"phase": "stage", "xfer_id": xfer_id,
+                            "start": start, "stop": stop})
+            data = np.stack(blocks)
+        except Exception as exc:  # noqa: BLE001 — stream_end's vote trims
+            log.warning("stage_wave extract failed: %s", exc)
+            st.failed = True
+            return st.staged
+        if st.staged != start or not self.staging.append(xfer_id, start, data):
+            st.failed = True
+            return st.staged
+        st.staged = stop
+        from dynamo_tpu.disagg.metrics import get_kv_metrics
+
+        get_kv_metrics().record_wave("stage", int(data.nbytes))
+        return st.staged
+
+    def stream_end(self, xfer_id: str) -> int:
+        """Close a streamed export: vote the mesh-wide minimum staged
+        count, trim pins/staging beyond it, mark the staging entry
+        complete. Returns the covered (pullable) block count."""
+        st = getattr(self, "_streams_by_xid", {}).pop(xfer_id, None)
+        if st is None:
+            return 0
+        self._stream_exports.pop(st.request_id, None)
+        covered = self._vote_min(st.staged)
+        pins = self._staged_pins.get(xfer_id, [])
+        if len(pins) > covered:
+            self.pool.release(pins[covered:])
+            self._staged_pins[xfer_id] = pins[:covered]
+        self.staging.finalize(xfer_id, covered)
+        return covered
+
+    def _fetch_local(self, params: dict, start: int | None = None,
+                     stop: int | None = None, clients: dict | None = None):
+        """The network half of a pull: fetch + assemble this rank's box
+        (the window [start, stop) of the chain; the whole transfer when
+        stop is None). Touches no engine state — safe off the core thread.
+        ``clients`` is a per-transfer addr→ShardClient cache so wave pulls
+        reuse connections. Returns (hashes, parents, local_blocks) or None
+        on any failure."""
         from dynamo_tpu.disagg.sharded import (
+            ShardClient,
             assemble_local,
             box_intersection,
-            fetch_slice,
         )
 
         spec = self.runner.spec
@@ -1523,7 +1654,24 @@ class EngineCore:
                 inter = box_intersection(box, tuple(sh["box"]))
                 if inter is None:
                     continue
-                h, p, flat, got = fetch_slice(sh["addr"], params["xfer_id"], inter)
+                if clients is not None:
+                    client = clients.get(sh["addr"])
+                    if client is None:
+                        client = clients[sh["addr"]] = ShardClient(sh["addr"])
+                    h, p, flat, got = client.fetch(params["xfer_id"], inter,
+                                                   start, stop)
+                else:
+                    client = ShardClient(sh["addr"], retries=2)
+                    try:
+                        h, p, flat, got = client.fetch(params["xfer_id"],
+                                                       inter, start, stop)
+                    finally:
+                        client.close()
+                if hashes and len(h) != len(hashes):
+                    # Shards answered different windows (a partial serve
+                    # racing finalize-trim) — the slices no longer tile.
+                    raise RuntimeError(
+                        f"shard windows diverge: {len(h)} vs {len(hashes)}")
                 hashes, parents = h, p  # identical across shards (one chain)
                 pieces.append((flat, got))
             local = (assemble_local(box, pieces, len(hashes), spec.block_size,
@@ -1534,45 +1682,95 @@ class EngineCore:
             return None
         return (hashes, parents, local) if local is not None else None
 
-    def prefetch_remote(self, params: dict) -> None:
+    def _pull_state(self, xfer_id: str) -> dict:
+        if not hasattr(self, "_pulls"):
+            self._pulls: dict[str, dict] = {}
+        return self._pulls.setdefault(
+            xfer_id, {"clients": {}, "waves": {}, "last": None})
+
+    def prefetch_remote(self, params: dict, start: int | None = None,
+                        stop: int | None = None, tail: bool = False) -> None:
         """Start the pull's network half on a background thread so engine
         steps keep running while bytes move; import_remote joins it. As a
         replayed op, every rank overlaps ITS fetch with ITS serving — the
         op order stays identical, only the waiting moves off the step
-        path."""
-        if not hasattr(self, "_prefetches"):
-            self._prefetches: dict[str, dict] = {}
+        path. Wave pulls ([start, stop) windows) of one transfer chain on
+        a single thread lineage so the per-shard connections are reused
+        without cross-thread sharing."""
+        state = self._pull_state(params["xfer_id"])
+        prev = state["last"]
         slot: dict = {}
 
         def run() -> None:
-            slot["result"] = self._fetch_local(params)
+            if prev is not None:
+                prev["thread"].join()
+            with get_tracer().span("kv.transfer", phase="pull",
+                                   xfer_id=params["xfer_id"],
+                                   start=start if start is not None else 0,
+                                   stop=stop if stop is not None else -1,
+                                   tail=tail) as sp:
+                result = self._fetch_local(params, start, stop,
+                                           state["clients"])
+                if result is not None:
+                    sp.attrs["bytes"] = int(result[2].nbytes)
+                    sp.attrs["blocks"] = len(result[0])
+            slot["result"] = result
 
         t = threading.Thread(target=run, name="kv-prefetch", daemon=True)
         slot["thread"] = t
-        self._prefetches[params["xfer_id"]] = slot
+        state["waves"][(start, stop)] = slot
+        state["last"] = slot
         t.start()
 
-    def import_remote(self, params: dict) -> int:
-        """Join the prefetch (or fetch inline), vote, and inject. On a
-        multi-host engine every rank runs this as a replayed op; the
-        mesh-wide vote makes fetch failure all-or-nothing so per-rank pool
-        state can never diverge (divergent pools would mean divergent XLA
-        programs → hung collectives). Returns blocks injected, or -1 when
-        the pull failed on some rank (no state was mutated anywhere)."""
-        slot = getattr(self, "_prefetches", {}).pop(params["xfer_id"], None)
+    def import_remote(self, params: dict, start: int | None = None,
+                      stop: int | None = None, final: bool = True) -> int:
+        """Join the prefetch (or fetch inline), vote, and inject one
+        window of the chain. On a multi-host engine every rank runs this
+        as a replayed op; the mesh-wide vote makes fetch failure
+        all-or-nothing so per-rank pool state can never diverge (divergent
+        pools would mean divergent XLA programs → hung collectives).
+        Returns blocks injected, or -1 when the pull failed on some rank
+        (no state was mutated anywhere). ``final`` closes the transfer's
+        pull state (shard connections) afterwards."""
+        state = self._pull_state(params["xfer_id"])
+        slot = state["waves"].pop((start, stop), None)
         if slot is not None:
             slot["thread"].join()
             fetched = slot["result"]
         else:
-            fetched = self._fetch_local(params)
-        if self._vote_min(1 if fetched is not None else 0) == 0:
+            fetched = self._fetch_local(params, start, stop, state["clients"])
+        failed = self._vote_min(1 if fetched is not None else 0) == 0
+        if failed:
+            self.close_pull(params["xfer_id"])
             return -1
         hashes, parents, local = fetched
-        plan = [(h, par, local[i]) for i, (h, par) in enumerate(zip(hashes, parents))]
-        n = self.import_blocks(plan)
+        plan = [(h, par, local[i])
+                for i, (h, par) in enumerate(zip(hashes, parents))]
+        n = self.import_blocks(
+            plan, span_attrs={"phase": "import", "xfer_id": params["xfer_id"],
+                              "start": start if start is not None else 0,
+                              "stop": stop if stop is not None else len(hashes)})
+        from dynamo_tpu.disagg.metrics import get_kv_metrics
+
+        get_kv_metrics().record_wave("pull", int(local.nbytes))
         log.info("pulled %d KV blocks for box %s (injected %d)",
                  len(plan), self.my_box(), n)
+        if final:
+            self.close_pull(params["xfer_id"])
         return n
+
+    def close_pull(self, xfer_id: str) -> None:
+        """Tear down a transfer's pull state: close per-shard connections
+        and drop pending wave results. Closing the sockets first makes any
+        in-flight fetch thread fail fast, so the join is bounded."""
+        state = getattr(self, "_pulls", {}).pop(xfer_id, None)
+        if state is None:
+            return
+        for client in state["clients"].values():
+            client.close()
+        last = state["last"]
+        if last is not None and last["thread"].is_alive():
+            last["thread"].join(timeout=5.0)
 
     def run_op(self, name: str, args: dict):
         """Execute one named core op — the replayable subset of run_in_core
@@ -1594,6 +1792,26 @@ class EngineCore:
         return rids
 
 
+@dataclass
+class _StreamExport:
+    """Per-request state of a streamed (wave-granular) KV export.
+
+    ``requested`` is leader-only bookkeeping (how far wave detection has
+    emitted ops); ``staged`` is this rank's locally-staged prefix, voted
+    down to the mesh minimum at stream_end. ``seq`` is cached by the
+    leader's wave detection so the final wave — committed by the finalize
+    that also finishes the request — is still observable after the seq
+    leaves ``_seqs``."""
+
+    xfer_id: str
+    request_id: str
+    hashes: list[int]
+    seq: "Seq | None" = None
+    requested: int = 0
+    staged: int = 0
+    failed: bool = False
+
+
 # The replayable core-op registry: names + msgpack-able args only, so a
 # multi-host leader can broadcast them on the op stream and followers
 # replay them in lockstep (the closure-based run_in_core can't cross
@@ -1603,6 +1821,17 @@ CORE_OPS: dict[str, Callable[["EngineCore", dict], Any]] = {
     "kv_release": lambda core, a: core.release_export(a["xfer_id"]),
     "kv_prefetch": lambda core, a: core.prefetch_remote(a["params"]),
     "kv_import": lambda core, a: core.import_remote(a["params"]),
+    # Streamed (wave-granular) handoff — see EngineCore.stream_begin.
+    "kv_stream_begin": lambda core, a: core.stream_begin(
+        a["xfer_id"], a["request_id"], a["hashes"]),
+    "kv_stage_wave": lambda core, a: core.stage_wave(
+        a["xfer_id"], a["start"], a["stop"]),
+    "kv_stream_end": lambda core, a: core.stream_end(a["xfer_id"]),
+    "kv_prefetch_wave": lambda core, a: core.prefetch_remote(
+        a["params"], a["start"], a["stop"], a.get("tail", False)),
+    "kv_import_wave": lambda core, a: core.import_remote(
+        a["params"], a["start"], a["stop"], a.get("final", False)),
+    "kv_pull_abort": lambda core, a: core.close_pull(a["xfer_id"]),
 }
 
 
@@ -1658,6 +1887,28 @@ class AsyncJaxEngine:
             self._channel_down = True
             self._stop = True
             raise OpChannelDown(str(exc)) from exc
+
+    def _stage_stream_waves(self) -> None:
+        """After each finalize: stage newly-committed prefill chunks of any
+        open streamed exports as kv_stage_wave ops. Broadcast-then-apply
+        like every state-changing op, and emitted at a fixed point of the
+        loop (right after step_finalize), so followers replay the wave at
+        the identical op-stream position — pool pins stay rank-identical.
+        The overlap comes for free: the NEXT chunk's device step is already
+        dispatched (pipelined step_begin) while this host-side extract+
+        stage runs."""
+        for xid, start, stop in self.core.stream_wave_targets():
+            self._emit_op({"op": "exec", "name": "kv_stage_wave",
+                           "args": {"xfer_id": xid, "start": start,
+                                    "stop": stop}})
+            staged = self.core.run_op(
+                "kv_stage_wave", {"xfer_id": xid, "start": start, "stop": stop})
+            listener = getattr(self.core, "_stream_listener", None)
+            if listener is not None and staged:
+                try:
+                    listener(xid, staged)
+                except Exception:  # noqa: BLE001 — advisory only
+                    log.exception("stream wave listener failed")
 
     # ------------------------------------------------------------------
     def _run(self) -> None:
@@ -1777,6 +2028,7 @@ class AsyncJaxEngine:
                     for rid, out in outputs.items():
                         self._post(rid, out)
                 pending = nxt
+                self._stage_stream_waves()
             except Exception as exc:
                 # Engine-fatal: fail + drain all in-flight state so the loop
                 # doesn't spin hot retrying the same failing step.

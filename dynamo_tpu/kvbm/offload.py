@@ -58,7 +58,8 @@ def plan_onboard(
 
 
 def inject_and_commit(runner, pool: PrefixPool, transfer: BlockTransferEngine,
-                      plan: OnboardPlan, flush: Callable[[], int] | None = None) -> int:
+                      plan: OnboardPlan, flush: Callable[[], int] | None = None,
+                      span_attrs: dict | None = None) -> int:
     """Allocate device blocks, scatter the plan's data in, and commit them as
     matchable inactive cache entries. Returns blocks injected (0 if the pool
     can't make room). ``runner`` is duck-typed: mutable cache_k/cache_v.
@@ -78,6 +79,7 @@ def inject_and_commit(runner, pool: PrefixPool, transfer: BlockTransferEngine,
     runner.cache_k, runner.cache_v = transfer.inject(
         runner.cache_k, runner.cache_v, block_ids,
         [data for _, _, data in plan],
+        span_attrs=span_attrs,
     )
     for bid, (h, par, _) in zip(block_ids, plan):
         pool.commit(bid, h, par)

@@ -165,29 +165,38 @@ class BlockTransferEngine:
         self._inject_quant = jax.jit(_inject_quant, donate_argnums=(0, 1))
 
     def extract(self, cache_k, cache_v, ids: list[int],
-                dequant: bool = False) -> list[np.ndarray]:
+                dequant: bool = False,
+                span_attrs: dict | None = None) -> list[np.ndarray]:
         """Gather blocks off the device; returns one host block per id.
         Quantized caches yield packed flat-uint8 blocks unless ``dequant``
-        (then: float blocks, for the box-sliced disagg staging path)."""
+        (then: float blocks, for the box-sliced disagg staging path).
+        ``span_attrs`` annotate the kv.transfer span (the streamed handoff
+        tags each wave's phase/window here, so per-wave extracts stay ONE
+        span each — wave sizes repeat, so the pow2 id-padding below reuses
+        the same jit buckets across waves)."""
         from dynamo_tpu.obs.tracer import get_tracer
 
         n = len(ids)
         with get_tracer().span("kv.transfer", direction="extract",
-                               blocks=n):
+                               blocks=n, **(span_attrs or {})) as sp:
             padded = jnp.asarray(_pad_pow2(list(ids)), jnp.int32)
             if isinstance(cache_k, dict) and not dequant:
                 kq, ks, vq, vs = self._extract_q(cache_k, cache_v, padded)
                 kq, ks = np.asarray(kq), np.asarray(ks)  # [L,n,BS,KH,D]/[L,n,KH]
                 vq, vs = np.asarray(vq), np.asarray(vs)
-                return [pack_kv_block(kq[:, i], ks[:, i], vq[:, i], vs[:, i])
-                        for i in range(n)]
+                out = [pack_kv_block(kq[:, i], ks[:, i], vq[:, i], vs[:, i])
+                       for i in range(n)]
+                sp.attrs["bytes"] = sum(int(b.nbytes) for b in out)
+                return out
             if isinstance(cache_k, dict):
                 k, v = self._extract_deq(cache_k, cache_v, padded)
             else:
                 k, v = self._extract(cache_k, cache_v, padded)
             kv = np.stack([np.asarray(k), np.asarray(v)])  # [2, layers, n_pad, bs, kvh, hd]
             per_block = np.moveaxis(kv, 2, 0)              # [n_pad, 2, layers, bs, kvh, hd]
-            return [np.ascontiguousarray(per_block[i]) for i in range(n)]
+            out = [np.ascontiguousarray(per_block[i]) for i in range(n)]
+            sp.attrs["bytes"] = sum(int(b.nbytes) for b in out)
+            return out
 
     def inject(
         self,
@@ -195,16 +204,20 @@ class BlockTransferEngine:
         cache_v,
         ids: list[int],
         blocks: list[np.ndarray],
+        span_attrs: dict | None = None,
     ):
         """Scatter host blocks into the device cache (cache args are donated —
         callers must replace their references with the returned arrays).
         Accepts packed or float blocks against either cache kind; format
-        conversion happens here (mixed-precision import)."""
+        conversion happens here (mixed-precision import — the wave boundary
+        of the streamed handoff included)."""
         from dynamo_tpu.obs.tracer import get_tracer
 
         assert len(ids) == len(blocks) and ids
         with get_tracer().span("kv.transfer", direction="inject",
-                               blocks=len(ids)):
+                               blocks=len(ids),
+                               bytes=sum(int(b.nbytes) for b in blocks),
+                               **(span_attrs or {})):
             quant_cache = isinstance(cache_k, dict)
             padded = _pad_pow2(list(ids))
             pad = [blocks[-1]] * (len(padded) - len(blocks))

@@ -7,18 +7,37 @@ the reference can't do without GPUs.
 """
 
 import asyncio
+import threading
+import time
+from contextlib import contextmanager
 
+import numpy as np
 import pytest
 
 from dynamo_tpu.disagg.handlers import DisaggDecodeHandler, PrefillHandler
 from dynamo_tpu.disagg.source import KvTransferSource
 from dynamo_tpu.engine.engine import AsyncJaxEngine, EngineCore
+from dynamo_tpu.obs.tracer import get_tracer
 from dynamo_tpu.tokens import compute_block_hashes_for_tokens
 
 from tests.test_engine import make_req, run_to_completion, tiny_config
 
 
-PROMPT = list(range(60, 84))  # 24 tokens = 6 full blocks of 4
+PROMPT = list(range(60, 84))      # 24 tokens = 6 full blocks of 4
+LONG_PROMPT = list(range(100, 140))  # 40 tokens = 10 blocks; 5 chunks of 8
+
+
+@contextmanager
+def capture_spans():
+    """Collect every span closed while the context is active."""
+    spans: list = []
+    sink = spans.append
+    tracer = get_tracer()
+    tracer.add_sink(sink)
+    try:
+        yield spans
+    finally:
+        tracer._sinks.remove(sink)
 
 
 def baseline_tokens(prompt, max_tokens=6):
@@ -214,6 +233,167 @@ async def test_transfer_ttl_expiry_unpins():
 
     await setup()
     await engine.shutdown()
+
+
+# -- streamed (wave-granular) handoff ----------------------------------------
+
+async def _handoff_tokens(p_cfg, d_cfg, stream, prompt, max_tokens=6):
+    """Full handler flow p→d; returns the decode-side token stream."""
+    p_engine = AsyncJaxEngine(EngineCore(p_cfg))
+    d_engine = AsyncJaxEngine(EngineCore(d_cfg))
+    source = KvTransferSource(p_engine)
+    prefill = PrefillHandler(p_engine, source, block_size=4, stream=stream)
+
+    async def prefill_call(payload, request_id):
+        async for item in prefill.generate(payload, _Ctx()):
+            yield item
+
+    decode = DisaggDecodeHandler(d_engine, prefill_call, block_size=4)
+    outs = await drain(decode.generate(
+        make_req(prompt=prompt, max_tokens=max_tokens).to_dict(), _Ctx()))
+    assert decode.remote_prefills == 1 and decode.local_fallbacks == 0
+    await p_engine.shutdown()
+    await d_engine.shutdown()
+    return [t for o in outs for t in o.get("token_ids", [])]
+
+
+async def test_streamed_handoff_overlaps_prefill():
+    """Acceptance: a ≥4-chunk prefill streams ≥4 stage waves, the last KV
+    pull lands no later than one wave after prefill end (≤1 tail pull), the
+    exported overlap ratio is >0 — and decode output stays bit-identical."""
+    from dynamo_tpu.disagg.metrics import get_kv_metrics
+
+    expected = baseline_tokens(LONG_PROMPT)
+    get_kv_metrics().overlap_ratio.set(0.0)
+    with capture_spans() as spans:
+        tokens = await _handoff_tokens(
+            tiny_config(prefill_chunk=8), tiny_config(),
+            stream=True, prompt=LONG_PROMPT)
+    assert tokens == expected
+
+    waves = [s for s in spans
+             if s.name == "kv.transfer" and s.attrs.get("phase")]
+    stage = [s for s in waves if s.attrs["phase"] == "stage"]
+    pulls = [s for s in waves if s.attrs["phase"] == "pull"]
+    imports = [s for s in waves if s.attrs["phase"] == "import"]
+    assert len(stage) >= 4, f"expected >=4 stage waves, got {len(stage)}"
+    assert pulls and imports
+    # the streamed pipeline may need one voted tail wave after prefill
+    # ends (the final chunk's event can race the stream's end) — never more
+    assert sum(1 for s in pulls if s.attrs.get("tail")) <= 1
+    assert get_kv_metrics().overlap_ratio.get() > 0.0
+    assert "dynamo_kv_transfer_overlap_ratio" in get_kv_metrics().registry.expose()
+
+
+def test_staging_waves_out_of_order_and_racing_pulls():
+    """StagingStore refuses wave gaps, and a wave pull issued BEFORE its
+    wave is staged blocks in the shard server until staging catches up."""
+    from dynamo_tpu.disagg.sharded import ShardServer, StagingStore, fetch_slice
+
+    store = StagingStore()
+    hashes = [101, 102, 103, 104]
+    parents = [None, 101, 102, 103]
+    box = (0, 2, 0, 2)
+    data = np.arange(4 * 2 * 2 * 4 * 2 * 8, dtype=np.float32).reshape(
+        4, 2, 2, 4, 2, 8)
+    store.begin("x", hashes, parents, box, "float32")
+    assert not store.append("x", 2, data[2:4])   # gap: wave 2 before wave 1
+    assert store.append("x", 0, data[0:2])
+
+    server = ShardServer(store, host="127.0.0.1", stage_timeout=10.0)
+    addr = f"127.0.0.1:{server.port}"
+    try:
+        got = {}
+        t = threading.Thread(
+            target=lambda: got.update(
+                res=fetch_slice(addr, "x", box, start=2, stop=4)))
+        t.start()
+        time.sleep(0.3)
+        assert t.is_alive()                       # blocked on wave 2
+        assert store.append("x", 2, data[2:4])    # contiguous now — lands
+        store.finalize("x", 4)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        h, p, flat, gbox = got["res"]
+        assert list(h) == hashes[2:4] and tuple(gbox) == box
+        np.testing.assert_array_equal(
+            flat.reshape(2, 2, 2, 4, 2, 8), data[2:4])
+    finally:
+        server.close()
+
+
+async def test_stream_abort_releases_all_pins():
+    """Aborting a streamed transfer mid-chain releases pins for shipped AND
+    not-yet-staged waves: stream state, pins, and staging all clear, and
+    churn can then evict the formerly-pinned blocks."""
+    # 16 usable blocks: the 40-token request needs 11, so post-abort churn
+    # MUST evict the 9 formerly-pinned blocks (a leaked pin would keep them)
+    engine = AsyncJaxEngine(EngineCore(tiny_config(prefill_chunk=8,
+                                                   num_blocks=17)))
+    source = KvTransferSource(engine)
+    hashes = compute_block_hashes_for_tokens(LONG_PROMPT, 4)[:9]
+    events: asyncio.Queue = asyncio.Queue()
+    reg = await source.register_streaming("s", hashes, events)
+    xid = reg["xfer_id"]
+    async for _ in engine.generate(make_req(prompt=LONG_PROMPT, max_tokens=1,
+                                            rid="s")):
+        pass
+    kinds = set()
+    while not events.empty():
+        kinds.add(events.get_nowait()[0])
+    assert "wave" in kinds
+    staged = await engine.run_in_core(
+        lambda c: len(c._staged_pins.get(xid, [])))
+    assert staged > 0
+
+    await source.abort_streaming(xid)
+    clean = await engine.run_in_core(
+        lambda c: (xid not in c._staged_pins
+                   and xid not in getattr(c, "_streams_by_xid", {})
+                   and c.staging.snapshot(xid) is None))
+    assert clean
+    for i in range(3):  # churn: needs the formerly-pinned blocks evictable
+        async for _ in engine.generate(
+                make_req(prompt=[300 + i] * 20, max_tokens=2, rid=f"c{i}")):
+            pass
+    plan = await engine.run_in_core(lambda c: c.export_blocks(hashes))
+    assert len(plan) < len(hashes), "churn failed to evict unpinned blocks"
+    await engine.shutdown()
+
+
+async def test_streamed_mixed_kv_dtype_matches_legacy():
+    """int8 prefill → bf16 decode and bf16 prefill → int8 decode hand off
+    over the streamed path with output identical to the legacy one-shot
+    pull (dtype conversion stays at the wave boundary both ways)."""
+    for p_kv, d_kv in (("int8", "bfloat16"), ("bfloat16", "int8")):
+        legacy = await _handoff_tokens(
+            tiny_config(kv_dtype=p_kv, prefill_chunk=8),
+            tiny_config(kv_dtype=d_kv), stream=False, prompt=LONG_PROMPT)
+        streamed = await _handoff_tokens(
+            tiny_config(kv_dtype=p_kv, prefill_chunk=8),
+            tiny_config(kv_dtype=d_kv), stream=True, prompt=LONG_PROMPT)
+        assert streamed == legacy and legacy, (p_kv, d_kv)
+
+
+async def test_single_wave_stream_matches_legacy_staged_pull():
+    """A prompt inside one prefill chunk streams exactly one wave, and that
+    wave is byte-identical to the legacy one-shot staged transfer."""
+    with capture_spans() as legacy_spans:
+        legacy = await _handoff_tokens(tiny_config(), tiny_config(),
+                                       stream=False, prompt=PROMPT)
+    with capture_spans() as spans:
+        streamed = await _handoff_tokens(tiny_config(), tiny_config(),
+                                         stream=True, prompt=PROMPT)
+    assert streamed == legacy == baseline_tokens(PROMPT)
+    stage = [s for s in spans
+             if s.name == "kv.transfer" and s.attrs.get("phase") == "stage"]
+    assert len(stage) == 1            # 24 tokens, chunk 32 → one wave
+    legacy_stage = [s for s in legacy_spans
+                    if s.name == "kv.transfer" and not s.attrs.get("phase")
+                    and s.attrs.get("direction") == "extract"]
+    assert legacy_stage
+    assert stage[0].attrs["bytes"] == legacy_stage[-1].attrs["bytes"]
+    assert stage[0].attrs["blocks"] == legacy_stage[-1].attrs["blocks"]
 
 
 async def test_decode_first_flow_with_spec_decoding():

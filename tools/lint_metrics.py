@@ -43,6 +43,17 @@ PROVIDER_METRICS = {
     ),
 }
 
+# The streamed KV handoff family (disagg/metrics.py KvTransferMetrics):
+# declared here so dashboards have a grep-stable contract and drift in
+# either direction — a registration added without declaring it, or a
+# declared name that no longer exists — fails the lint.
+KV_TRANSFER_METRICS = (
+    "kv_transfer_overlap_ratio",
+    "kv_transfer_waves_total",
+    "kv_transfer_bytes_total",
+    "kv_transfer_wave_bytes",
+)
+
 
 def _const_str(node: ast.expr | None) -> str | None:
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
@@ -128,6 +139,41 @@ def _snapshot_keys(path: Path) -> set[str] | None:
     return None
 
 
+def _registered_names(path: Path) -> set[str] | None:
+    """Constant metric names registered via .counter()/.gauge()/... calls in
+    one module (None if the module isn't found — partial trees in tests)."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError):
+        return None
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in METHODS and node.args):
+            name = _const_str(node.args[0])
+            if name is not None:
+                names.add(name)
+    return names
+
+
+def _lint_kv_transfer_metrics(root: Path, problems: list[str]) -> None:
+    """The streamed-handoff family must match what disagg/metrics.py
+    actually registers — same no-silent-drift rule as PROVIDER_METRICS."""
+    actual = _registered_names(root / "disagg" / "metrics.py")
+    if actual is None:
+        return
+    declared = set(KV_TRANSFER_METRICS)
+    for key in sorted(actual - declared):
+        problems.append(
+            f"disagg/metrics.py registers {key!r} but it is missing from "
+            "tools/lint_metrics.py KV_TRANSFER_METRICS")
+    for key in sorted(declared - actual):
+        problems.append(
+            f"KV_TRANSFER_METRICS declares {key!r} but disagg/metrics.py "
+            "does not register it")
+
+
 def _lint_provider_metrics(root: Path, problems: list[str]) -> None:
     """The status-provider surface: names must be Prometheus-valid under the
     dynamo_ prefix, and the declared engine list must match what
@@ -162,6 +208,7 @@ def lint_tree(root: Path | None = None) -> list[str]:
             continue
         _lint_module(path, problems)
     _lint_provider_metrics(root, problems)
+    _lint_kv_transfer_metrics(root, problems)
     return problems
 
 

@@ -6,7 +6,11 @@ Reads a span dump — JSONL (one span object per line, the
 default ``/debug/traces`` format) — and prints:
 
 * a per-phase latency table: count / p50 / p95 / max, grouped by span
-  name, durations in milliseconds;
+  name, durations in milliseconds — ``kv.transfer`` spans split by their
+  handoff phase (``kv.transfer/stage|pull|import``, the streamed-wave
+  pipeline; legacy spans fall back to their ``direction`` attr);
+* a streamed-handoff wave summary (waves, bytes, per-transfer tail
+  pulls) when any wave-phase spans are present;
 * the slowest ``request`` spans with their per-phase breakdown so a
   tail-latency outlier can be attributed to queueing vs prefill vs
   decode vs KV transfer at a glance.
@@ -76,11 +80,24 @@ def _pct(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+def _span_key(s: dict) -> str:
+    """Table row key: kv.transfer spans split by handoff phase (the
+    streamed-wave stage/pull/import pipeline) or, for legacy spans,
+    transfer direction."""
+    name = s.get("name", "?")
+    if name == "kv.transfer":
+        attrs = s.get("attrs", {})
+        sub = attrs.get("phase") or attrs.get("direction")
+        if sub:
+            return f"{name}/{sub}"
+    return name
+
+
 def phase_table(spans: list[dict]) -> str:
     by_name: dict[str, list[float]] = defaultdict(list)
     for s in spans:
         dur = max(float(s.get("end", 0)) - float(s.get("start", 0)), 0.0)
-        by_name[s.get("name", "?")].append(dur * 1e3)
+        by_name[_span_key(s)].append(dur * 1e3)
     rows = [("phase", "count", "p50 ms", "p95 ms", "max ms")]
     for name in sorted(by_name):
         vals = sorted(by_name[name])
@@ -94,6 +111,43 @@ def phase_table(spans: list[dict]) -> str:
         if i == 0:
             lines.append("  ".join("-" * w for w in widths))
     return "\n".join(lines)
+
+
+def kv_wave_summary(spans: list[dict]) -> str:
+    """Per-phase wave totals of the streamed KV handoff, plus per-transfer
+    wave counts and how many pulls were tail pulls (issued after the
+    remote prefill ended — the streamed pipeline's miss metric)."""
+    waves = [s for s in spans
+             if s.get("name") == "kv.transfer"
+             and s.get("attrs", {}).get("phase")]
+    if not waves:
+        return ""
+    by_phase: dict[str, list[dict]] = defaultdict(list)
+    for s in waves:
+        by_phase[s["attrs"]["phase"]].append(s)
+    out = ["kv transfer waves:"]
+    for phase in sorted(by_phase):
+        ss = by_phase[phase]
+        total_ms = sum(max(float(s.get("end", 0)) - float(s.get("start", 0)),
+                           0.0) for s in ss) * 1e3
+        nbytes = sum(int(s["attrs"].get("bytes", 0)) for s in ss)
+        blocks = sum(int(s["attrs"].get("blocks", 0)) for s in ss)
+        out.append(f"  {phase:<7s} {len(ss):4d} wave(s)  {blocks:5d} blocks"
+                   f"  {nbytes / 1e6:9.2f} MB  {total_ms:9.2f} ms total")
+    by_xfer: dict[str, list[dict]] = defaultdict(list)
+    for s in waves:
+        xid = s["attrs"].get("xfer_id")
+        if xid:
+            by_xfer[str(xid)].append(s)
+    for xid in sorted(by_xfer):
+        ss = by_xfer[xid]
+        pulls = [s for s in ss if s["attrs"]["phase"] == "pull"]
+        tails = [s for s in pulls if s["attrs"].get("tail")]
+        out.append(f"  xfer {xid[:12]}: "
+                   f"{sum(1 for s in ss if s['attrs']['phase'] == 'stage')}"
+                   f" staged / {len(pulls)} pulled wave(s), "
+                   f"{len(tails)} after prefill end")
+    return "\n".join(out)
 
 
 def slowest_requests(spans: list[dict], top: int) -> str:
@@ -142,6 +196,9 @@ def main(argv: list[str] | None = None) -> int:
     print(f"{len(spans)} spans, "
           f"{len({s.get('trace_id') for s in spans})} traces\n")
     print(phase_table(spans))
+    waves = kv_wave_summary(spans)
+    if waves:
+        print(f"\n{waves}")
     print(f"\nslowest requests (top {args.top}):")
     print(slowest_requests(spans, args.top))
     return 0
