@@ -1,0 +1,438 @@
+"""Chaos harness: drive a mocker fleet through scripted failure scenarios.
+
+Spawns a real coordinator + mocker workers + frontend as subprocesses
+(the zero-accelerator e2e shape of tests/test_e2e_mockers.py), injects
+faults — either by manipulating processes directly (SIGKILL, restart) or
+by shipping a ChaosPlan to the children via ``DYN_CHAOS_PLAN`` /
+``DYN_CHAOS_SEED`` — then drives client load and hands the evidence to
+the :class:`~dynamo_tpu.chaos.invariants.InvariantChecker`.
+
+Scenarios return a :class:`ScenarioResult` whose ``report`` is plain data,
+so ``tools/chaos_run.py`` can print it and the deterministic-replay test
+can compare two runs byte-for-byte. Used by both ``tools/chaos_run.py``
+and ``tests/test_chaos.py`` — the logic lives here so the CLI and the
+pytest suite cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable
+
+from dynamo_tpu.chaos.invariants import (
+    InvariantChecker,
+    InvariantReport,
+    StreamOutcome,
+)
+from dynamo_tpu.chaos.plan import ChaosPlan
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("chaos.harness")
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+_BASE_ENV = {
+    "PYTHONPATH": str(REPO),
+    "PYTHONUNBUFFERED": "1",
+    "JAX_PLATFORMS": "cpu",
+    "PALLAS_AXON_POOL_IPS": "",  # keep the TPU tunnel plugin out of tests
+    "DYN_LOG": "info",
+}
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class Proc:
+    """Subprocess with readiness-line gating + captured logs (the
+    ManagedProcess shape of tests/utils_process.py, importable from the
+    package so tools/chaos_run.py works outside pytest)."""
+
+    def __init__(self, args: list[str], name: str, env: dict | None = None):
+        self.name = name
+        self.args = [sys.executable, "-u", *args]
+        self.env = {**os.environ, **_BASE_ENV, **(env or {})}
+        self.proc: subprocess.Popen | None = None
+        self._lines: list[str] = []
+
+    def start(self) -> "Proc":
+        self.proc = subprocess.Popen(
+            self.args, env=self.env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        threading.Thread(target=self._drain, daemon=True).start()
+        return self
+
+    def _drain(self) -> None:
+        assert self.proc and self.proc.stdout
+        for line in self.proc.stdout:
+            self._lines.append(line)
+
+    def wait_for_line(self, needle: str, timeout: float = 30.0) -> str:
+        deadline = time.time() + timeout
+        scanned = 0
+        while time.time() < deadline:
+            lines = self._lines
+            while scanned < len(lines):
+                if needle in lines[scanned]:
+                    return lines[scanned]
+                scanned += 1
+            if self.proc.poll() is not None and scanned >= len(self._lines):
+                raise RuntimeError(
+                    f"{self.name} exited rc={self.proc.returncode}:\n"
+                    + "".join(self._lines[-50:]))
+            time.sleep(0.02)
+        raise TimeoutError(f"{self.name}: no {needle!r} within {timeout}s:\n"
+                           + "".join(self._lines[-50:]))
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill_hard(self) -> None:
+        if self.alive():
+            self.proc.kill()
+
+    def stop(self, grace: float = 5.0) -> None:
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            self.proc.wait(grace)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(5)
+
+    def logs(self) -> str:
+        return "".join(self._lines)
+
+
+def http_json(url: str, payload: dict | None = None, timeout: float = 30.0,
+              headers: dict | None = None):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"content-type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@dataclass
+class FleetConfig:
+    workers: int = 2
+    router_mode: str = "kv"
+    speedup_ratio: float = 50.0
+    block_size: int = 4
+    num_blocks: int = 128
+    max_model_len: int = 512
+    migration_limit: int = 3
+    lease_ttl_s: float | None = None          # None = runtime default
+    chaos_plan: "ChaosPlan | None" = None     # shipped to WORKERS via env
+    chaos_seed: int | None = None
+    worker_env: dict[str, str] = field(default_factory=dict)
+    frontend_env: dict[str, str] = field(default_factory=dict)
+    worker_args: list[str] = field(default_factory=list)
+
+
+class MockerFleet:
+    """coordinator + N mocker workers + frontend, as real processes."""
+
+    def __init__(self, cfg: FleetConfig):
+        self.cfg = cfg
+        self.coord_port = free_port()
+        self.http_port = free_port()
+        self.coord_url = f"tcp://127.0.0.1:{self.coord_port}"
+        self.base = f"http://127.0.0.1:{self.http_port}"
+        self.coordinator: Proc | None = None
+        self.workers: list[Proc] = []
+        self.frontend: Proc | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def _common_env(self) -> dict[str, str]:
+        env: dict[str, str] = {}
+        if self.cfg.lease_ttl_s is not None:
+            env["DYN_LEASE_TTL_S"] = str(self.cfg.lease_ttl_s)
+        return env
+
+    def _worker_env(self) -> dict[str, str]:
+        env = {**self._common_env(), **self.cfg.worker_env}
+        if self.cfg.chaos_plan is not None:
+            env["DYN_CHAOS_PLAN"] = json.dumps(self.cfg.chaos_plan.to_dict())
+        if self.cfg.chaos_seed is not None:
+            env["DYN_CHAOS_SEED"] = str(self.cfg.chaos_seed)
+        return env
+
+    def start_worker(self, i: int) -> Proc:
+        w = Proc(
+            ["-m", "dynamo_tpu.components.worker", "--engine", "mocker",
+             "--coordinator", self.coord_url,
+             "--block-size", str(self.cfg.block_size),
+             "--speedup-ratio", str(self.cfg.speedup_ratio),
+             "--max-model-len", str(self.cfg.max_model_len),
+             "--num-blocks", str(self.cfg.num_blocks),
+             *self.cfg.worker_args],
+            name=f"worker{i}", env=self._worker_env()).start()
+        return w
+
+    def start(self) -> "MockerFleet":
+        self.coordinator = Proc(
+            ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
+             "--port", str(self.coord_port)], name="coordinator").start()
+        self.coordinator.wait_for_line("COORDINATOR_READY", 20)
+        self.workers = [self.start_worker(i) for i in range(self.cfg.workers)]
+        for w in self.workers:
+            w.wait_for_line("WORKER_READY", 30)
+        self.frontend = Proc(
+            ["-m", "dynamo_tpu.components.frontend",
+             "--coordinator", self.coord_url, "--host", "127.0.0.1",
+             "--port", str(self.http_port),
+             "--router-mode", self.cfg.router_mode,
+             "--migration-limit", str(self.cfg.migration_limit)],
+            name="frontend", env={**self._common_env(),
+                                  **self.cfg.frontend_env}).start()
+        self.frontend.wait_for_line("FRONTEND_READY", 30)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                if http_json(self.base + "/v1/models")["data"]:
+                    return self
+            except Exception:
+                pass
+            time.sleep(0.1)
+        raise TimeoutError("model never discovered:\n" + self.frontend.logs())
+
+    def stop(self) -> None:
+        if self.frontend:
+            self.frontend.stop()
+        for w in self.workers:
+            w.stop()
+        if self.coordinator:
+            self.coordinator.stop()
+
+    def __enter__(self) -> "MockerFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- observation -------------------------------------------------------
+    def metrics_text(self) -> str:
+        with urllib.request.urlopen(self.base + "/metrics", timeout=10) as r:
+            return r.read().decode()
+
+    def engine_stats(self) -> dict:
+        return http_json(self.base + "/engine_stats")
+
+    def wait_drained(self, timeout: float = 20.0) -> dict:
+        """Wait until every published worker snapshot shows an idle engine;
+        returns the final /engine_stats. Published metrics lag ~1s."""
+        deadline = time.time() + timeout
+        stats: dict = {}
+        while time.time() < deadline:
+            stats = self.engine_stats()
+            busy = False
+            for model in stats.values():
+                for m in (model.get("workers") or {}).values():
+                    if (m.get("num_running", 0) or m.get("num_waiting", 0)
+                            or (m.get("kv_usage", 0.0) or 0.0) > 1e-9):
+                        busy = True
+            if not busy:
+                return stats
+            time.sleep(0.3)
+        return stats
+
+    # -- load --------------------------------------------------------------
+    def drive_load(self, n: int = 12, max_tokens: int = 8,
+                   concurrency: int = 4, timeout: float = 30.0,
+                   interval_s: float = 0.0) -> list[StreamOutcome]:
+        """Fire ``n`` completions; classify every outcome for the stream-
+        accounting invariant. An HTTP error status is a TYPED error (the
+        client was told); a transport-level failure or a response without a
+        finish_reason is a LOST stream."""
+
+        def one(i: int) -> StreamOutcome:
+            rid = f"chaos-{i}"
+            if interval_s:
+                time.sleep(interval_s * i)
+            try:
+                r = http_json(self.base + "/v1/completions", {
+                    "model": "tiny-llama",
+                    "prompt": f"chaos prompt {i} " * 4,
+                    "max_tokens": max_tokens, "ignore_eos": True,
+                }, timeout=timeout, headers={"x-request-id": rid})
+                fr = r["choices"][0].get("finish_reason")
+                if fr:
+                    return StreamOutcome(rid, "finished", fr)
+                return StreamOutcome(rid, "lost", "no finish_reason")
+            except urllib.error.HTTPError as exc:
+                return StreamOutcome(rid, "error", f"http {exc.code}")
+            except Exception as exc:  # noqa: BLE001 - transport-level loss
+                return StreamOutcome(rid, "lost", f"{type(exc).__name__}: {exc}")
+
+        with concurrent.futures.ThreadPoolExecutor(concurrency) as ex:
+            return list(ex.map(one, range(n)))
+
+
+@dataclass
+class ScenarioResult:
+    name: str
+    report: InvariantReport
+    outcomes: list[StreamOutcome]
+    seed: int | None = None
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "seed": self.seed,
+                "report": self.report.to_dict(),
+                "outcomes": [o.to_dict() for o in self.outcomes]}
+
+
+def _finish(name: str, fleet: MockerFleet,
+            outcomes: list[StreamOutcome],
+            seed: int | None = None,
+            require_shed_zero: bool = False) -> ScenarioResult:
+    """Shared epilogue: drain, then run every fleet-level invariant."""
+    checker = InvariantChecker()
+    checker.check_streams(outcomes)
+    stats = fleet.wait_drained()
+    checker.check_block_leaks(stats)
+    checker.check_metrics_balance(fleet.metrics_text())
+    if require_shed_zero:
+        from dynamo_tpu.chaos.invariants import metric_sum, parse_prometheus
+
+        shed = metric_sum(parse_prometheus(fleet.metrics_text()),
+                          "dynamo_qos_rejected_total")
+        if shed:
+            checker.report.fail(f"unexpected shedding: {shed:g} rejected")
+    return ScenarioResult(name, checker.finish(), outcomes, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# Scenarios. Each takes a seed so the chaos-plan-driven ones replay exactly.
+# ---------------------------------------------------------------------------
+
+def scenario_smoke(seed: int = 1234) -> ScenarioResult:
+    """Tier-1 smoke (<30s): inject transient dispatch errors + delays into
+    every worker via a seeded plan; Migration must absorb them all."""
+    plan = ChaosPlan.from_dict({"seed": seed, "rules": [
+        # A burst of retryable dispatch failures...
+        {"point": "worker.dispatch", "kind": "error", "rate": 0.3, "count": 4},
+        # ...plus jitter on the mocker step loop (never fatal).
+        {"point": "mocker.step", "kind": "delay", "rate": 0.05,
+         "delay_s": 0.01},
+    ]})
+    cfg = FleetConfig(workers=2, chaos_plan=plan, chaos_seed=seed)
+    with MockerFleet(cfg) as fleet:
+        outcomes = fleet.drive_load(n=10, concurrency=4)
+        return _finish("smoke", fleet, outcomes, seed=seed)
+
+
+def scenario_worker_kill(seed: int = 1234) -> ScenarioResult:
+    """Kill one worker mid-decode (chaos kind=kill after a few dispatches);
+    migration re-dispatches onto the survivor, no stream is lost."""
+    plan = ChaosPlan.from_dict({"seed": seed, "rules": [
+        # the 3rd dispatch on whichever worker gets there first dies hard
+        {"point": "worker.dispatch", "kind": "kill", "rate": 1.0,
+         "count": 1, "after": 2},
+    ]})
+    cfg = FleetConfig(workers=2, chaos_plan=plan, chaos_seed=seed,
+                      lease_ttl_s=3.0, speedup_ratio=10.0)
+    with MockerFleet(cfg) as fleet:
+        outcomes = fleet.drive_load(n=10, max_tokens=24, concurrency=3,
+                                    timeout=60.0, interval_s=0.3)
+        return _finish("worker_kill", fleet, outcomes, seed=seed)
+
+
+def scenario_coordinator_partition(seed: int = 1234) -> ScenarioResult:
+    """Kill + restart the coordinator mid-serving: workers re-register,
+    frontend watches reset+replay, requests succeed throughout recovery."""
+    cfg = FleetConfig(workers=2, lease_ttl_s=3.0)
+    with MockerFleet(cfg) as fleet:
+        pre = fleet.drive_load(n=4, concurrency=2)
+        fleet.coordinator.stop()
+        time.sleep(1.0)
+        fleet.coordinator = Proc(
+            ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
+             "--port", str(fleet.coord_port)], name="coordinator2").start()
+        fleet.coordinator.wait_for_line("COORDINATOR_READY", 20)
+        # data-plane connections survive the partition; serving continues
+        # while control-plane state is re-declared
+        mid = fleet.drive_load(n=4, concurrency=2, timeout=60.0)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                if http_json(fleet.base + "/v1/models")["data"]:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        post = fleet.drive_load(n=4, concurrency=2, timeout=60.0)
+        return _finish("coordinator_partition", fleet, pre + mid + post,
+                       seed=seed)
+
+
+def scenario_lease_expiry_storm(seed: int = 1234) -> ScenarioResult:
+    """Drop every worker's lease keepalives (chaos on transports.keepalive)
+    with a short TTL: leases expire in waves, instances vanish via
+    prefix-watch DELETEs, then re-register on the runtime's reconnect
+    path. Requests riding through the storm must all terminate."""
+    plan = ChaosPlan.from_dict({"seed": seed, "rules": [
+        # every keepalive for ~2 TTLs fails, then the storm passes
+        {"point": "transports.keepalive", "kind": "error", "rate": 1.0,
+         "count": 4},
+    ]})
+    cfg = FleetConfig(workers=2, chaos_plan=plan, chaos_seed=seed,
+                      lease_ttl_s=2.0)
+    with MockerFleet(cfg) as fleet:
+        outcomes = fleet.drive_load(n=12, concurrency=3, timeout=60.0,
+                                    interval_s=0.5)
+        # give re-registration time to settle before the drain check
+        time.sleep(3.0)
+        return _finish("lease_expiry_storm", fleet, outcomes, seed=seed)
+
+
+def scenario_slow_rank_stall(seed: int = 1234) -> ScenarioResult:
+    """One fleet under heavy per-step delay injection (the slow-rank/
+    straggler shape): throughput drops but nothing times out, sheds, or
+    leaks — slowness must degrade latency only."""
+    plan = ChaosPlan.from_dict({"seed": seed, "rules": [
+        {"point": "mocker.step", "kind": "delay", "rate": 0.5,
+         "delay_s": 0.05},
+    ]})
+    cfg = FleetConfig(workers=2, chaos_plan=plan, chaos_seed=seed)
+    with MockerFleet(cfg) as fleet:
+        outcomes = fleet.drive_load(n=8, max_tokens=16, concurrency=4,
+                                    timeout=60.0)
+        return _finish("slow_rank_stall", fleet, outcomes, seed=seed,
+                       require_shed_zero=True)
+
+
+SCENARIOS: dict[str, Callable[[int], ScenarioResult]] = {
+    "smoke": scenario_smoke,
+    "worker_kill": scenario_worker_kill,
+    "coordinator_partition": scenario_coordinator_partition,
+    "lease_expiry_storm": scenario_lease_expiry_storm,
+    "slow_rank_stall": scenario_slow_rank_stall,
+}
+
+
+def run_scenario(name: str, seed: int = 1234) -> ScenarioResult:
+    try:
+        fn = SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r} (one of {sorted(SCENARIOS)})")
+    log.info("chaos scenario %s (seed=%d)", name, seed)
+    return fn(seed)

@@ -200,7 +200,8 @@ class ModelWatcher:
         pipeline = link(
             MapOutput(LLMEngineOutput.from_dict),
             Migration(migration_limit=self.args.migration_limit,
-                      wait_ready=client.wait_for_instances),
+                      wait_ready=client.wait_for_instances,
+                      on_instance_error=client.quarantine),
             sink=routed,
         )
         generate = pipeline.generate
@@ -287,6 +288,17 @@ async def amain(ns: argparse.Namespace) -> None:
         watcher.image_encoder = image_encoder
     await watcher.start()
     svc = HttpService(models, qos=qos_config_from_args(ns))
+    # Recovery counters live next to the request counters they balance
+    # against (InvariantChecker reads both from one /metrics scrape).
+    from dynamo_tpu.frontend.migration import install_migration_metrics
+
+    install_migration_metrics(svc.metrics)
+    from dynamo_tpu import chaos
+
+    if chaos.enabled():
+        from dynamo_tpu.chaos.metrics import install_chaos_metrics
+
+        install_chaos_metrics(svc.metrics)
     port = await svc.start(ns.host, ns.port,
                            tls_cert=ns.tls_cert, tls_key=ns.tls_key)
     grpc_srv = None

@@ -13,6 +13,7 @@ import asyncio
 import json
 import signal
 
+from dynamo_tpu import chaos
 from dynamo_tpu.protocols.common import (
     FinishReason,
     LLMEngineOutput,
@@ -77,6 +78,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="idle seconds before a health canary replays through "
                         "the handler (reference: health_check.rs); 0 disables")
     p.add_argument("--wedgeable", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--chaos-plan", default=None,
+                   help="enable deterministic fault injection: a ChaosPlan "
+                        "YAML/JSON file path or inline JSON (docs/CHAOS.md); "
+                        "equivalent to DYN_CHAOS_PLAN")
+    p.add_argument("--chaos-seed", type=int, default=None,
+                   help="override the chaos plan's seed (DYN_CHAOS_SEED)")
     p.add_argument("--host-kv-blocks", type=int, default=0, help="G2 host KV tier capacity")
     p.add_argument("--disk-kv-path", default=None, help="G3 disk KV tier directory")
     p.add_argument("--remote-kv-addr", default=None,
@@ -140,9 +147,16 @@ async def amain(ns: argparse.Namespace) -> None:
         if ns.served_model_name is None:
             ns.served_model_name = ns.model
         ns.model = resolve_model_path(ns.model)
+    if ns.chaos_plan is not None:
+        # CLI mirror of DYN_CHAOS_PLAN/DYN_CHAOS_SEED (docs/CHAOS.md).
+        chaos.configure(ns.chaos_plan, seed=ns.chaos_seed)
     cfg = RuntimeConfig.from_settings(coordinator_url=ns.coordinator)
     rt = await DistributedRuntime.create(cfg)
     assert rt.client is not None and rt.primary_lease is not None
+    if chaos.enabled():
+        from dynamo_tpu.chaos.metrics import install_chaos_metrics
+
+        install_chaos_metrics(rt.metrics)
 
     # Multi-host SPMD engine: all ranks join one jax.distributed group and
     # form ONE global mesh; rank 0 serves, others replay its op stream
@@ -406,6 +420,21 @@ async def amain(ns: argparse.Namespace) -> None:
             async for item in inner_handler(payload, ctx):
                 yield item
 
+    if chaos.enabled():
+        # Fault point covering EVERY dispatch path (agg, prefill, decode,
+        # wedgeable) — wrapped here, under the health monitor, so canaries
+        # exercise the same injected failures real traffic does. Only built
+        # when a plan is active: the disabled path adds no generator layer.
+        chaos_inner = handler
+
+        async def handler(payload: dict, ctx: RequestContext):  # noqa: F811
+            await chaos.ainject(
+                "worker.dispatch", endpoint=ns.endpoint,
+                request_id=payload.get("request_id")
+                if isinstance(payload, dict) else None)
+            async for item in chaos_inner(payload, ctx):
+                yield item
+
     # Health canaries (reference: lib/runtime/src/health_check.rs:20-36):
     # replay a tiny generate through the SAME handler when idle; a wedged
     # engine flips ready=False in the published metrics and the KV router
@@ -416,8 +445,10 @@ async def amain(ns: argparse.Namespace) -> None:
             EndpointHealthMonitor,
             HealthCheckConfig,
             default_canary_payload,
+            install_health_metrics,
         )
 
+        install_health_metrics(rt.metrics)
         monitor = EndpointHealthMonitor(handler, HealthCheckConfig(
             payload=default_canary_payload(),
             idle_interval_s=ns.health_interval,

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import time
 
+from dynamo_tpu import chaos
 from dynamo_tpu.engine.engine import AsyncJaxEngine
 from dynamo_tpu.utils.logging import get_logger
 
@@ -54,6 +55,9 @@ async def pull_and_import(engine: AsyncJaxEngine, params: dict) -> int:
     conditional-disagg fallback fires; a 0 return is a SUCCESSFUL pull
     whose blocks were all already device-resident.
     """
+    # Chaos: an error here surfaces exactly like a voted-down pull — the
+    # caller's conditional-disagg fallback (local prefill) must fire.
+    await chaos.ainject("disagg.import", xfer_id=params["xfer_id"])
     # Two replayed ops: the prefetch starts the network fetch on a
     # background thread on every rank (engine steps keep running while
     # bytes move); the import joins it, votes, and injects.

@@ -43,6 +43,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from dynamo_tpu import chaos
 from dynamo_tpu.parallel.multihost import recv_frame, send_frame
 from dynamo_tpu.utils.logging import get_logger
 
@@ -405,6 +406,10 @@ class ShardClient:
 
     def _fetch_once(self, conn: socket.socket, xfer_id: str, box: Box,
                     start: int | None, stop: int | None):
+        # Chaos: injected disconnect/error lands inside fetch()'s retry loop
+        # (ChaosInjectedError is a ConnectionError, i.e. retryable OSError)
+        # — the mid-wave shard-death scenario without killing a real server.
+        chaos.inject("disagg.pull", addr=self.addr, xfer_id=xfer_id)
         req = {"xfer_id": xfer_id, "ls": box[0], "le": box[1],
                "hs": box[2], "he": box[3]}
         if start is not None:

@@ -30,6 +30,7 @@ import time
 import uuid
 from dataclasses import dataclass
 
+from dynamo_tpu import chaos
 from dynamo_tpu.engine.engine import AsyncJaxEngine
 from dynamo_tpu.utils.logging import get_logger
 
@@ -100,6 +101,7 @@ class KvTransferSource:
         one block)."""
         if not seq_hashes:
             return None
+        await chaos.ainject("disagg.stage", blocks=len(seq_hashes))
         shards = self._ensure_shards()
         xid = uuid.uuid4().hex
         covered_n = await self.engine.run_op(
@@ -144,6 +146,7 @@ class KvTransferSource:
         endpoints) or None for an empty chain."""
         if not seq_hashes:
             return None
+        await chaos.ainject("disagg.stage", blocks=len(seq_hashes))
         shards = self._ensure_shards()
         self._ensure_stream_listener()
         xid = uuid.uuid4().hex

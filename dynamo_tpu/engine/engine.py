@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dynamo_tpu import chaos
 from dynamo_tpu.engine.cache import KVCacheSpec, allocate_cache
 from dynamo_tpu.engine.prefix_pool import PrefixPool
 from dynamo_tpu.engine.sampling import (
@@ -2012,6 +2013,10 @@ class AsyncJaxEngine:
                     self._wake.clear()
                 continue
             try:
+                # Chaos: inside the try so an error-kind injection exercises
+                # the engine-fatal path (fail_all + drain), and a delay is a
+                # straggling device step.
+                chaos.inject("engine.step")
                 if self.core.has_work() or pending is not None:
                     t_step = time.time()
                     if self.core.has_expired_waiting(t_step):

@@ -6,21 +6,67 @@ docs/architecture/request_migration.md): if a worker dies mid-generation,
 re-dispatch the request to a new worker with the already-generated tokens
 appended to the prompt (KV rebuilds via prefix cache or recompute), up to
 ``migration_limit`` times. The client stream never sees the failure.
+
+Recovery discipline on each retry:
+
+- the failing worker (``StreamError.instance_id``) is quarantined via
+  ``on_instance_error`` so the re-dispatch can't race the lease-expiry
+  watch and re-pick the dead instance;
+- the QoS deadline is re-checked — a request that blew its deadline while
+  broken is finished with a typed ``cancelled`` delta, not resurrected;
+- the retried request keeps the ``obs.traceparent`` annotation (retried
+  spans join the original trace) and stamps ``migration.attempt``.
 """
 
 from __future__ import annotations
 
 from typing import AsyncIterator, Awaitable, Callable
 
-from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.protocols.common import FinishReason, PreprocessedRequest
+from dynamo_tpu.qos.deadline import deadline_of, expired
 from dynamo_tpu.runtime.client import NoInstancesError, StreamError
 from dynamo_tpu.runtime.pipeline import NextFn, Operator
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.metrics import MetricsRegistry
 
 log = get_logger("migration")
 
 # A routed generate: request -> stream of LLMEngineOutput dicts.
 RoutedGenerate = Callable[[PreprocessedRequest], AsyncIterator[dict]]
+
+MIGRATION_ATTEMPT_KEY = "migration.attempt"
+
+
+class MigrationMetrics:
+    """dynamo_migration_attempts_total (cross-checked by
+    tools/lint_metrics.py RECOVERY_METRICS)."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.bind(registry or MetricsRegistry())
+
+    def bind(self, registry: MetricsRegistry) -> None:
+        self.registry = registry
+        self.attempts = registry.counter(
+            "migration_attempts_total",
+            "Request re-dispatch attempts after a broken worker stream, "
+            "by outcome (retried|exhausted|deadline)")
+
+
+_metrics: MigrationMetrics | None = None
+
+
+def get_migration_metrics() -> MigrationMetrics:
+    global _metrics
+    if _metrics is None:
+        _metrics = MigrationMetrics()
+    return _metrics
+
+
+def install_migration_metrics(registry: MetricsRegistry) -> MigrationMetrics:
+    """Re-home the singleton into the frontend's registry (/metrics)."""
+    m = get_migration_metrics()
+    m.bind(registry)
+    return m
 
 
 class Migration(Operator):
@@ -30,10 +76,14 @@ class Migration(Operator):
 
     def __init__(self, inner: RoutedGenerate | None = None,
                  migration_limit: int = 3,
-                 wait_ready: Callable[[float], Awaitable[None]] | None = None):
+                 wait_ready: Callable[[float], Awaitable[None]] | None = None,
+                 on_instance_error: Callable[[int], None] | None = None):
         self.inner = inner
         self.migration_limit = migration_limit
         self.wait_ready = wait_ready  # e.g. EndpointClient.wait_for_instances
+        # e.g. EndpointClient.quarantine: sideline the failing worker NOW
+        # rather than waiting out its lease TTL.
+        self.on_instance_error = on_instance_error
 
     async def generate(self, req: PreprocessedRequest,
                        next: NextFn | None = None) -> AsyncIterator[dict]:
@@ -62,10 +112,30 @@ class Migration(Operator):
                     # END frame lost). Re-dispatching would emit duplicate
                     # tokens after the finish chunk.
                     return
+                iid = getattr(exc, "instance_id", None)
+                if iid is not None and self.on_instance_error is not None:
+                    try:
+                        self.on_instance_error(iid)
+                    except Exception:  # noqa: BLE001 - advisory only
+                        log.exception("instance-error callback failed")
                 attempts += 1
                 if attempts > self.migration_limit:
+                    get_migration_metrics().attempts.inc(outcome="exhausted")
                     log.warning("migration limit reached for %s: %s", req.request_id, exc)
                     raise
+                # Don't resurrect a request that already blew its QoS
+                # deadline: finish the stream with a TYPED cancellation
+                # (the worker-side mid-stream enforcement can't fire for a
+                # request that is between workers).
+                if expired(deadline_of(req.annotations)):
+                    get_migration_metrics().attempts.inc(outcome="deadline")
+                    log.info("not migrating %s: deadline expired after %s",
+                             req.request_id, exc)
+                    yield {"token_ids": [],
+                           "finish_reason": str(FinishReason.CANCELLED),
+                           "error": "deadline exceeded during migration"}
+                    return
+                get_migration_metrics().attempts.inc(outcome="retried")
                 log.info("migrating request %s (attempt %d/%d): %s",
                          req.request_id, attempts, self.migration_limit, exc)
                 # Back off so retries span the lease-expiry window — dead
@@ -85,6 +155,11 @@ class Migration(Operator):
                 new_req = PreprocessedRequest.from_dict(req.to_dict())
                 new_req.request_id = req.request_id
                 new_req.token_ids = list(req.token_ids) + generated
+                # Annotations round-trip through to_dict, which keeps the
+                # obs.traceparent — retried worker spans join the ORIGINAL
+                # trace; the attempt number marks them as a migration leg.
+                new_req.annotations = dict(req.annotations or {})
+                new_req.annotations[MIGRATION_ATTEMPT_KEY] = attempts
                 orig_max = req.stop_conditions.max_tokens
                 if orig_max is not None:
                     new_req.stop_conditions.max_tokens = max(orig_max - len(generated), 1)

@@ -746,7 +746,12 @@ class HttpService:
         try:
             outs = await self._collect_outputs(entry, pre, req.model, t_start,
                                                root=root, ttft_span=ttft_span)
-        except RuntimeError as exc:  # engine error surfaced mid-stream
+        # RuntimeError: engine error surfaced mid-stream (StreamError,
+        # NoInstancesError). ConnectionError/OSError: the data plane itself
+        # died and Migration exhausted its retries re-raising the original —
+        # every admitted request must still end in a terminal 500, not an
+        # unrecorded propagation (the chaos balance invariant).
+        except (RuntimeError, ConnectionError, OSError) as exc:
             self._requests.inc(route=route, status="500")
             if chat and self._audit.bus() is not None:
                 # Anomalous requests are exactly what a compliance log
@@ -963,6 +968,22 @@ class HttpService:
             if root is not None:
                 root.attrs["_cancelled"] = True
             self._requests.inc(route="chat" if chat else "completions", status="499")
+        except Exception as exc:  # noqa: BLE001 - backend died mid-stream
+            # Headers are already sent, so the client can't get an HTTP 500 —
+            # but the request still needs a TERMINAL status (every admitted
+            # request must end in exactly one of 200/499/500; the chaos
+            # invariant checker holds us to it) and the client a typed error
+            # event instead of a silently truncated stream. Migration
+            # exhaustion (worker killed repeatedly) lands here.
+            log.warning("stream failed mid-flight for %s: %s: %s",
+                        pre.request_id, type(exc).__name__, exc)
+            audit_error = audit_error or str(exc)
+            try:
+                await resp.write(encode_sse_json(
+                    {"error": {"message": str(exc), "code": 500}}))
+            except (ConnectionError, RuntimeError):
+                pass  # client is gone too; the counter below still ticks
+            self._requests.inc(route="chat" if chat else "completions", status="500")
         finally:
             # Deterministic teardown: close the generation stream NOW (not at
             # GC) so a disconnect-abort reaches the engine/worker while this

@@ -22,6 +22,7 @@ from typing import Callable
 
 import numpy as np
 
+from dynamo_tpu import chaos
 from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
 from dynamo_tpu.kvbm.transfer import BlockTransferEngine
@@ -150,6 +151,9 @@ class OffloadManager:
         inject_and_commit."""
         if not self._pending:
             return 0
+        # Chaos: an error here propagates into the engine step — the
+        # offload cascade failing is engine-fatal, not silently droppable.
+        chaos.inject("kvbm.offload", blocks=len(self._pending))
         pending, self._pending = self._pending, []
         blocks = self.transfer.extract(
             self.runner.cache_k, self.runner.cache_v, [b for b, _ in pending]

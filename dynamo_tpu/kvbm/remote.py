@@ -38,6 +38,7 @@ from collections import OrderedDict
 import msgpack
 import numpy as np
 
+from dynamo_tpu import chaos
 from dynamo_tpu.engine.cache import KVCacheSpec
 from dynamo_tpu.kvbm.pools import TierStats, block_dtype, block_shape
 from dynamo_tpu.utils.logging import get_logger
@@ -204,6 +205,9 @@ class RemoteBlockPool:
                 return None
             for attempt in (0, 1):
                 try:
+                    # Chaos: injected ConnectionError takes the same path as
+                    # a dead store — reconnect once, then open the breaker.
+                    chaos.inject("kvbm.remote", op=msg.get("op"))
                     if self._sock is None:
                         self._sock = self._connect()
                     payload = msgpack.packb(msg, use_bin_type=True)

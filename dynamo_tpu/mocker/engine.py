@@ -17,6 +17,7 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Callable
 
+from dynamo_tpu import chaos
 from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
 from dynamo_tpu.obs.tracer import get_tracer, trace_context_of
@@ -166,6 +167,10 @@ class MockEngine:
                 self._wake.clear()
                 await self._wake.wait()
                 continue  # re-check wedged before serving the wake-up work
+            # Chaos: a delay here is a slow engine step (stragglers); an
+            # error kills the step loop — the wedged-engine failure canaries
+            # are built to catch.
+            await chaos.ainject("mocker.step", running=len(self.running))
             # reap cancelled
             for seq in [s for s in self.running if s.done]:
                 self._finish(seq, None)

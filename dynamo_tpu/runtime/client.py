@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, AsyncIterator
 
+from dynamo_tpu import chaos
 from dynamo_tpu.runtime.protocols import EndpointId, Instance
 from dynamo_tpu.runtime.runtime import DistributedRuntime
 from dynamo_tpu.transports.wire import Frame, MsgpackConnection
@@ -39,7 +40,17 @@ class NoInstancesError(RuntimeError):
 
 
 class StreamError(RuntimeError):
-    pass
+    """A response stream broke (worker ERR frame or lost connection).
+
+    Carries the ``instance_id`` that was serving the stream (when known) so
+    recovery layers can act on the FAILING worker — Migration quarantines
+    it before re-dispatch instead of racing the lease-expiry watch and
+    re-picking the same dead instance."""
+
+    def __init__(self, message: str = "stream error",
+                 instance_id: int | None = None):
+        super().__init__(message)
+        self.instance_id = instance_id
 
 
 class _WorkerConnection:
@@ -73,6 +84,7 @@ class _WorkerConnection:
 
     async def call(self, endpoint: str, payload: Any, request_id: str,
                    headers: dict | None = None) -> AsyncIterator[Any]:
+        await chaos.ainject("runtime.client.call", endpoint=endpoint)
         sid = next(self._ids)
         q: asyncio.Queue = asyncio.Queue()
         self._streams[sid] = q
@@ -164,8 +176,18 @@ class EndpointClient:
         only a lease expiry actually removes it)."""
         return sorted(self.instances)
 
+    def quarantine(self, instance_id: int, duration_s: float = 10.0) -> None:
+        """Skip ``instance_id`` in routing for ``duration_s`` (or until it
+        re-registers, whichever comes first). Called on connect failures and
+        by Migration when a stream dies on a specific worker — routing away
+        immediately instead of racing the lease-expiry watch."""
+        self._quarantine[instance_id] = (
+            asyncio.get_event_loop().time() + duration_s)
+        log.info("instance %x quarantined for %.1fs", instance_id, duration_s)
+
     # ------------------------------------------------------------------
     async def _connect(self, inst: Instance) -> _WorkerConnection:
+        await chaos.ainject("runtime.client.connect", address=inst.address)
         wc = self._conns.get(inst.address)
         if wc is not None and wc.alive:
             return wc
@@ -182,12 +204,19 @@ class EndpointClient:
         try:
             wc = await self._connect(inst)
         except OSError:
-            self._quarantine[instance_id] = asyncio.get_running_loop().time() + 10.0
-            log.info("instance %x unreachable; quarantined 10s", instance_id)
+            self.quarantine(instance_id)
+            log.info("instance %x unreachable", instance_id)
             raise
         target = f"{self.endpoint.namespace}.{self.endpoint.component}.{self.endpoint.endpoint}"
-        async for item in wc.call(target, payload, request_id or uuid.uuid4().hex):
-            yield item
+        try:
+            async for item in wc.call(target, payload, request_id or uuid.uuid4().hex):
+                yield item
+        except StreamError as exc:
+            # Stamp the failing worker so recovery (Migration) can act on
+            # it; wire-level ERR frames can't know their own instance.
+            if exc.instance_id is None:
+                exc.instance_id = instance_id
+            raise
 
     async def close(self) -> None:
         if self._watch_task:

@@ -25,8 +25,43 @@ from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable
 
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.metrics import MetricsRegistry
 
 log = get_logger("health")
+
+
+class HealthMetrics:
+    """dynamo_health_canary_{total,failures} (cross-checked by
+    tools/lint_metrics.py RECOVERY_METRICS). Singleton + install idiom of
+    disagg/metrics.py: workers re-home it into their runtime registry."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.bind(registry or MetricsRegistry())
+
+    def bind(self, registry: MetricsRegistry) -> None:
+        self.registry = registry
+        self.canary_total = registry.counter(
+            "health_canary_total",
+            "Health-check canary payloads replayed through idle endpoints")
+        self.canary_failures = registry.counter(
+            "health_canary_failures",
+            "Canary replays that failed (endpoint flipped NotReady)")
+
+
+_metrics: HealthMetrics | None = None
+
+
+def get_health_metrics() -> HealthMetrics:
+    global _metrics
+    if _metrics is None:
+        _metrics = HealthMetrics()
+    return _metrics
+
+
+def install_health_metrics(registry: MetricsRegistry) -> HealthMetrics:
+    m = get_health_metrics()
+    m.bind(registry)
+    return m
 
 
 @dataclass
@@ -115,9 +150,11 @@ class EndpointHealthMonitor:
             async for _ in self._handler(payload, _CanaryContext()):
                 pass
 
+        get_health_metrics().canary_total.inc()
         try:
             await asyncio.wait_for(drive(), self.config.timeout_s)
         except Exception as exc:
+            get_health_metrics().canary_failures.inc()
             if self.ready:
                 log.warning("canary %s failed (%s: %s): endpoint NotReady",
                             rid, type(exc).__name__, exc)

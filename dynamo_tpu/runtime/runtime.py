@@ -105,6 +105,10 @@ class DistributedRuntime:
             rt.config.coordinator_url, auto_reconnect=True)
         rt.client.on_reconnected.append(rt._restore_registrations)
         rt.primary_lease = await rt.client.lease_grant(ttl=rt.config.lease_ttl_s)
+        # Lease death WITHOUT a connection outage (keepalive starvation /
+        # expiry storm) also means every lease-bound key is gone — recover
+        # through the same re-declaration path as a reconnect.
+        rt.primary_lease.on_lost = rt._restore_registrations
         # Coordinator lease ids are server-unique — mixing one in makes
         # instance ids collision-free even for runtimes created in the same
         # millisecond in the same process.
@@ -180,11 +184,17 @@ class DistributedRuntime:
                          "registrations intact", self.primary_lease.id)
                 return
             # Lease is gone (expired, or the coordinator restarted): stop
-            # the orphaned keepalive and re-declare everything fresh.
-            if self.primary_lease._task:
+            # the orphaned keepalive and re-declare everything fresh. When
+            # on_lost delivered us FROM that keepalive task, cancelling it
+            # would abort this very restore mid-flight (new lease granted but
+            # never re-put, never kept alive) — the loop returns on its own
+            # after the callback, so only cancel a foreign task.
+            if (self.primary_lease._task is not None
+                    and self.primary_lease._task is not asyncio.current_task()):
                 self.primary_lease._task.cancel()
         self.primary_lease = await self.client.lease_grant(
             ttl=self.config.lease_ttl_s)
+        self.primary_lease.on_lost = self._restore_registrations
         import dataclasses as _dc
 
         for served in self._served.values():

@@ -13,6 +13,7 @@ import itertools
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Awaitable, Callable
 
+from dynamo_tpu import chaos
 from dynamo_tpu.transports.wire import Frame, MsgpackConnection
 from dynamo_tpu.utils.logging import get_logger
 
@@ -107,6 +108,11 @@ class Lease:
     id: int
     ttl: float
     _task: asyncio.Task | None = None
+    # Fired (once) when the server reports the lease dead while the
+    # connection itself is healthy — expiry under keepalive loss, NOT a
+    # connection outage (that path runs through on_reconnected). The owner
+    # re-grants and re-declares its lease-bound keys here.
+    on_lost: Callable[[], Awaitable[None]] | None = None
 
     async def revoke(self, client: "CoordinatorClient") -> None:
         if self._task:
@@ -154,6 +160,7 @@ class CoordinatorClient:
         return client
 
     async def _dial(self, retries: int = 30, delay: float = 0.2) -> None:
+        await chaos.ainject("transports.dial", url=self.url)
         if self._conn is not None:
             self._conn.close()  # never leak a half-dead connection
         host, port = parse_url(self.url)
@@ -323,6 +330,7 @@ class CoordinatorClient:
 
 
     async def _request(self, body: dict) -> dict:
+        await chaos.ainject("transports.request", op=body.get("op"))
         if self._conn is None or not self._connected:
             # Fail fast during an outage: callers see the same error shape
             # as a mid-flight loss and apply their own retry policy.
@@ -379,11 +387,27 @@ class CoordinatorClient:
         while True:
             await asyncio.sleep(interval)
             try:
+                await chaos.ainject("transports.keepalive", lease_id=lease.id)
                 ok = (await self._request(
                     {"op": "lease_keepalive", "lease_id": lease.id})).get("alive")
                 if not ok:
+                    # Expired while the CONNECTION is healthy (keepalive
+                    # starvation, e.g. a GIL-holding stall or injected
+                    # drops): connection-loss recovery never fires, so tell
+                    # the owner directly — it re-grants and re-declares.
                     log.warning("lease %d no longer alive", lease.id)
+                    if lease.on_lost is not None:
+                        cb, lease.on_lost = lease.on_lost, None
+                        try:
+                            await cb()
+                        except Exception:
+                            log.exception("lease on_lost callback failed")
                     return
+            except ConnectionError:
+                # A dropped keepalive (injected or transient network fault)
+                # must not kill the loop — the lease survives until TTL, and
+                # the next tick may well get through.
+                continue
             except CoordinatorError:
                 return
 

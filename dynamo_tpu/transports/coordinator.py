@@ -29,6 +29,7 @@ import time
 import uuid
 from dataclasses import dataclass, field
 
+from dynamo_tpu import chaos
 from dynamo_tpu.transports.wire import Frame, MsgpackConnection
 from dynamo_tpu.utils.logging import get_logger
 
@@ -291,6 +292,10 @@ class CoordinatorServer:
                 if msg.get("t") == Frame.PING:
                     await session.conn.send({"t": Frame.PONG})
                     continue
+                # Chaos: a raise here tears down THIS session (finally
+                # below) — a one-client partition; clients must reconnect
+                # and replay their watches/registrations.
+                await chaos.ainject("coordinator.conn", op=msg.get("op"))
                 task = asyncio.ensure_future(self._handle(session, msg))
                 self._handler_tasks.add(task)
                 task.add_done_callback(self._handler_tasks.discard)

@@ -56,6 +56,8 @@ def pytest_pyfunc_call(pyfuncitem):
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: async test (handled by conftest)")
     config.addinivalue_line("markers", "slow: multi-process e2e tests")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection tests (deterministic seed)")
 
 
 @pytest.fixture(scope="session")
@@ -63,3 +65,16 @@ def cpu_devices():
     import jax
 
     return jax.devices()
+
+
+@pytest.fixture
+def chaos_seed():
+    """Deterministic seed for chaos tests, overridable for replay debugging:
+    DYN_CHAOS_SEED=1234 pytest -m chaos reruns every scenario with the
+    failing seed. Always resets the in-process chaos engine afterwards so a
+    configured plan can never leak into unrelated tests."""
+    from dynamo_tpu import chaos
+
+    seed = int(os.environ.get(chaos.SEED_ENV, "42"))
+    yield seed
+    chaos.reset()

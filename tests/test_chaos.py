@@ -1,0 +1,486 @@
+"""Chaos subsystem tests: plan DSL, deterministic seeded injection, the
+invariant checker, recovery paths under injected faults, and the tier-1
+mocker-fleet smoke scenario (heavier scenarios are marked slow).
+
+Every test that configures the in-process chaos engine uses the
+``chaos_seed`` fixture, which resets the engine afterwards so a plan can
+never leak into unrelated tests.
+"""
+
+import asyncio
+import contextlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu import chaos
+from dynamo_tpu.chaos.injector import ChaosInjectedError
+from dynamo_tpu.chaos.invariants import (
+    InvariantChecker,
+    StreamOutcome,
+    metric_sum,
+    parse_prometheus,
+)
+from dynamo_tpu.chaos.plan import ChaosPlan, ChaosRule
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------------------
+# Plan DSL
+# ---------------------------------------------------------------------------
+
+def test_plan_dict_roundtrip():
+    plan = ChaosPlan.from_dict({"seed": 9, "rules": [
+        {"point": "worker.*", "kind": "error", "rate": 0.5, "count": 2},
+        {"point": "disagg.pull", "kind": "delay", "delay_s": 0.2,
+         "match": {"addr": "x:1"}},
+    ]})
+    again = ChaosPlan.from_dict(plan.to_dict())
+    assert again == plan
+    assert again.rules[1].delay_s == 0.2
+    assert again.rules[1].match == {"addr": "x:1"}
+
+
+def test_plan_load_yaml_file_and_inline_json(tmp_path):
+    p = tmp_path / "plan.yaml"
+    p.write_text("seed: 3\nrules:\n  - point: mocker.step\n    kind: delay\n"
+                 "    rate: 0.1\n    delay_s: 0.01\n")
+    plan = ChaosPlan.load(str(p))
+    assert plan.seed == 3
+    assert plan.rules[0].point == "mocker.step"
+
+    inline = ChaosPlan.load(
+        '{"seed": 4, "rules": [{"point": "a", "kind": "error"}]}')
+    assert inline.seed == 4 and inline.rules[0].kind == "error"
+
+
+def test_plan_validation_rejects_bad_specs():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        ChaosRule(point="x", kind="explode")
+    with pytest.raises(ValueError, match="rate"):
+        ChaosRule(point="x", kind="error", rate=1.5)
+    with pytest.raises(ValueError, match="unknown ChaosRule keys"):
+        ChaosRule.from_dict({"point": "x", "kind": "error", "probability": 1})
+
+
+# ---------------------------------------------------------------------------
+# Deterministic injection
+# ---------------------------------------------------------------------------
+
+def _drive(plan: dict, point: str, hits: int) -> list[tuple]:
+    chaos.configure(plan)
+    for _ in range(hits):
+        with contextlib.suppress(ConnectionError):
+            chaos.inject(point)
+    return chaos.injection_log()
+
+
+def test_same_seed_replays_identical_fault_sequence(chaos_seed):
+    plan = {"seed": chaos_seed, "rules": [
+        {"point": "worker.*", "kind": "error", "rate": 0.3},
+        {"point": "worker.*", "kind": "disconnect", "rate": 0.2},
+    ]}
+    log1 = _drive(plan, "worker.dispatch", 50)
+    log2 = _drive(plan, "worker.dispatch", 50)
+    assert log1 and log1 == log2
+    assert _drive({**plan, "seed": chaos_seed + 1},
+                  "worker.dispatch", 50) != log1
+
+
+def test_points_have_independent_schedules(chaos_seed):
+    """One RNG per point (sha256(seed, point)): traffic on point A never
+    shifts point B's schedule."""
+    plan = {"seed": chaos_seed, "rules": [
+        {"point": "*", "kind": "error", "rate": 0.3},
+    ]}
+    chaos.configure(plan)
+    for _ in range(30):
+        with contextlib.suppress(ConnectionError):
+            chaos.inject("b.point")
+    solo = [k for k in chaos.injection_log() if k[1] == "b.point"]
+
+    chaos.configure(plan)
+    for i in range(30):
+        with contextlib.suppress(ConnectionError):
+            chaos.inject("a.point")  # interleaved traffic on another point
+        with contextlib.suppress(ConnectionError):
+            chaos.inject("b.point")
+    mixed = [k for k in chaos.injection_log() if k[1] == "b.point"]
+    # seq numbers differ (global ordering), but point/kind/rule/hit agree
+    assert [k[1:] for k in solo] == [k[1:] for k in mixed]
+
+
+def test_exhausted_rule_does_not_shift_later_rules(chaos_seed):
+    """Exactly one RNG draw per hit, eligible or not: a bounded first rule
+    running out must not change WHICH hits the next rule fires on."""
+    base_rule = {"point": "p", "kind": "disconnect", "rate": 0.4}
+    with_cap = {"seed": chaos_seed, "rules": [
+        {"point": "p", "kind": "error", "rate": 1.0, "count": 3},
+        dict(base_rule),
+    ]}
+    alone = {"seed": chaos_seed, "rules": [dict(base_rule)]}
+    capped_log = _drive(with_cap, "p", 40)
+    alone_log = _drive(alone, "p", 40)
+    # hits 1..3 go to the capped rule; afterwards the disconnect rule must
+    # fire on exactly the hits it fires on when it is the only rule
+    assert [k[4] for k in capped_log if k[2] == "disconnect"] == \
+        [k[4] for k in alone_log if k[4] > 3]
+
+
+def test_fault_kind_exception_types(chaos_seed):
+    chaos.configure({"seed": chaos_seed, "rules": [
+        {"point": "err", "kind": "error", "message": "boom"},
+        {"point": "disc", "kind": "disconnect"},
+    ]})
+    with pytest.raises(ChaosInjectedError, match="boom") as ei:
+        chaos.inject("err")
+    # retryable by every ConnectionError/OSError recovery path
+    assert isinstance(ei.value, ConnectionError)
+    assert ei.value.point == "err"
+    with pytest.raises(ConnectionResetError):
+        chaos.inject("disc")
+
+
+async def test_async_delay_and_error(chaos_seed):
+    chaos.configure({"seed": chaos_seed, "rules": [
+        {"point": "d", "kind": "delay", "delay_s": 0.05},
+        {"point": "e", "kind": "error"},
+    ]})
+    t0 = time.monotonic()
+    await chaos.ainject("d")
+    assert time.monotonic() - t0 >= 0.04
+    with pytest.raises(ChaosInjectedError):
+        await chaos.ainject("e")
+
+
+def test_after_count_and_match(chaos_seed):
+    chaos.configure({"seed": chaos_seed, "rules": [
+        {"point": "p", "kind": "error", "rate": 1.0, "after": 2, "count": 2,
+         "match": {"op": "put"}},
+    ]})
+    fired = []
+    for i in range(10):
+        try:
+            chaos.inject("p", op="put" if i % 2 == 0 else "get")
+        except ChaosInjectedError:
+            fired.append(i)
+    # `after` counts point-local hits: hits 1..2 (i=0,1) pass untouched;
+    # then only op=put hits are eligible (i even) and count=2 caps it
+    assert fired == [2, 4]
+
+
+def test_disabled_is_noop_and_env_activation():
+    chaos.reset()
+    assert not chaos.enabled()
+    chaos.inject("anything")                       # must not raise
+    assert chaos.injection_log() == []
+    assert chaos.configure_from_env({}) is None    # unset → stays off
+    eng = chaos.configure_from_env({
+        chaos.PLAN_ENV: '{"seed": 5, "rules": [{"point": "x", "kind": "error"}]}',
+        chaos.SEED_ENV: "77",
+    })
+    assert eng is not None and eng.plan.seed == 77  # env seed wins
+    chaos.reset()
+
+
+def test_injection_increments_chaos_metric(chaos_seed):
+    from dynamo_tpu.chaos.metrics import get_chaos_metrics
+
+    chaos.configure({"seed": chaos_seed, "rules": [
+        {"point": "m", "kind": "error", "count": 1}]})
+    with pytest.raises(ChaosInjectedError):
+        chaos.inject("m")
+    text = get_chaos_metrics().registry.expose()
+    assert 'dynamo_chaos_injected_total{kind="error",point="m"}' in text \
+        or 'dynamo_chaos_injected_total{point="m",kind="error"}' in text
+
+
+# ---------------------------------------------------------------------------
+# InvariantChecker
+# ---------------------------------------------------------------------------
+
+def test_invariant_streams():
+    ok = InvariantChecker()
+    ok.check_streams([StreamOutcome("a", "finished", "stop"),
+                      StreamOutcome("b", "error", "http 500")])
+    assert ok.finish().passed
+    bad = InvariantChecker()
+    bad.check_streams([StreamOutcome("c", "lost", "socket timeout")])
+    rep = bad.finish()
+    assert not rep.passed and "stream lost" in rep.failures[0]
+
+
+def test_invariant_block_leaks():
+    clean = InvariantChecker()
+    clean.check_block_leaks({"m": {"workers": {
+        "w1": {"num_running": 0, "num_waiting": 0, "kv_usage": 0.0}}}})
+    assert clean.finish().passed
+
+    leak = InvariantChecker()
+    leak.check_block_leaks({"m": {"workers": {
+        "w1": {"num_running": 0, "num_waiting": 0, "kv_usage": 0.25}}}})
+    rep = leak.finish()
+    assert not rep.passed and "leaked pinned blocks" in rep.failures[0]
+
+    # no workers observed is a skip, not a pass
+    skip = InvariantChecker()
+    skip.check_block_leaks({"m": {"workers": {}}})
+    assert "no_leaked_blocks" not in skip.finish().checks
+
+
+def test_invariant_op_streams():
+    same = InvariantChecker()
+    same.check_op_streams({0: ["add", "step"], 1: ["add", "step"]})
+    assert "spmd_op_streams_identical" in same.finish().checks
+
+    div = InvariantChecker()
+    div.check_op_streams({0: ["add", "step", "step"],
+                          1: ["add", "reap", "step"]})
+    rep = div.finish()
+    assert not rep.passed and "op index 1" in rep.failures[0]
+
+
+def _metrics_text(x499: int) -> str:
+    return f"""\
+dynamo_qos_admitted_total{{priority="standard"}} 8
+dynamo_qos_rejected_total{{reason="rate_limit"}} 2
+dynamo_frontend_requests_total{{route="chat",status="200"}} 5
+dynamo_frontend_requests_total{{route="completions",status="500"}} 2
+dynamo_frontend_requests_total{{route="chat",status="499"}} {x499}
+dynamo_frontend_requests_total{{route="chat",status="429"}} 2
+dynamo_frontend_requests_total{{route="chat",status="400"}} 3
+dynamo_frontend_requests_total{{route="embeddings",status="200"}} 9
+"""
+
+
+def test_invariant_metrics_balance():
+    balanced = InvariantChecker()
+    balanced.check_metrics_balance(_metrics_text(1))
+    rep = balanced.finish()
+    assert rep.passed, rep.failures
+    assert rep.details["metrics_balance"]["admitted"] == 8
+
+    # one admitted request never reached a terminal status
+    hole = InvariantChecker()
+    hole.check_metrics_balance(_metrics_text(0))
+    rep = hole.finish()
+    assert not rep.passed and "imbalance" in rep.failures[0]
+
+
+def test_parse_prometheus_and_metric_sum():
+    samples = parse_prometheus(_metrics_text(1))
+    assert metric_sum(samples, "dynamo_frontend_requests_total",
+                      route="chat") == 11
+    assert metric_sum(samples, "dynamo_qos_rejected_total") == 2
+
+
+def test_identical_evidence_gives_identical_report():
+    """Replay contract: the report is pure data derived from evidence."""
+    def build():
+        c = InvariantChecker()
+        c.check_streams([StreamOutcome("a", "finished", "stop")])
+        c.check_op_streams({0: ["step"], 1: ["step"]})
+        c.check_metrics_balance(_metrics_text(1))
+        return c.finish().to_dict()
+
+    assert build() == build()
+
+
+# ---------------------------------------------------------------------------
+# Recovery paths under injection
+# ---------------------------------------------------------------------------
+
+def test_shard_client_survives_injected_disconnect(chaos_seed):
+    """The mid-wave shard-death shape: the first pull attempt dies on an
+    injected disconnect; ShardClient reconnects and the fetch completes."""
+    from dynamo_tpu.disagg.sharded import ShardClient, ShardServer, StagingStore
+
+    chaos.configure({"seed": chaos_seed, "rules": [
+        {"point": "disagg.pull", "kind": "disconnect", "rate": 1.0,
+         "count": 1}]})
+    store = StagingStore()
+    hashes, parents = [11, 12], [None, 11]
+    box = (0, 1, 0, 1)
+    data = np.arange(2 * 2 * 1 * 4 * 1 * 8, dtype=np.float32).reshape(
+        2, 2, 1, 4, 1, 8)
+    store.begin("x", hashes, parents, box, "float32")
+    store.append("x", 0, data)
+    store.finalize("x", 2)
+    server = ShardServer(store, host="127.0.0.1")
+    client = ShardClient(f"127.0.0.1:{server.port}", retries=3, backoff=0.01)
+    try:
+        h, p, flat, gbox = client.fetch("x", box)
+        assert list(h) == hashes and tuple(gbox) == box
+        np.testing.assert_array_equal(flat.reshape(data.shape), data)
+        assert [k[1:3] for k in chaos.injection_log()] == \
+            [("disagg.pull", "disconnect")]
+    finally:
+        client.close()
+        server.close()
+
+
+async def test_migration_quarantines_and_keeps_trace(chaos_seed):
+    """StreamError.instance_id flows into on_instance_error, and the
+    re-dispatched request keeps obs.traceparent + stamps migration.attempt."""
+    from dynamo_tpu.frontend.migration import MIGRATION_ATTEMPT_KEY, Migration
+    from dynamo_tpu.protocols.common import PreprocessedRequest
+    from dynamo_tpu.runtime.client import StreamError
+
+    seen: list = []
+    calls = []
+
+    async def worker(req):
+        calls.append(dict(req.annotations or {}))
+        if len(calls) == 1:
+            yield {"token_ids": [1]}
+            raise StreamError("worker died", instance_id=0xBEEF)
+        yield {"token_ids": [2], "finish_reason": "stop"}
+
+    mig = Migration(inner=worker, migration_limit=2,
+                    on_instance_error=seen.append)
+    req = PreprocessedRequest(token_ids=[5])
+    req.request_id = "q1"
+    req.annotations = {"obs.traceparent": "00-abc-def-01"}
+    toks = [t async for out in mig.generate(req)
+            for t in out.get("token_ids", [])]
+    assert toks == [1, 2]
+    assert seen == [0xBEEF]
+    assert calls[1]["obs.traceparent"] == "00-abc-def-01"
+    assert calls[1][MIGRATION_ATTEMPT_KEY] == 1
+
+
+async def test_migration_respects_expired_deadline(chaos_seed):
+    """A request that blew its QoS deadline while broken is finished with a
+    typed cancelled delta instead of being re-dispatched."""
+    from dynamo_tpu.frontend.migration import Migration
+    from dynamo_tpu.protocols.common import PreprocessedRequest
+    from dynamo_tpu.qos.deadline import DEADLINE_KEY
+    from dynamo_tpu.runtime.client import StreamError
+
+    calls = []
+
+    async def worker(req):
+        calls.append(req)
+        raise StreamError("worker died")
+        yield  # pragma: no cover
+
+    mig = Migration(inner=worker, migration_limit=3)
+    req = PreprocessedRequest(token_ids=[5])
+    req.request_id = "d1"
+    req.annotations = {DEADLINE_KEY: time.time() - 1.0}
+    outs = [out async for out in mig.generate(req)]
+    assert len(calls) == 1            # no re-dispatch after expiry
+    assert outs[-1]["finish_reason"] == "cancelled"
+    assert "deadline" in outs[-1]["error"]
+
+
+async def test_lease_expiry_quarantine_chain(chaos_seed):
+    """Satellite: coordinator lease expiry → prefix-watch DELETE →
+    endpoint-client sees the instance vanish; quarantine() hides a live
+    instance from routing without deregistering it, and the worker's
+    on_lost hook re-registers after a keepalive-starvation storm."""
+    from dynamo_tpu.runtime.client import EndpointClient
+    from dynamo_tpu.runtime.protocols import EndpointId
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+    from dynamo_tpu.transports.coordinator import CoordinatorServer
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    server = CoordinatorServer()
+    await server.start()
+    cfg = RuntimeConfig(coordinator_url=server.url, lease_ttl_s=1.0)
+    worker = await DistributedRuntime.create(cfg)
+
+    async def handler(payload, ctx):
+        yield {"ok": True}
+
+    ep = worker.namespace("ns").component("b").endpoint("g")
+    await ep.serve(handler)
+    observer = await DistributedRuntime.create(
+        RuntimeConfig(coordinator_url=server.url))
+    client = await EndpointClient.create(observer, EndpointId("ns", "b", "g"))
+    try:
+        await client.wait_for_instances(10.0)
+        iid = client.instance_ids()[0]
+
+        # quarantine: routing skips it, discovery still knows it
+        client.quarantine(iid, duration_s=30.0)
+        assert client.instance_ids() == []
+        assert client.known_instance_ids() == [iid]
+
+        # starve ONLY the worker's keepalives (match on its lease id) long
+        # enough for the 1s-TTL lease to expire server-side
+        chaos.configure({"seed": chaos_seed, "rules": [
+            {"point": "transports.keepalive", "kind": "error", "rate": 1.0,
+             "count": 6, "match": {"lease_id": worker.primary_lease.id}}]})
+
+        async def wait_for(pred, timeout):
+            deadline = asyncio.get_running_loop().time() + timeout
+            while not pred():
+                if asyncio.get_running_loop().time() > deadline:
+                    return False
+                await asyncio.sleep(0.05)
+            return True
+
+        # lease dies → key DELETE → watch removes the instance
+        assert await wait_for(lambda: not client.known_instance_ids(), 10.0), \
+            "expired lease never produced a prefix-watch DELETE"
+        # keepalive loop notices the dead lease once the storm passes →
+        # on_lost → _restore_registrations re-grants and re-puts; the PUT
+        # also clears any quarantine on the re-registered instance
+        assert await wait_for(lambda: client.instance_ids(), 15.0), \
+            "worker never re-registered after lease expiry"
+    finally:
+        await client.close()
+        for rt in (observer, worker):
+            with contextlib.suppress(Exception):
+                await rt.shutdown()
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fleet scenarios (the smoke scenario is tier-1; the rest are slow)
+# ---------------------------------------------------------------------------
+
+def test_scenario_smoke(chaos_seed):
+    """Mocker fleet under a seeded error+delay plan: Migration absorbs every
+    injected dispatch failure; all invariants hold. Tier-1 (<30s)."""
+    from dynamo_tpu.chaos.harness import run_scenario
+
+    res = run_scenario("smoke", seed=chaos_seed)
+    assert res.report.passed, res.report.failures
+    assert res.report.details["streams"]["lost"] == 0
+
+
+@pytest.mark.slow
+def test_scenario_worker_kill(chaos_seed):
+    from dynamo_tpu.chaos.harness import run_scenario
+
+    res = run_scenario("worker_kill", seed=chaos_seed)
+    assert res.report.passed, res.report.failures
+
+
+@pytest.mark.slow
+def test_scenario_coordinator_partition(chaos_seed):
+    from dynamo_tpu.chaos.harness import run_scenario
+
+    res = run_scenario("coordinator_partition", seed=chaos_seed)
+    assert res.report.passed, res.report.failures
+
+
+@pytest.mark.slow
+def test_scenario_lease_expiry_storm(chaos_seed):
+    from dynamo_tpu.chaos.harness import run_scenario
+
+    res = run_scenario("lease_expiry_storm", seed=chaos_seed)
+    assert res.report.passed, res.report.failures
+
+
+@pytest.mark.slow
+def test_scenario_slow_rank_stall(chaos_seed):
+    from dynamo_tpu.chaos.harness import run_scenario
+
+    res = run_scenario("slow_rank_stall", seed=chaos_seed)
+    assert res.report.passed, res.report.failures

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Chaos scenario runner: drive mocker fleets through fault scenarios.
+
+Each scenario boots a real multi-process fleet (coordinator + workers +
+frontend), injects faults from a seeded ChaosPlan, and asserts the
+post-scenario invariants (no lost streams, no leaked KV blocks, metrics
+balance). Same seed ⇒ identical fault sequence ⇒ reproducible failures:
+a red CI run prints the seed, and ``--seed`` replays it locally.
+
+    python tools/chaos_run.py smoke
+    python tools/chaos_run.py all --seed 987 --json report.json
+
+See docs/CHAOS.md for the fault-point catalog and plan format.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    from dynamo_tpu.chaos.harness import SCENARIOS, run_scenario
+
+    p = argparse.ArgumentParser(
+        "chaos-run", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("scenario", choices=[*SCENARIOS, "all"],
+                   help="scenario name, or 'all' for the full suite")
+    p.add_argument("--seed", type=int, default=1234,
+                   help="chaos seed (replays the exact fault sequence)")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="write the full report (outcomes + invariant "
+                        "details) as JSON")
+    ns = p.parse_args(argv)
+
+    names = list(SCENARIOS) if ns.scenario == "all" else [ns.scenario]
+    results = []
+    failed = 0
+    for name in names:
+        t0 = time.monotonic()
+        print(f"=== {name} (seed={ns.seed}) ===", flush=True)
+        try:
+            res = run_scenario(name, seed=ns.seed)
+        except Exception as exc:  # noqa: BLE001 — harness-level failure
+            failed += 1
+            print(f"    HARNESS ERROR: {type(exc).__name__}: {exc}")
+            results.append({"name": name, "seed": ns.seed,
+                            "harness_error": str(exc)})
+            continue
+        dt = time.monotonic() - t0
+        results.append(res.to_dict())
+        rep = res.report
+        verdict = "PASS" if rep.passed else "FAIL"
+        print(f"    {verdict} in {dt:.1f}s — {len(rep.checks)} checks, "
+              f"{len(res.outcomes)} streams")
+        for line in rep.failures:
+            print(f"    failure: {line}")
+        if not rep.passed:
+            failed += 1
+
+    if ns.json:
+        with open(ns.json, "w") as f:
+            json.dump({"seed": ns.seed, "results": results}, f, indent=2)
+        print(f"report written to {ns.json}")
+    if failed:
+        print(f"{failed}/{len(names)} scenario(s) failed "
+              f"(replay with --seed {ns.seed})", file=sys.stderr)
+        return 1
+    print(f"all {len(names)} scenario(s) passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -54,6 +54,16 @@ KV_TRANSFER_METRICS = (
     "kv_transfer_wave_bytes",
 )
 
+# The failure-recovery family: health canaries (runtime/health.py),
+# migration re-dispatch (frontend/migration.py), and chaos injection
+# (chaos/metrics.py). Same bidirectional drift rule as KV_TRANSFER_METRICS:
+# each module's registrations must exactly match its declared slice.
+RECOVERY_METRICS = {
+    ("runtime", "health.py"): ("health_canary_total", "health_canary_failures"),
+    ("frontend", "migration.py"): ("migration_attempts_total",),
+    ("chaos", "metrics.py"): ("chaos_injected_total",),
+}
+
 
 def _const_str(node: ast.expr | None) -> str | None:
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
@@ -174,6 +184,25 @@ def _lint_kv_transfer_metrics(root: Path, problems: list[str]) -> None:
             "does not register it")
 
 
+def _lint_recovery_metrics(root: Path, problems: list[str]) -> None:
+    """The recovery family must match what each module actually registers
+    — same no-silent-drift rule as KV_TRANSFER_METRICS."""
+    for parts, declared_names in RECOVERY_METRICS.items():
+        rel = "/".join(parts)
+        actual = _registered_names(root.joinpath(*parts))
+        if actual is None:
+            continue
+        declared = set(declared_names)
+        for key in sorted(actual - declared):
+            problems.append(
+                f"{rel} registers {key!r} but it is missing from "
+                "tools/lint_metrics.py RECOVERY_METRICS")
+        for key in sorted(declared - actual):
+            problems.append(
+                f"RECOVERY_METRICS declares {key!r} but {rel} "
+                "does not register it")
+
+
 def _lint_provider_metrics(root: Path, problems: list[str]) -> None:
     """The status-provider surface: names must be Prometheus-valid under the
     dynamo_ prefix, and the declared engine list must match what
@@ -209,6 +238,7 @@ def lint_tree(root: Path | None = None) -> list[str]:
         _lint_module(path, problems)
     _lint_provider_metrics(root, problems)
     _lint_kv_transfer_metrics(root, problems)
+    _lint_recovery_metrics(root, problems)
     return problems
 
 
