@@ -249,6 +249,12 @@ async def amain(ns: argparse.Namespace) -> None:
         stats_fn = engine.stats
     else:
         from dynamo_tpu.engine.engine import build_engine
+        from dynamo_tpu.obs.profiler import install_perf_metrics
+
+        # JAX engines feed the dynamo_engine_perf_* family (MFU, HBM-BW
+        # utilization, roofline fraction — obs/profiler.py); re-home the
+        # singleton into the runtime registry so /metrics exposes it.
+        install_perf_metrics(rt.metrics)
 
         remote_kv = ns.remote_kv_addr
         if remote_kv == "auto":

@@ -44,6 +44,7 @@ from dynamo_tpu.engine.sampling import (
 from dynamo_tpu.engine.scheduler import Phase, PrefillWork, Scheduler, Seq, StepPlan
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.config import ModelConfig, resolve_model_config
+from dynamo_tpu.obs.profiler import StepPerfProfiler, phase as _perf_phase
 from dynamo_tpu.obs.tracer import get_tracer, trace_context_of
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
 from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
@@ -290,24 +291,26 @@ class ModelRunner:
                 # program is untouched — only the sampling input shifts.
                 logits = logits + logit_mask
             write_slots = jnp.where(do_sample, slots, trash_row)
-            if fast_greedy:
-                # Whole batch greedy + penalty-free (host-verified at
-                # dispatch): argmax over raw logits is bit-identical to the
-                # general path and skips its PRNG, penalty-count gathers,
-                # and sorted top-k/p masking — the per-step vocab-sized
-                # traffic that isn't the model itself.
-                toks, lps = _greedy_sample(logits)
-            else:
-                st = SamplingState(
-                    temperature=temp, top_k=top_k, top_p=top_p,
-                    frequency_penalty=fp, presence_penalty=pp, repetition_penalty=rp,
-                    keys=keys[slots], token_counts=counts[slots],
-                )
-                toks, lps, new_keys = sample(logits, st)
-                new_counts = record_tokens(st.token_counts, toks, do_sample)
-                # Only sampling rows persist state; others write to trash.
-                counts = counts.at[write_slots].set(new_counts)
-                keys = keys.at[write_slots].set(new_keys)
+            with _perf_phase("sampling"):
+                if fast_greedy:
+                    # Whole batch greedy + penalty-free (host-verified at
+                    # dispatch): argmax over raw logits is bit-identical to
+                    # the general path and skips its PRNG, penalty-count
+                    # gathers, and sorted top-k/p masking — the per-step
+                    # vocab-sized traffic that isn't the model itself.
+                    toks, lps = _greedy_sample(logits)
+                else:
+                    st = SamplingState(
+                        temperature=temp, top_k=top_k, top_p=top_p,
+                        frequency_penalty=fp, presence_penalty=pp,
+                        repetition_penalty=rp,
+                        keys=keys[slots], token_counts=counts[slots],
+                    )
+                    toks, lps, new_keys = sample(logits, st)
+                    new_counts = record_tokens(st.token_counts, toks, do_sample)
+                    # Only sampling rows persist state; others write to trash.
+                    counts = counts.at[write_slots].set(new_counts)
+                    keys = keys.at[write_slots].set(new_keys)
             slot_toks = slot_toks.at[write_slots].set(toks)
             return ck, cv, counts, keys, slot_toks, toks, lps
 
@@ -362,21 +365,23 @@ class ModelRunner:
                     attn_impl=attn_impl, moe_impl=moe_impl, mesh=mesh,
                     pp_microbatches=pp_micro)
                 logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
-                if fast_greedy:
-                    # See _build_step_fn: bit-identical for all-greedy
-                    # penalty-free batches, minus the sampling machinery.
-                    toks, lps = _greedy_sample(logits)
-                else:
-                    st = SamplingState(
-                        temperature=temp, top_k=top_k, top_p=top_p,
-                        frequency_penalty=fp, presence_penalty=pp,
-                        repetition_penalty=rp, keys=keys[slots],
-                        token_counts=counts[slots],
-                    )
-                    toks, lps, new_keys = sample(logits, st)
-                    new_counts = record_tokens(st.token_counts, toks, do_sample)
-                    counts = counts.at[write_slots].set(new_counts)
-                    keys = keys.at[write_slots].set(new_keys)
+                with _perf_phase("sampling"):
+                    if fast_greedy:
+                        # See _build_step_fn: bit-identical for all-greedy
+                        # penalty-free batches, minus the sampling machinery.
+                        toks, lps = _greedy_sample(logits)
+                    else:
+                        st = SamplingState(
+                            temperature=temp, top_k=top_k, top_p=top_p,
+                            frequency_penalty=fp, presence_penalty=pp,
+                            repetition_penalty=rp, keys=keys[slots],
+                            token_counts=counts[slots],
+                        )
+                        toks, lps, new_keys = sample(logits, st)
+                        new_counts = record_tokens(st.token_counts, toks,
+                                                   do_sample)
+                        counts = counts.at[write_slots].set(new_counts)
+                        keys = keys.at[write_slots].set(new_keys)
                 slot_toks = slot_toks.at[write_slots].set(toks)
                 return (ck, cv, counts, keys, slot_toks, toks), (toks, lps)
 
@@ -786,6 +791,10 @@ class EngineCore:
                             * self.runner.spec.num_blocks),
             kv_quant_enabled=self.runner.spec.quantized,
         )
+        # Hardware counters: analytic FLOPs/bytes + MFU/BW-util per step
+        # (obs/profiler.py). DYN_PERF_PROFILE=0 turns the whole thing into
+        # a no-op dict lookup per step.
+        self.perf = StepPerfProfiler(self.model_cfg, engine_cfg)
         self._seqs: dict[str, Seq] = {}
         self.default_eos: list[int] = []
         # Tracing: decode spans rotate every N generated tokens — one span
@@ -1160,13 +1169,15 @@ class EngineCore:
             else:
                 n_dec += len(rows)
         pc = self.sched.preemption_count
+        wall = time.perf_counter() - t0
         get_tracer().recorder.steps.record(
-            time.time(), time.perf_counter() - t0,
+            time.time(), wall,
             num_prefill=n_pf, num_decode=n_dec,
             num_waiting=self.sched.num_waiting,
             num_preempted=pc - self._trace_last_preempt,
             occupancy=(self.sched.num_running
-                       / max(self.engine_cfg.max_batch_size, 1)))
+                       / max(self.engine_cfg.max_batch_size, 1)),
+            **self.perf.measure(pending.batches, wall))
         self._trace_last_preempt = pc
 
     def _plan_verify(self, decode_seqs: list

@@ -157,6 +157,11 @@ async def run_http(ns: argparse.Namespace) -> None:
         image_encoder=image_encoder,
     )
     svc = HttpService(models)
+    # Single-process launch: the engine lives here, so its perf-counter
+    # family belongs on this /metrics (workers do the same in
+    # components/worker.py).
+    from dynamo_tpu.obs.profiler import install_perf_metrics
+    install_perf_metrics(svc.metrics)
     await svc.start(ns.host, ns.port)
     log.info("serving %s on http://%s:%d/v1", ns.model, ns.host, svc.port)
     try:

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.obs.profiler import phase as _perf_phase
 from dynamo_tpu.parallel.mesh import shard_map_compat
 from dynamo_tpu.utils.logging import get_logger
 
@@ -404,12 +405,17 @@ def forward(
         v = mm(x, lp["wv"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        ck = _scatter_kv(ck, k, slot)
-        cv = _scatter_kv(cv, v, slot)
+        # Phase hooks (obs/profiler.py): jax.named_scope annotations for
+        # XLA profiles, plus wall capture in eager profiling runs. Under
+        # jit they execute at trace time only — zero ops in the program.
+        with _perf_phase("scatter"):
+            ck = _scatter_kv(ck, k, slot)
+            cv = _scatter_kv(cv, v, slot)
         if use_ring:
             from dynamo_tpu.ops.ring_attention import ring_attention_prefill
 
-            attn = ring_attention_prefill(mesh, q, k, v, kv_lens)
+            with _perf_phase("attention"):
+                attn = ring_attention_prefill(mesh, q, k, v, kv_lens)
         elif attn_impl in ("pallas", "pallas_interpret"):
             from dynamo_tpu.ops.paged_attention import (
                 paged_attention_kernel,
@@ -417,21 +423,25 @@ def forward(
             )
 
             interp = attn_impl == "pallas_interpret"
-            if tp > 1:
-                # TP: shard_map the kernel over the head axis; GSPMD's psum
-                # in the wo projection completes the TP contraction.
-                attn = paged_attention_sharded(
-                    mesh, q, ck, cv, block_tables, q_start, kv_lens,
-                    interpret=interp,
-                )
-            else:
-                attn = paged_attention_kernel(
-                    q, ck, cv, block_tables, q_start, kv_lens, interpret=interp,
-                )
+            with _perf_phase("attention"):
+                if tp > 1:
+                    # TP: shard_map the kernel over the head axis; GSPMD's
+                    # psum in the wo projection completes the TP contraction.
+                    attn = paged_attention_sharded(
+                        mesh, q, ck, cv, block_tables, q_start, kv_lens,
+                        interpret=interp,
+                    )
+                else:
+                    attn = paged_attention_kernel(
+                        q, ck, cv, block_tables, q_start, kv_lens,
+                        interpret=interp,
+                    )
         else:
-            ctx_k = _gather_kv(ck, block_tables)
-            ctx_v = _gather_kv(cv, block_tables)
-            attn = paged_attention(q, ctx_k, ctx_v, positions, kv_lens)
+            with _perf_phase("gather"):
+                ctx_k = _gather_kv(ck, block_tables)
+                ctx_v = _gather_kv(cv, block_tables)
+            with _perf_phase("attention"):
+                attn = paged_attention(q, ctx_k, ctx_v, positions, kv_lens)
         attn = mm(attn.reshape(b, t, cfg.q_size), lp["wo"])
         hid = hid + attn
         x = rms_norm(hid, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -687,9 +697,10 @@ def logits_from_hidden(params: Params, cfg: ModelConfig, hidden: jax.Array) -> j
     """Project hidden [B,H] → logits [B,V] (tied or separate lm head).
     Row-quantized embeddings put the scale on the vocab axis, so it
     applies per logit column after the contraction."""
-    if cfg.tie_word_embeddings:
-        e = params["embed"]
-        if isinstance(e, dict):
-            return (hidden @ e["q"].astype(hidden.dtype).T) * e["sr"].astype(hidden.dtype)
-        return hidden @ e.T
-    return mm(hidden, params["lm_head"])
+    with _perf_phase("logits"):
+        if cfg.tie_word_embeddings:
+            e = params["embed"]
+            if isinstance(e, dict):
+                return (hidden @ e["q"].astype(hidden.dtype).T) * e["sr"].astype(hidden.dtype)
+            return hidden @ e.T
+        return mm(hidden, params["lm_head"])

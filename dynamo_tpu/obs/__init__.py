@@ -12,6 +12,19 @@ aggregates without an external trace backend.
 """
 
 from dynamo_tpu.obs.bridge import SpanMetricsBridge
+from dynamo_tpu.obs.costmodel import (
+    HardwareSpec,
+    KernelCost,
+    hw_spec_for,
+)
+from dynamo_tpu.obs.profiler import (
+    PerfMetrics,
+    StepPerfProfiler,
+    capture_phases,
+    get_perf_metrics,
+    install_perf_metrics,
+    phase,
+)
 from dynamo_tpu.obs.recorder import FlightRecorder, StepProfiler
 from dynamo_tpu.obs.tracer import (
     TRACE_KEY,
@@ -24,10 +37,19 @@ from dynamo_tpu.obs.tracer import (
 __all__ = [
     "TRACE_KEY",
     "FlightRecorder",
+    "HardwareSpec",
+    "KernelCost",
+    "PerfMetrics",
     "Span",
     "SpanMetricsBridge",
+    "StepPerfProfiler",
     "StepProfiler",
     "Tracer",
+    "capture_phases",
+    "get_perf_metrics",
     "get_tracer",
+    "hw_spec_for",
+    "install_perf_metrics",
+    "phase",
     "trace_context_of",
 ]

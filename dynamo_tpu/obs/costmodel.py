@@ -1,0 +1,420 @@
+"""Analytic roofline cost model: expected FLOPs and HBM bytes per kernel.
+
+Every hot path the engine dispatches — paged attention (bf16 or int8 KV),
+ring-attention prefill, and the dense matmuls around them — gets a closed-
+form cost as a function of the call's shapes and dtypes. The step profiler
+(obs/profiler.py) folds these into per-step MFU / HBM-bandwidth-utilization
+counters; tools/perf_report.py renders them as the docs/PERF.md scoreboard;
+bench.py uses them to *predict* device numbers when the probe can only
+reach a CPU.
+
+Conventions (stated once, relied on by tests/test_perf_obs.py):
+
+* FLOPs count matmul work only (2·M·N·K per dense contraction), the
+  standard MFU accounting — softmax/normalization vector work is noise
+  against the MXU terms for every real shape.
+* Attention is charged for whole KV blocks (``ceil(kv_len / bs) · bs``
+  context positions): that is what the kernel DMAs and feeds the MXU —
+  masked in-block positions still burn the hardware.
+* HBM bytes count reads + writes of tensors that round-trip HBM under the
+  serving access pattern: weights stream once per step, activations are
+  assumed resident (XLA fuses them), KV blocks stream per step.
+* int8 KV halves the KV payload and adds the per-(block, head) f32 scales;
+  int8 weights count 1 byte/elem (models/quant.py streams them packed).
+
+This module is dependency-free on purpose — no jax import — so the bench
+parent process can compute predicted device numbers without touching a
+device runtime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from dynamo_tpu.models.config import ModelConfig
+
+__all__ = [
+    "HardwareSpec",
+    "KernelCost",
+    "HW_SPECS",
+    "hw_spec_for",
+    "paged_attention_cost",
+    "ring_attention_cost",
+    "dense_matmul_cost",
+    "model_step_cost",
+    "decode_step_cost",
+    "prefill_cost",
+    "total_cost",
+    "analytic_param_bytes",
+    "predicted_decode_perf",
+    "mfu",
+    "bw_util",
+    "roofline_fraction",
+]
+
+
+@dataclass(frozen=True)
+class HardwareSpec:
+    """Peak numbers one chip can theoretically sustain."""
+
+    name: str
+    peak_flops: float   # bf16 matmul FLOP/s
+    hbm_bw: float       # HBM bytes/s
+
+    @property
+    def ridge_intensity(self) -> float:
+        """FLOPs/byte where the roofline bends: below it you are
+        bandwidth-bound, above it compute-bound."""
+        return self.peak_flops / self.hbm_bw
+
+
+# Keyed by a lowercase substring of jax's ``device_kind``; first match wins
+# (dict order), so the more specific "tpu v5p" precedes "tpu v5". The HBM
+# numbers intentionally match bench.py's historical roofline table. The CPU
+# entry is a deliberately rough stand-in for the fallback bench — a few
+# AVX cores and one DDR channel-ish.
+HW_SPECS: dict[str, HardwareSpec] = {
+    "tpu v6": HardwareSpec("tpu-v6e", 918e12, 1638e9),
+    "tpu v5p": HardwareSpec("tpu-v5p", 459e12, 2765e9),
+    "tpu v5": HardwareSpec("tpu-v5e", 197e12, 819e9),
+    "tpu v4": HardwareSpec("tpu-v4", 275e12, 1228e9),
+    "cpu": HardwareSpec("cpu", 200e9, 50e9),
+}
+
+
+def hw_spec_for(device_kind: str) -> HardwareSpec:
+    """Resolve a jax ``device_kind`` string (e.g. "TPU v5 lite") to a spec;
+    unknown kinds fall back to the conservative CPU entry."""
+    kind = (device_kind or "cpu").lower()
+    for key, spec in HW_SPECS.items():
+        if key in kind:
+            return spec
+    return HW_SPECS["cpu"]
+
+
+@dataclass(frozen=True)
+class KernelCost:
+    """Expected work of one kernel invocation (or a sum of them)."""
+
+    name: str
+    flops: float = 0.0
+    hbm_bytes: float = 0.0
+    ici_bytes: float = 0.0  # interconnect traffic (ring attention hops)
+
+    def __add__(self, other: "KernelCost") -> "KernelCost":
+        return KernelCost(
+            name=self.name if self.name == other.name else "total",
+            flops=self.flops + other.flops,
+            hbm_bytes=self.hbm_bytes + other.hbm_bytes,
+            ici_bytes=self.ici_bytes + other.ici_bytes,
+        )
+
+    def scaled(self, k: float) -> "KernelCost":
+        return replace(self, flops=self.flops * k,
+                       hbm_bytes=self.hbm_bytes * k,
+                       ici_bytes=self.ici_bytes * k)
+
+    @property
+    def intensity(self) -> float:
+        """Arithmetic intensity, FLOPs per HBM byte."""
+        return self.flops / self.hbm_bytes if self.hbm_bytes else float("inf")
+
+    def time_bound(self, hw: HardwareSpec) -> float:
+        """Roofline-bound execution time: max of the compute and bandwidth
+        lower bounds (perfect overlap assumed — this is the floor)."""
+        return max(self.flops / hw.peak_flops if hw.peak_flops else 0.0,
+                   self.hbm_bytes / hw.hbm_bw if hw.hbm_bw else 0.0)
+
+    def bound(self, hw: HardwareSpec) -> str:
+        return "compute" if self.intensity >= hw.ridge_intensity else "bandwidth"
+
+
+def _kv_itemsize(kv_dtype: str) -> int:
+    return 1 if kv_dtype == "int8" else 2
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def paged_attention_cost(
+    *,
+    batch: int,
+    q_tokens: int,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+    kv_len: int,
+    block_size: int,
+    kv_dtype: str = "bfloat16",
+    act_bytes: int = 2,
+) -> KernelCost:
+    """One paged-attention invocation (Pallas kernel and the dense-gather
+    fallback execute the same matmul volume over the same KV blocks).
+
+    FLOPs: the QK^T and PV matmuls — ``4 · B · T · H · D · S`` with S the
+    block-rounded context. HBM: Q read + output write (activation dtype),
+    plus both K and V caches streamed once per invocation; int8 caches move
+    half the payload plus the per-(block, kv-head) f32 scales.
+    """
+    nblk = _ceil_div(max(kv_len, 1), block_size)
+    s = nblk * block_size
+    flops = 4.0 * batch * q_tokens * num_heads * head_dim * s
+    q_bytes = batch * q_tokens * num_heads * head_dim * act_bytes
+    kv_block = block_size * num_kv_heads * head_dim * _kv_itemsize(kv_dtype)
+    if kv_dtype == "int8":
+        kv_block += num_kv_heads * 4  # per-(block, head) f32 scale
+    kv_bytes = 2.0 * batch * nblk * kv_block
+    out_bytes = q_bytes
+    return KernelCost("paged_attention", flops, q_bytes + kv_bytes + out_bytes)
+
+
+def ring_attention_cost(
+    *,
+    batch: int,
+    seq_len: int,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+    sp: int = 1,
+    act_bytes: int = 2,
+) -> KernelCost:
+    """Sequence-parallel prefill self-attention (ops/ring_attention.py):
+    full causal-masked matmul volume over the chunk, KV shards rotating
+    ``sp - 1`` hops over the interconnect."""
+    flops = 4.0 * batch * seq_len * seq_len * num_heads * head_dim
+    qkv = batch * seq_len * (num_heads + 2 * num_kv_heads) * head_dim * act_bytes
+    out = batch * seq_len * num_heads * head_dim * act_bytes
+    kv_shard = 2.0 * batch * seq_len * num_kv_heads * head_dim * act_bytes / max(sp, 1)
+    ici = kv_shard * max(sp - 1, 0)
+    return KernelCost("ring_attention", flops, qkv + out, ici_bytes=ici)
+
+
+def dense_matmul_cost(m: int, n: int, k: int, *, act_bytes: int = 2,
+                      weight_bytes: int = 2, name: str = "matmul") -> KernelCost:
+    """[M,K] @ [K,N]: 2MNK FLOPs; activations + streamed weight + output."""
+    flops = 2.0 * m * n * k
+    hbm = m * k * act_bytes + k * n * weight_bytes + m * n * act_bytes
+    return KernelCost(name, flops, hbm)
+
+
+def _weight_itemsize(quantization: str) -> int:
+    return 1 if quantization == "int8" else 2
+
+
+def model_step_cost(
+    cfg: ModelConfig,
+    *,
+    tokens: int,
+    logit_rows: int,
+    attn_q_ctx: float,
+    kv_blocks: float,
+    block_size: int,
+    kv_dtype: str = "bfloat16",
+    quantization: str = "none",
+) -> dict[str, KernelCost]:
+    """Aggregate cost of ONE dispatched engine step, by phase.
+
+    Aggregated inputs let the profiler charge a ragged batch in O(rows)
+    host work (engine hot path):
+
+    * ``tokens`` — total query tokens across rows (N),
+    * ``logit_rows`` — rows projected to logits and sampled,
+    * ``attn_q_ctx`` — Σ over rows of ``t_row · S_row`` with S_row the
+      block-rounded context (the attention matmul volume per head-dim),
+    * ``kv_blocks`` — Σ over rows of ``ceil(kv_len / bs)`` (blocks DMA'd
+      per layer).
+
+    Phase keys mirror the profiler's hooks: embed, scatter, attention,
+    proj, mlp, logits, sampling. All per-layer terms are multiplied by
+    ``cfg.num_layers``.
+    """
+    h, L = cfg.hidden_size, cfg.num_layers
+    wb = _weight_itemsize(quantization)
+    ab = 2  # bf16 activations
+    n = tokens
+
+    embed = KernelCost("embed", 0.0, n * h * (wb + ab))
+
+    # Attention projections: wq, wk, wv, wo per layer; weights stream once
+    # per step regardless of batch (the bandwidth-roofline assumption the
+    # bench normalizes against).
+    proj_flops = 2.0 * n * h * (2 * cfg.q_size + 2 * cfg.kv_size) * L
+    proj_w = (h * cfg.q_size * 2 + h * cfg.kv_size * 2) * wb * L
+    proj_act = (n * h * 2 + n * (cfg.q_size + 2 * cfg.kv_size)) * ab * L
+    proj = KernelCost("proj", proj_flops, proj_w + proj_act)
+
+    # KV scatter: the step's new K/V rows written at cache dtype; an int8
+    # cache additionally re-reads + re-writes each touched block to requant
+    # committed rows against the merged scale (llama._scatter_kv_quant).
+    kvb = _kv_itemsize(kv_dtype)
+    scatter_bytes = 2.0 * n * cfg.kv_size * kvb * L
+    if kv_dtype == "int8":
+        blocks_touched = _ceil_div(n, block_size) + 1
+        scatter_bytes += (2.0 * 2.0 * blocks_touched * block_size
+                          * cfg.kv_size * kvb * L)
+    scatter = KernelCost("scatter", 0.0, scatter_bytes)
+
+    attn_per_layer = paged_attention_cost(
+        batch=1, q_tokens=1, num_heads=cfg.num_heads,
+        num_kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
+        kv_len=block_size, block_size=block_size, kv_dtype=kv_dtype)
+    # Rebuild from the aggregated volumes: flops scale with attn_q_ctx,
+    # KV bytes with kv_blocks, Q/out bytes with tokens.
+    kv_block_bytes = block_size * cfg.num_kv_heads * cfg.head_dim * kvb
+    if kv_dtype == "int8":
+        kv_block_bytes += cfg.num_kv_heads * 4
+    attention = KernelCost(
+        "paged_attention",
+        4.0 * cfg.num_heads * cfg.head_dim * attn_q_ctx * L,
+        (2.0 * n * cfg.q_size * ab + 2.0 * kv_blocks * kv_block_bytes) * L,
+    )
+
+    if cfg.is_moe:
+        m = cfg.moe_intermediate_size
+        k = max(cfg.num_experts_per_tok, 1)
+        mlp_flops = (2.0 * n * h * cfg.num_experts  # router
+                     + 6.0 * n * h * m * k) * L
+        experts_touched = min(n * k, cfg.num_experts)
+        mlp_w = (h * cfg.num_experts + 3 * h * m * experts_touched) * wb * L
+        if cfg.num_shared_experts:
+            sm = m * cfg.num_shared_experts
+            mlp_flops += 6.0 * n * h * sm * L
+            mlp_w += 3 * h * sm * wb * L
+        mlp_act = n * h * 2 * ab * L
+    else:
+        i = cfg.intermediate_size
+        mlp_flops = 6.0 * n * h * i * L
+        mlp_w = 3 * h * i * wb * L
+        mlp_act = (n * h * 2 + n * i) * ab * L
+    mlp = KernelCost("mlp", mlp_flops, mlp_w + mlp_act)
+
+    logits = dense_matmul_cost(logit_rows, cfg.vocab_size, h,
+                               weight_bytes=wb, name="logits")
+    # Sampling: vector work over [rows, V] logits — no matmul FLOPs, one
+    # f32 read of the logits (argmax / top-k masking).
+    sampling = KernelCost("sampling", 0.0, logit_rows * cfg.vocab_size * 4.0)
+
+    return {"embed": embed, "scatter": scatter, "attention": attention,
+            "proj": proj, "mlp": mlp, "logits": logits, "sampling": sampling}
+
+
+def total_cost(phases: dict[str, KernelCost]) -> KernelCost:
+    out = KernelCost("total")
+    for c in phases.values():
+        out = out + c
+    return out
+
+
+def decode_step_cost(
+    cfg: ModelConfig,
+    *,
+    batch: int,
+    kv_len: int,
+    block_size: int,
+    kv_dtype: str = "bfloat16",
+    quantization: str = "none",
+) -> dict[str, KernelCost]:
+    """Uniform-batch decode step (every row: 1 query token, same context) —
+    the bench / perf_report / prediction entry point."""
+    nblk = _ceil_div(max(kv_len, 1), block_size)
+    return model_step_cost(
+        cfg, tokens=batch, logit_rows=batch,
+        attn_q_ctx=float(batch * nblk * block_size),
+        kv_blocks=float(batch * nblk), block_size=block_size,
+        kv_dtype=kv_dtype, quantization=quantization)
+
+
+def prefill_cost(
+    cfg: ModelConfig,
+    *,
+    batch: int,
+    chunk: int,
+    kv_len: int,
+    block_size: int,
+    kv_dtype: str = "bfloat16",
+    quantization: str = "none",
+) -> dict[str, KernelCost]:
+    """Uniform prefill chunk: ``chunk`` query tokens per row attending a
+    ``kv_len`` context (chunk end for fresh prompts)."""
+    nblk = _ceil_div(max(kv_len, 1), block_size)
+    return model_step_cost(
+        cfg, tokens=batch * chunk, logit_rows=batch,
+        attn_q_ctx=float(batch * chunk * nblk * block_size),
+        kv_blocks=float(batch * nblk), block_size=block_size,
+        kv_dtype=kv_dtype, quantization=quantization)
+
+
+def analytic_param_bytes(cfg: ModelConfig, quantization: str = "none") -> int:
+    """Model parameter bytes from shapes alone (mirrors models/llama.py
+    init_params structure; matmul weights at the quantized itemsize, norms
+    at bf16). The runtime twin is models/quant.py param_bytes(params)."""
+    h, L = cfg.hidden_size, cfg.num_layers
+    wb = _weight_itemsize(quantization)
+    matmul = h * cfg.q_size * 2 + h * cfg.kv_size * 2  # wq wk wv wo
+    norms = 2 * h
+    if cfg.is_moe:
+        m = cfg.moe_intermediate_size
+        matmul += h * cfg.num_experts + cfg.num_experts * 3 * h * m
+        if cfg.num_shared_experts:
+            matmul += 3 * h * m * cfg.num_shared_experts
+    else:
+        matmul += 3 * h * cfg.intermediate_size
+    total = L * (matmul * wb + norms * 2)
+    total += cfg.vocab_size * h * wb   # embed
+    total += h * 2                      # final norm
+    if not cfg.tie_word_embeddings:
+        total += h * cfg.vocab_size * wb
+    return total
+
+
+def predicted_decode_perf(
+    cfg: ModelConfig,
+    hw: HardwareSpec,
+    *,
+    batch: int,
+    kv_len: int,
+    block_size: int = 16,
+    kv_dtype: str = "bfloat16",
+    quantization: str = "none",
+) -> dict:
+    """Roofline prediction for a decode config on ``hw`` — what bench.py
+    attaches as the device forecast when only the CPU fallback could run."""
+    phases = decode_step_cost(cfg, batch=batch, kv_len=kv_len,
+                              block_size=block_size, kv_dtype=kv_dtype,
+                              quantization=quantization)
+    cost = total_cost(phases)
+    step_s = cost.time_bound(hw)
+    tok_s = batch / step_s if step_s > 0 else 0.0
+    return {
+        "device": hw.name,
+        "tok_s": round(tok_s, 1),
+        "step_flops": cost.flops,
+        "step_hbm_bytes": cost.hbm_bytes,
+        "arithmetic_intensity": round(cost.intensity, 2),
+        "bound": cost.bound(hw),
+        "mfu_at_roofline": round(mfu(cost.flops, step_s, hw), 4),
+        "bw_util_at_roofline": round(bw_util(cost.hbm_bytes, step_s, hw), 4),
+    }
+
+
+def mfu(flops: float, wall_s: float, hw: HardwareSpec) -> float:
+    """Model-FLOPs utilization: achieved matmul FLOP/s over peak."""
+    if wall_s <= 0 or hw.peak_flops <= 0:
+        return 0.0
+    return flops / wall_s / hw.peak_flops
+
+
+def bw_util(hbm_bytes: float, wall_s: float, hw: HardwareSpec) -> float:
+    """Achieved HBM bytes/s over peak bandwidth."""
+    if wall_s <= 0 or hw.hbm_bw <= 0:
+        return 0.0
+    return hbm_bytes / wall_s / hw.hbm_bw
+
+
+def roofline_fraction(cost: KernelCost, wall_s: float, hw: HardwareSpec) -> float:
+    """Achieved fraction of the roofline floor: bound-time / wall (1.0 =
+    running exactly at the roofline; > 1 means the model undercounts)."""
+    if wall_s <= 0:
+        return 0.0
+    return cost.time_bound(hw) / wall_s

@@ -22,7 +22,11 @@ if TYPE_CHECKING:  # pragma: no cover - import cycle guard
 @dataclass
 class StepRecord:
     """One engine step: wall time plus batch composition. Fixed-size
-    fields only — recording is a deque append, always-on cheap."""
+    fields only — recording is a deque append, always-on cheap.
+
+    The perf fields (flops…roofline_frac) are the step profiler's analytic
+    hardware counters (obs/profiler.py); they stay 0 when the profiler is
+    disabled so the ring schema is stable either way."""
 
     ts: float
     wall_s: float
@@ -31,6 +35,14 @@ class StepRecord:
     num_waiting: int
     num_preempted: int
     occupancy: float
+    decode_tokens: int = 0
+    prefill_tokens: int = 0
+    flops: float = 0.0
+    hbm_bytes: float = 0.0
+    tok_s: float = 0.0
+    mfu: float = 0.0
+    bw_util: float = 0.0
+    roofline_frac: float = 0.0
 
     def to_dict(self) -> dict:
         return {
@@ -39,6 +51,11 @@ class StepRecord:
             "num_waiting": self.num_waiting,
             "num_preempted": self.num_preempted,
             "occupancy": self.occupancy,
+            "decode_tokens": self.decode_tokens,
+            "prefill_tokens": self.prefill_tokens,
+            "flops": self.flops, "hbm_bytes": self.hbm_bytes,
+            "tok_s": self.tok_s, "mfu": self.mfu,
+            "bw_util": self.bw_util, "roofline_frac": self.roofline_frac,
         }
 
 
@@ -51,9 +68,15 @@ class StepProfiler:
 
     def record(self, ts: float, wall_s: float, *, num_prefill: int = 0,
                num_decode: int = 0, num_waiting: int = 0,
-               num_preempted: int = 0, occupancy: float = 0.0) -> None:
+               num_preempted: int = 0, occupancy: float = 0.0,
+               decode_tokens: int = 0, prefill_tokens: int = 0,
+               flops: float = 0.0, hbm_bytes: float = 0.0,
+               tok_s: float = 0.0, mfu: float = 0.0, bw_util: float = 0.0,
+               roofline_frac: float = 0.0) -> None:
         rec = StepRecord(ts, wall_s, num_prefill, num_decode, num_waiting,
-                         num_preempted, occupancy)
+                         num_preempted, occupancy, decode_tokens,
+                         prefill_tokens, flops, hbm_bytes, tok_s, mfu,
+                         bw_util, roofline_frac)
         with self._lock:
             self._ring.append(rec)
 
@@ -162,7 +185,10 @@ class FlightRecorder:
                     "ts": rec.ts * 1e6,
                     "args": {"prefill": rec.num_prefill,
                              "decode": rec.num_decode,
-                             "waiting": rec.num_waiting}})
+                             "waiting": rec.num_waiting,
+                             "tok_s": round(rec.tok_s, 1),
+                             "mfu": round(rec.mfu, 4),
+                             "bw_util": round(rec.bw_util, 4)}})
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def iter_spans(self) -> "Iterable[Span]":

@@ -54,6 +54,50 @@ _SCRATCH_CAP_BYTES = 4 * 2**20  # online-softmax VMEM scratch budget
 # jax renamed TPUCompilerParams → CompilerParams; accept both.
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
+# Mosaic min-tile sublane count by dtype itemsize (lane is always 128):
+# f32 → (8, 128), bf16 → (16, 128), int8/fp8 → (32, 128).
+_MIN_SUBLANE = {4: 8, 2: 16, 1: 32}
+
+
+def _sublane(dtype) -> int:
+    return _MIN_SUBLANE.get(jnp.dtype(dtype).itemsize, 8)
+
+
+def mosaic_block_shape_ok(block_shape: tuple[int, ...],
+                          array_shape: tuple[int, ...], dtype) -> bool:
+    """Mosaic's 2D tiling rule for a BlockSpec: each of the last two block
+    dims must either equal the array's dim (whole-axis block) or be a
+    multiple of the dtype's min tile (sublane × 128). The round-1 bench
+    failure was exactly this: a per-head block ``(1, 16, 1, 128)`` against
+    a ``[NB, BS, KH, D]`` cache put 1 in the second-to-minor position where
+    KH was 8 — neither equal nor divisible — and the kernel refused to
+    lower on TPU (BENCH_r01.json)."""
+    if len(block_shape) < 2 or len(array_shape) < 2:
+        return True
+    sub, lane = block_shape[-2], block_shape[-1]
+    asub, alane = array_shape[-2], array_shape[-1]
+    sub_ok = sub == asub or sub % _sublane(dtype) == 0
+    lane_ok = lane == alane or lane % 128 == 0
+    return sub_ok and lane_ok
+
+
+def _validate_block_specs(specs: list[tuple[str, tuple[int, ...],
+                                            tuple[int, ...], "jnp.dtype"]]) -> None:
+    """Static trace-time guard: fail with a readable error instead of a
+    deep Mosaic lowering failure on hardware. ``specs`` is a list of
+    (name, block_shape, array_shape, dtype)."""
+    bad = [
+        f"{name}: block {blk} vs array {arr} ({jnp.dtype(dt).name}: "
+        f"min tile {_sublane(dt)}x128)"
+        for name, blk, arr, dt in specs
+        if not mosaic_block_shape_ok(blk, arr, dt)
+    ]
+    if bad:
+        raise ValueError(
+            "paged-attention BlockSpec violates the TPU tiling rule (last "
+            "two block dims must equal the array dims or be multiples of "
+            "the dtype's min tile): " + "; ".join(bad))
+
 
 def _kernel(*refs, bs: int, kh: int, rep: int, quant: bool):
     if quant:
@@ -164,7 +208,15 @@ def paged_attention_kernel(
     # each KV block is still DMA'd exactly once per step on the hot path.
     r = t * rep
     rchunk = r
-    while kh * rchunk * (d + 256) * 4 > _SCRATCH_CAP_BYTES and rchunk % 2 == 0 and rchunk > rep:
+    # Halving stops while the chunk stays Mosaic-legal: a partial block's
+    # second-to-minor dim must be a multiple of the dtype's min sublane
+    # count (rchunk == r needs no divisibility — whole-axis blocks are
+    # always legal). Better to overshoot the soft scratch cap than emit a
+    # block shape the TPU refuses to lower.
+    q_sub = _sublane(q.dtype)
+    while (kh * rchunk * (d + 256) * 4 > _SCRATCH_CAP_BYTES
+           and rchunk % 2 == 0 and rchunk > rep
+           and (rchunk // 2) % q_sub == 0):
         rchunk //= 2
     nq = r // rchunk
 
@@ -180,6 +232,12 @@ def paged_attention_kernel(
         scalars = (block_tables.astype(jnp.int32), q_start.astype(jnp.int32),
                    kv_lens.astype(jnp.int32))
 
+    _validate_block_specs([
+        ("q", (1, kh, rchunk, d), qs.shape, qs.dtype),
+        ("k_cache", (1, bs, kh, d), k_cache.shape, k_cache.dtype),
+        ("v_cache", (1, bs, kh, d), v_cache.shape, v_cache.dtype),
+        ("out", (1, kh, rchunk, d), (b, kh, t * rep, d), q.dtype),
+    ])
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=len(scalars),  # block_tables, q_start, kv_lens[, scales]
         grid=(b, nq, nblk),

@@ -158,3 +158,85 @@ def test_engine_pallas_interpret_matches_dense():
         return toks
 
     assert run("dense") == run("pallas_interpret")
+
+
+# -- Mosaic tiling guard (the BENCH_r01 lowering failure) --------------------
+
+def test_mosaic_tiling_rejects_seed_era_per_head_block():
+    """The round-1 bench died lowering a per-head KV block spec
+    ``(1, 16, 1, 128)`` against the [NB, BS, KH, D] cache: 1 in the
+    second-to-minor position (KH=8) is neither the whole axis nor a
+    multiple of the min tile. The static guard must reject exactly that
+    shape and accept the whole-axis spec the kernel now uses."""
+    from dynamo_tpu.ops.paged_attention import mosaic_block_shape_ok
+
+    cache = (128, 16, 8, 128)  # bench-like: bs=16, kh=8, d=128
+    assert not mosaic_block_shape_ok((1, 16, 1, 128), cache, jnp.bfloat16)
+    assert mosaic_block_shape_ok((1, 16, 8, 128), cache, jnp.bfloat16)
+    # multiples of the min tile are fine even when not the whole axis
+    assert mosaic_block_shape_ok((1, 16, 16, 128), (128, 16, 32, 128),
+                                 jnp.bfloat16)
+    # f32 min tile is 8x128: sublane 8 divides, lane must be 128-multiple
+    assert mosaic_block_shape_ok((8, 128), (64, 128), jnp.float32)
+    assert not mosaic_block_shape_ok((8, 64), (64, 128), jnp.float32)
+
+
+def test_validate_block_specs_readable_error():
+    from dynamo_tpu.ops.paged_attention import _validate_block_specs
+
+    with pytest.raises(ValueError, match="tiling rule"):
+        _validate_block_specs([
+            ("k_cache", (1, 16, 1, 128), (128, 16, 8, 128), jnp.bfloat16)])
+    _validate_block_specs([
+        ("k_cache", (1, 16, 8, 128), (128, 16, 8, 128), jnp.bfloat16)])
+
+
+def test_paged_attention_kernel_parity_at_bench_shapes():
+    """Interpret-mode parity at the llama-3-8b-lite geometry the bench
+    actually dispatches (kh=8, d=128, bs=16) — the configuration whose
+    lowering regressed in round 1. bf16 q/cache like the real run."""
+    rng = np.random.default_rng(7)
+    case = _make_case(rng, b=2, t=1, h=8, kh=8, d=128, nb=24, bs=16, nblk=4,
+                      dtype=jnp.bfloat16)
+    q, k_cache, v_cache, block_tables, q_start, q_len = case
+    ref = _dense_ref(q, k_cache, v_cache, block_tables, q_start, q_len)
+    out = paged_attention_kernel(
+        q, k_cache, v_cache, block_tables, q_start, q_start + q_len,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_paged_attention_kernel_parity_bench_shapes_int8_cache():
+    """Same bench geometry with the int8 quantized cache (in-kernel
+    dequant): kernel vs dense on identical quantized content."""
+    from dynamo_tpu.models.llama import _gather_kv, _scatter_kv
+
+    rng = np.random.default_rng(8)
+    nb, bs, kh, d, b, h = 24, 16, 8, 128, 2, 8
+    kc = {"q": jnp.zeros((nb, bs, kh, d), jnp.int8),
+          "s": jnp.zeros((nb, kh), jnp.float32)}
+    vc = {"q": jnp.zeros((nb, bs, kh, d), jnp.int8),
+          "s": jnp.zeros((nb, kh), jnp.float32)}
+    ctx = 2 * bs
+    slots = jnp.stack([jnp.arange(ctx), 2 * bs + jnp.arange(ctx)]).astype(jnp.int32)
+    kc = _scatter_kv(kc, jnp.asarray(rng.normal(size=(b, ctx, kh, d)), jnp.float32), slots)
+    vc = _scatter_kv(vc, jnp.asarray(rng.normal(size=(b, ctx, kh, d)), jnp.float32), slots)
+    q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+    bt = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+    q_start = jnp.full((b,), ctx - 1, jnp.int32)
+    kv_lens = jnp.full((b,), ctx, jnp.int32)
+
+    out_kernel = paged_attention_kernel(q, kc, vc, bt, q_start, kv_lens,
+                                        interpret=True)
+    kg, vg = _gather_kv(kc, bt), _gather_kv(vc, bt)
+    rep = h // kh
+    qr = (q * (d ** -0.5)).reshape(b, 1, kh, rep, d).astype(jnp.float32)
+    scores = jnp.einsum("btkrd,bskd->btkrs", qr, kg.astype(jnp.float32))
+    mask = jnp.arange(ctx)[None, :] < kv_lens[:, None]
+    scores = jnp.where(mask[:, None, None, None, :], scores, -1e30)
+    ref = jnp.einsum("btkrs,bskd->btkrd",
+                     jax.nn.softmax(scores, axis=-1), vg.astype(jnp.float32))
+    err = np.abs(np.asarray(out_kernel) - np.asarray(ref.reshape(b, 1, h, d))).max()
+    assert err < 2e-4, err

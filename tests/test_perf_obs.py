@@ -1,0 +1,344 @@
+"""Performance observability: analytic cost model, step profiler, perf
+metrics family, perf_report regression diff, and the bench JSON contract.
+
+The cost-model tests pin the conventions documented in
+obs/costmodel.py (matmul-only FLOPs, block-rounded attention, int8 KV
+payload + scales) against hand-computed values — drift in either the
+model or the convention fails loudly.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import bench
+from dynamo_tpu.models.config import MODEL_PRESETS, resolve_model_config
+from dynamo_tpu.obs import costmodel as cm
+from dynamo_tpu.obs.profiler import (
+    PerfMetrics,
+    StepPerfProfiler,
+    capture_phases,
+    phase,
+)
+from dynamo_tpu.utils.metrics import MetricsRegistry
+from tools.perf_report import diff_benches, kernel_rows, load_bench
+
+from tests.test_engine import make_req, run_to_completion, tiny_config
+
+
+# ---------------------------------------------------------------------------
+# Analytic cost model vs hand-computed values
+# ---------------------------------------------------------------------------
+
+def test_paged_attention_cost_bf16_hand_computed():
+    # B=2 rows of 1 query token, H=4, KH=2, D=16, context 10 @ block 4:
+    # 3 blocks DMA'd -> S = 12 block-rounded context positions.
+    c = cm.paged_attention_cost(
+        batch=2, q_tokens=1, num_heads=4, num_kv_heads=2, head_dim=16,
+        kv_len=10, block_size=4, kv_dtype="bfloat16")
+    assert c.flops == 4 * 2 * 1 * 4 * 16 * 12          # QK^T + PV matmuls
+    q_bytes = 2 * 1 * 4 * 16 * 2                       # Q read (bf16)
+    kv_bytes = 2 * 2 * 3 * (4 * 2 * 16 * 2)            # K and V, 3 blocks/row
+    assert c.hbm_bytes == q_bytes + kv_bytes + q_bytes  # + output write
+
+
+def test_paged_attention_cost_int8_halves_kv_payload():
+    kw = dict(batch=2, q_tokens=1, num_heads=4, num_kv_heads=2, head_dim=16,
+              kv_len=10, block_size=4)
+    bf16 = cm.paged_attention_cost(kv_dtype="bfloat16", **kw)
+    int8 = cm.paged_attention_cost(kv_dtype="int8", **kw)
+    assert int8.flops == bf16.flops                     # same matmul volume
+    # int8 block: half payload + per-(block, kv-head) f32 scales.
+    kv_block = 4 * 2 * 16 * 1 + 2 * 4
+    q_bytes = 2 * 1 * 4 * 16 * 2
+    assert int8.hbm_bytes == 2 * q_bytes + 2 * 2 * 3 * kv_block
+    assert int8.hbm_bytes < bf16.hbm_bytes
+
+
+def test_dense_matmul_cost_hand_computed():
+    c = cm.dense_matmul_cost(8, 16, 32)
+    assert c.flops == 2 * 8 * 16 * 32
+    assert c.hbm_bytes == (8 * 32 + 32 * 16 + 8 * 16) * 2
+    assert c.intensity == pytest.approx(c.flops / c.hbm_bytes)
+
+
+def test_kernel_cost_roofline_bound():
+    hw = cm.HardwareSpec("x", peak_flops=100.0, hbm_bw=10.0)  # ridge = 10
+    bw_bound = cm.KernelCost("a", flops=50.0, hbm_bytes=20.0)  # intensity 2.5
+    compute = cm.KernelCost("b", flops=500.0, hbm_bytes=10.0)  # intensity 50
+    assert bw_bound.bound(hw) == "bandwidth"
+    assert compute.bound(hw) == "compute"
+    assert bw_bound.time_bound(hw) == pytest.approx(2.0)   # 20B / 10 B/s
+    assert compute.time_bound(hw) == pytest.approx(5.0)    # 500F / 100 F/s
+
+
+def test_decode_step_cost_composition():
+    """The per-phase decomposition recomposes to the closed-form totals."""
+    cfg = resolve_model_config("tiny-llama")
+    batch, kv_len, bs = 4, 10, 4
+    phases = cm.decode_step_cost(cfg, batch=batch, kv_len=kv_len,
+                                 block_size=bs)
+    h, L = cfg.hidden_size, cfg.num_layers
+    s = 12  # ceil(10/4) * 4
+    assert phases["attention"].flops == (
+        4 * cfg.num_heads * cfg.head_dim * batch * s * L)
+    assert phases["proj"].flops == (
+        2 * batch * h * (2 * cfg.q_size + 2 * cfg.kv_size) * L)
+    assert phases["mlp"].flops == 6 * batch * h * cfg.intermediate_size * L
+    assert phases["logits"].flops == 2 * batch * cfg.vocab_size * h
+    assert phases["sampling"].flops == 0
+    total = cm.total_cost(phases)
+    assert total.flops == sum(p.flops for p in phases.values())
+    assert total.hbm_bytes == sum(p.hbm_bytes for p in phases.values())
+
+
+def test_decode_step_int8_kv_moves_fewer_bytes():
+    cfg = MODEL_PRESETS["llama-3-8b-lite"]
+    kw = dict(batch=32, kv_len=160, block_size=16)
+    bf16 = cm.total_cost(cm.decode_step_cost(cfg, kv_dtype="bfloat16", **kw))
+    int8 = cm.total_cost(cm.decode_step_cost(cfg, kv_dtype="int8", **kw))
+    assert int8.flops == bf16.flops
+    assert int8.hbm_bytes < bf16.hbm_bytes
+
+
+def test_analytic_param_bytes_matches_runtime():
+    """Shape-derived parameter bytes == bytes of actually-initialized
+    params (both precisions), so roofline predictions use real weights."""
+    import jax
+
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.quant import param_bytes, quantize_params_int8
+
+    cfg = resolve_model_config("tiny-llama")
+    params = llama.init_params(cfg, jax.random.key(0))
+    assert cm.analytic_param_bytes(cfg, "none") == param_bytes(params)
+    qparams = quantize_params_int8(params, cfg)
+    # Quantized: matmul leaves shrink to 1B + f32 scales; the analytic twin
+    # ignores the (per-channel, O(h)) scale vectors -> small underestimate.
+    analytic = cm.analytic_param_bytes(cfg, "int8")
+    actual = param_bytes(qparams)
+    assert analytic <= actual < analytic * 1.1
+
+
+def test_hw_spec_lookup():
+    assert cm.hw_spec_for("TPU v5 lite").name == "tpu-v5e"
+    assert cm.hw_spec_for("TPU v5p chip").name == "tpu-v5p"
+    assert cm.hw_spec_for("TPU v6e").name == "tpu-v6e"
+    assert cm.hw_spec_for("Grace CPU").name == "cpu"
+    assert cm.hw_spec_for("").name == "cpu"  # unknown -> conservative
+
+
+def test_predicted_decode_perf_bandwidth_bound():
+    cfg = MODEL_PRESETS["llama-3-8b-lite"]
+    pred = cm.predicted_decode_perf(
+        cfg, cm.hw_spec_for("tpu v5 lite"), batch=32, kv_len=160)
+    assert pred["bound"] == "bandwidth"
+    assert pred["tok_s"] > 0
+    assert pred["bw_util_at_roofline"] == pytest.approx(1.0)
+    assert 0 < pred["mfu_at_roofline"] < 1
+
+
+# ---------------------------------------------------------------------------
+# Phase hooks
+# ---------------------------------------------------------------------------
+
+def test_phase_is_named_scope_outside_capture():
+    import jax
+    assert isinstance(phase("attention"), type(jax.named_scope("x")))
+
+
+def test_capture_phases_accumulates_wall():
+    with capture_phases() as sink:
+        with phase("attention"):
+            pass
+        with phase("attention"):
+            pass
+        with phase("logits"):
+            pass
+    assert set(sink) == {"attention", "logits"}
+    assert sink["attention"] >= 0.0
+    # capture is scoped: hooks revert to named_scope afterwards
+    import jax
+    assert isinstance(phase("attention"), type(jax.named_scope("x")))
+
+
+# ---------------------------------------------------------------------------
+# Step profiler: engine integration + disabled-mode bound
+# ---------------------------------------------------------------------------
+
+def test_engine_step_ring_carries_perf_counters():
+    from dynamo_tpu.engine.engine import EngineCore
+    from dynamo_tpu.obs.tracer import get_tracer
+
+    core = EngineCore(tiny_config())
+    run_to_completion(core, [make_req(), make_req()])
+    recs = [r for r in get_tracer().recorder.steps.snapshot()
+            if r.flops > 0]
+    assert recs, "no step record carried perf counters"
+    rec = recs[-1]
+    d = rec.to_dict()
+    for key in ("decode_tokens", "prefill_tokens", "flops", "hbm_bytes",
+                "tok_s", "mfu", "bw_util", "roofline_frac"):
+        assert key in d
+    assert rec.hbm_bytes > 0 and rec.tok_s > 0
+    assert 0 <= rec.mfu <= 1.5  # tiny model on CPU spec: loose sanity bound
+
+
+def test_profiler_disabled_is_inert(monkeypatch):
+    """DYN_PERF_PROFILE=0: measure() returns {} BEFORE any cost-model math
+    (the overhead bound) and the engine still steps fine."""
+    monkeypatch.setenv("DYN_PERF_PROFILE", "0")
+    cfg = resolve_model_config("tiny-llama")
+    prof = StepPerfProfiler(tiny_config_model(), tiny_config(),
+                            device_kind="cpu")
+    assert prof.enabled is False
+    monkeypatch.setattr(cm, "model_step_cost",
+                        _raise_if_called, raising=True)
+    assert prof.measure([("decode", [(0, 5, 1)], [0], _FakeArr((1,)), None)],
+                        0.01) == {}
+    del cfg
+
+    from dynamo_tpu.engine.engine import EngineCore
+    core = EngineCore(tiny_config())
+    out, fin = run_to_completion(core, [make_req()])
+    assert fin  # engine unaffected
+    assert core.perf.enabled is False
+
+
+def tiny_config_model():
+    return resolve_model_config("tiny-llama")
+
+
+class _FakeArr:
+    def __init__(self, shape):
+        self.shape = shape
+        self.ndim = len(shape)
+
+
+def _raise_if_called(*a, **k):
+    raise AssertionError("cost model must not run when profiler disabled")
+
+
+def test_profiler_charges_decode_and_prefill_rows():
+    ecfg = tiny_config()
+    prof = StepPerfProfiler(tiny_config_model(), ecfg, device_kind="cpu",
+                            enabled=True)
+    batches = [
+        ("prefill", [(0, 0, 8)], [0], _FakeArr((1,)), None),
+        ("decode", [(1, 8, 1), (2, 12, 1)], [0, 1], _FakeArr((2,)), None),
+    ]
+    fields = prof.measure(batches, wall_s=0.05)
+    assert fields["prefill_tokens"] == 8
+    assert fields["decode_tokens"] == 2
+    assert fields["flops"] > 0 and fields["hbm_bytes"] > 0
+    assert fields["tok_s"] == pytest.approx(2 / 0.05)  # generated tokens/s
+
+
+def test_perf_metrics_family_exposed():
+    reg = MetricsRegistry()
+    PerfMetrics(reg)
+    text = reg.expose()
+    for name in ("dynamo_engine_perf_mfu", "dynamo_engine_perf_hbm_bw_util",
+                 "dynamo_engine_perf_roofline_fraction",
+                 "dynamo_engine_perf_model_flops_total",
+                 "dynamo_engine_perf_hbm_bytes_total",
+                 "dynamo_engine_perf_step_seconds"):
+        assert name in text
+
+
+# ---------------------------------------------------------------------------
+# perf_report: BENCH parsing + regression diff
+# ---------------------------------------------------------------------------
+
+def _wrap(n, rc, parsed):
+    return {"n": n, "cmd": "python bench.py", "rc": rc, "tail": "",
+            "parsed": parsed}
+
+
+def test_load_bench_driver_wrapper_and_raw(tmp_path):
+    ok = tmp_path / "BENCH_r01.json"
+    ok.write_text(json.dumps(_wrap(1, 0, {
+        "metric": "m", "value": 123.4, "vs_baseline": 0.1})))
+    e = load_bench(ok)
+    assert e["run"] == 1 and e["value"] == 123.4 and e["error"] is None
+
+    failed = tmp_path / "BENCH_r02.json"
+    failed.write_text(json.dumps(_wrap(2, 1, None)))
+    e = load_bench(failed)
+    assert e["value"] is None and e["error"] == "no JSON parsed"
+
+    raw = tmp_path / "BENCH_r03.json"
+    raw.write_text(json.dumps({"metric": "m", "value": 99.0,
+                               "fallback": "cpu_probe"}))
+    e = load_bench(raw)
+    assert e["run"] == 3 and e["fallback"] == "cpu_probe"
+
+
+def test_diff_flags_regressions_within_comparable_class(tmp_path):
+    files = [
+        _wrap(1, 0, {"metric": "m", "value": 100.0, "fallback": None}),
+        _wrap(2, 0, {"metric": "m", "value": 95.0, "fallback": None}),
+        _wrap(3, 0, {"metric": "m", "value": 50.0, "fallback": None}),
+        # cpu_probe numbers never compare against device numbers:
+        _wrap(4, 0, {"metric": "m", "value": 8.0, "fallback": "cpu_probe"}),
+        _wrap(5, 1, {"metric": "m", "value": None, "error": "boom",
+                     "fallback": None}),
+    ]
+    paths = []
+    for i, w in enumerate(files, 1):
+        p = tmp_path / f"BENCH_r{i:02d}.json"
+        p.write_text(json.dumps(w))
+        paths.append(p)
+    entries = diff_benches([load_bench(p) for p in paths])
+    by_run = {e["run"]: e for e in entries}
+    assert by_run[1]["status"] == "ok"
+    assert by_run[2]["status"] == "ok"          # within 10% of best
+    assert by_run[3]["status"] == "regression"  # 50 << 100
+    assert by_run[3]["regressed_from"] == 100.0
+    assert by_run[4]["status"] == "fallback"    # own class, no comparison
+    assert by_run[5]["status"] == "failed"
+
+
+def test_perf_report_check_smoke():
+    from tools.perf_report import main as perf_main
+    assert perf_main(["--check"]) == 0
+
+
+def test_kernel_rows_cover_both_kv_modes():
+    cfg = MODEL_PRESETS["llama-3-8b-lite"]
+    rows = kernel_rows(cfg, cm.hw_spec_for("tpu v5 lite"), batch=32,
+                       context=160, block_size=16, quantization="none",
+                       measured_step_s=32 / 440.2)
+    pa = {r["kv_dtype"]: r for r in rows if r["kernel"] == "paged_attention"}
+    assert set(pa) == {"bfloat16", "int8"}
+    for r in pa.values():
+        assert r["achieved"] and 0 < r["mfu"] < 1 and 0 < r["bw_util"] < 1
+
+
+# ---------------------------------------------------------------------------
+# bench.py JSON contract
+# ---------------------------------------------------------------------------
+
+def test_bench_fail_json_contract(capsys):
+    """A failure line always carries error + explicit fallback:null, value
+    null, and (when the cost model resolves) the predicted device perf."""
+    with pytest.raises(SystemExit) as exc:
+        bench.fail("unit_test", "synthetic failure", probe_log="tail text")
+    assert exc.value.code == 1
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] is None
+    assert out["fallback"] is None
+    assert out["error"].startswith("unit_test:")
+    assert out["probe_log"] == "tail text"
+    assert out["metric"] == bench.METRIC
+    pred = out.get("predicted")
+    assert pred and pred["source"] == "costmodel" and pred["tok_s"] > 0
+
+
+def test_bench_predicted_perf_targets_device():
+    pred = bench._predicted_perf()
+    assert pred is not None
+    assert pred["device"] == "tpu-v5e"
+    assert pred["bound"] in ("bandwidth", "compute")

@@ -54,6 +54,19 @@ KV_TRANSFER_METRICS = (
     "kv_transfer_wave_bytes",
 )
 
+# The engine performance-counter family (obs/profiler.py PerfMetrics):
+# MFU / HBM-bandwidth / roofline gauges plus cumulative FLOPs-and-bytes
+# counters. Same bidirectional drift rule as KV_TRANSFER_METRICS.
+PERF_METRICS = (
+    "engine_perf_tokens_per_second",
+    "engine_perf_mfu",
+    "engine_perf_hbm_bw_util",
+    "engine_perf_roofline_fraction",
+    "engine_perf_model_flops_total",
+    "engine_perf_hbm_bytes_total",
+    "engine_perf_step_seconds",
+)
+
 # The failure-recovery family: health canaries (runtime/health.py),
 # migration re-dispatch (frontend/migration.py), and chaos injection
 # (chaos/metrics.py). Same bidirectional drift rule as KV_TRANSFER_METRICS:
@@ -184,6 +197,23 @@ def _lint_kv_transfer_metrics(root: Path, problems: list[str]) -> None:
             "does not register it")
 
 
+def _lint_perf_metrics(root: Path, problems: list[str]) -> None:
+    """The dynamo_engine_perf_* family must match what obs/profiler.py
+    actually registers — same no-silent-drift rule as KV_TRANSFER_METRICS."""
+    actual = _registered_names(root / "obs" / "profiler.py")
+    if actual is None:
+        return
+    declared = set(PERF_METRICS)
+    for key in sorted(actual - declared):
+        problems.append(
+            f"obs/profiler.py registers {key!r} but it is missing from "
+            "tools/lint_metrics.py PERF_METRICS")
+    for key in sorted(declared - actual):
+        problems.append(
+            f"PERF_METRICS declares {key!r} but obs/profiler.py "
+            "does not register it")
+
+
 def _lint_recovery_metrics(root: Path, problems: list[str]) -> None:
     """The recovery family must match what each module actually registers
     — same no-silent-drift rule as KV_TRANSFER_METRICS."""
@@ -238,6 +268,7 @@ def lint_tree(root: Path | None = None) -> list[str]:
         _lint_module(path, problems)
     _lint_provider_metrics(root, problems)
     _lint_kv_transfer_metrics(root, problems)
+    _lint_perf_metrics(root, problems)
     _lint_recovery_metrics(root, problems)
     return problems
 
