@@ -363,7 +363,8 @@ def main(argv: list[str] | None = None) -> dict:
                          "deadline (must never reach prefill)")
     ap.add_argument("--chips", type=int, default=1,
                     help="chips serving the endpoint (for tok/s/chip)")
-    ap.add_argument("--kv-dtype", choices=["bfloat16", "int8"], default=None,
+    ap.add_argument("--kv-dtype", choices=["bfloat16", "int8", "int4"],
+                    default=None,
                     help="KV-cache dtype the serving engine was launched "
                          "with; recorded in the result JSON and checked "
                          "against the engine's dynamo_engine_kv_quant_enabled "

@@ -66,11 +66,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--quantization", choices=["none", "int8"], default="none",
                    help="weight-only quantization (int8: per-channel scales, "
                         "bf16 compute; halves decode HBM traffic)")
-    p.add_argument("--kv-dtype", choices=["bfloat16", "int8"],
+    p.add_argument("--kv-dtype", choices=["bfloat16", "int8", "int4"],
                    default="bfloat16",
                    help="paged KV cache storage dtype (int8: per-block-per-"
                         "head scales, in-kernel dequant; halves KV bytes so "
-                        "auto-sizing fits ~2x the blocks)")
+                        "auto-sizing fits ~2x the blocks; int4: packed "
+                        "nibbles, quarter bytes / ~4x blocks, even head_dim)")
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--speedup-ratio", type=float, default=10.0, help="mocker only")
     p.add_argument("--no-kv-events", action="store_true")

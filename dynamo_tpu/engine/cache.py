@@ -12,6 +12,12 @@ With ``kv_dtype="int8"`` each cache becomes a two-leaf pytree
 ``{"q", "so"}`` weight-quant idiom in models/llama.py. Everything downstream
 (scan over layers, donation, shard_map in_specs) treats the cache as a
 pytree, so the plain-array fast path is structurally unchanged.
+
+``kv_dtype="int4"`` keeps the same pytree but packs two signed nibbles per
+byte along head_dim: ``{"q": uint8 [L, NB, BS, KH, D//2], "s": f32}`` —
+the uint8 payload dtype IS the packed-int4 marker everywhere downstream
+(kernel, kvbm, scatter), so no third leaf or flag is needed. A block costs
+~0.25x its bf16 bytes, so auto-sizing fits ~4x the blocks.
 """
 
 from __future__ import annotations
@@ -38,8 +44,8 @@ class KVCacheSpec:
     num_kv_heads: int
     head_dim: int
     dtype: str = "bfloat16"
-    #: "int8" enables quantized storage; any other value means the cache is
-    #: stored at ``dtype`` (model precision) exactly as before.
+    #: "int8" / "int4" enable quantized storage; any other value means the
+    #: cache is stored at ``dtype`` (model precision) exactly as before.
     kv_dtype: str = "bfloat16"
 
     @classmethod
@@ -57,20 +63,47 @@ class KVCacheSpec:
 
     @property
     def quantized(self) -> bool:
-        return self.kv_dtype == "int8"
+        return self.kv_dtype in ("int8", "int4")
+
+    @property
+    def packed_int4(self) -> bool:
+        return self.kv_dtype == "int4"
+
+    @property
+    def payload_dtype(self):
+        """Storage dtype of the quantized payload leaf. uint8 is the packed
+        int4 marker (two nibbles per byte); int8 means one byte per elem."""
+        return jnp.uint8 if self.packed_int4 else jnp.int8
+
+    @property
+    def payload_head_dim(self) -> int:
+        """Trailing payload dim: head_dim, halved when int4-packed."""
+        if self.packed_int4:
+            if self.head_dim % 2:
+                raise ValueError(
+                    f"kv_dtype=int4 needs an even head_dim, got {self.head_dim}")
+            return self.head_dim // 2
+        return self.head_dim
 
     @property
     def shape(self) -> tuple[int, int, int, int, int]:
         return (self.num_layers, self.num_blocks, self.block_size, self.num_kv_heads, self.head_dim)
 
     @property
+    def payload_shape(self) -> tuple[int, int, int, int, int]:
+        """Stored payload shape: == ``shape`` except int4 packs head_dim/2."""
+        return (self.num_layers, self.num_blocks, self.block_size,
+                self.num_kv_heads, self.payload_head_dim)
+
+    @property
     def scale_shape(self) -> tuple[int, int, int]:
-        """Quantization scale tensor [layers, blocks, kv_heads] (int8 mode)."""
+        """Quantization scale tensor [layers, blocks, kv_heads] (int8/int4)."""
         return (self.num_layers, self.num_blocks, self.num_kv_heads)
 
     def bytes_per_block(self) -> int:
         if self.quantized:
-            payload = 2 * self.num_layers * self.block_size * self.num_kv_heads * self.head_dim
+            payload = (2 * self.num_layers * self.block_size
+                       * self.num_kv_heads * self.payload_head_dim)
             scales = 2 * self.num_layers * self.num_kv_heads * _SCALE_ITEMSIZE
             return payload + scales
         itemsize = jnp.dtype(self.dtype).itemsize
@@ -85,7 +118,7 @@ def allocate_cache(spec: KVCacheSpec, mesh: Mesh | None = None):
     (payload and scales sharded with per-leaf out_shardings)."""
     if spec.quantized:
         def qzeros():
-            return {"q": jnp.zeros(spec.shape, jnp.int8),
+            return {"q": jnp.zeros(spec.payload_shape, spec.payload_dtype),
                     "s": jnp.zeros(spec.scale_shape, jnp.float32)}
         if mesh is not None:
             sh = {"q": NamedSharding(mesh, kv_cache_spec()),

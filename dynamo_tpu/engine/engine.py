@@ -264,6 +264,7 @@ class ModelRunner:
         moe_impl = "ep" if self.engine_cfg.ep > 1 else "dense"
         mesh = self.mesh
         pp_micro = self.engine_cfg.pp_microbatches
+        attn_splits = self.engine_cfg.attn_num_splits
 
         def step(params, ck, cv, counts, keys, slot_toks, tokens, q_start, q_len,
                  bt, slots, temp, top_k, top_p, fp, pp, rp, do_sample, from_slot,
@@ -283,7 +284,8 @@ class ModelRunner:
                                            mesh=mesh, sp_prefill=sp_prefill,
                                            embed_override=emb_override,
                                            embed_mask=emb_mask,
-                                           pp_microbatches=pp_micro)
+                                           pp_microbatches=pp_micro,
+                                           attn_num_splits=attn_splits)
             logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
             if masked:
                 # Structured output (engine/guided.py): the grammar's
@@ -352,6 +354,7 @@ class ModelRunner:
         moe_impl = "ep" if self.engine_cfg.ep > 1 else "dense"
         mesh = self.mesh
         pp_micro = self.engine_cfg.pp_microbatches
+        attn_splits = self.engine_cfg.attn_num_splits
 
         def step(params, ck, cv, counts, keys, slot_toks, tokens, q_start, q_len,
                  bt, slots, temp, top_k, top_p, fp, pp, rp, do_sample, from_slot):
@@ -363,7 +366,7 @@ class ModelRunner:
                 hidden, ck, cv = llama.forward(
                     params, cfg, cur[:, None], q_start + j, q_len, bt, ck, cv,
                     attn_impl=attn_impl, moe_impl=moe_impl, mesh=mesh,
-                    pp_microbatches=pp_micro)
+                    pp_microbatches=pp_micro, attn_num_splits=attn_splits)
                 logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
                 with _perf_phase("sampling"):
                     if fast_greedy:
@@ -581,12 +584,13 @@ class ModelRunner:
         attn_impl = self.attn_impl
         moe_impl = "ep" if self.engine_cfg.ep > 1 else "dense"
         mesh = self.mesh
+        attn_splits = self.engine_cfg.attn_num_splits
 
         def verify(params, ck, cv, tokens, q_start, q_len, bt):
             hidden, ck, cv = llama.forward(
                 params, cfg, tokens, q_start, q_len, bt, ck, cv,
                 attn_impl=attn_impl, moe_impl=moe_impl, mesh=mesh,
-                return_all_hidden=True)
+                return_all_hidden=True, attn_num_splits=attn_splits)
             logits = llama.logits_from_hidden(params, cfg, hidden).astype(jnp.float32)
             toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)      # [B, t]
             lps = jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
@@ -759,15 +763,24 @@ class EngineCore:
             raise ValueError(
                 f"unknown quantization {engine_cfg.quantization!r} "
                 "(supported: none, int8)")
-        if engine_cfg.kv_dtype not in ("bfloat16", "", "int8"):
+        if engine_cfg.kv_dtype not in ("bfloat16", "", "int8", "int4"):
             raise ValueError(
                 f"unknown kv_dtype {engine_cfg.kv_dtype!r} "
-                "(supported: bfloat16 [model-precision cache], int8)")
+                "(supported: bfloat16 [model-precision cache], int8, int4)")
+        if engine_cfg.attn_num_splits < 0:
+            raise ValueError(
+                f"attn_num_splits must be >= 0 (0 = auto), "
+                f"got {engine_cfg.attn_num_splits}")
+        self.model_cfg = resolve_model_config(engine_cfg.model)
+        if engine_cfg.kv_dtype == "int4" and self.model_cfg.head_dim % 2:
+            raise ValueError(
+                f"kv_dtype=int4 packs two nibbles per byte along head_dim and "
+                f"needs it even; model {engine_cfg.model!r} has head_dim="
+                f"{self.model_cfg.head_dim}")
         if mesh is None and any(v != 1 for v in engine_cfg.mesh_shape().values()):
             mesh = make_mesh(MeshConfig(dp=engine_cfg.dp, pp=engine_cfg.pp,
                                         sp=engine_cfg.sp, tp=engine_cfg.tp,
                                         ep=engine_cfg.ep))
-        self.model_cfg = resolve_model_config(engine_cfg.model)
         self.runner = ModelRunner(self.model_cfg, engine_cfg, mesh=mesh, params=params,
                                   rng_seed=engine_cfg.seed)
         self.pool = PrefixPool(

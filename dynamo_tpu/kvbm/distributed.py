@@ -39,8 +39,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from dynamo_tpu.engine.cache import KVCacheSpec, cache_payload
 from dynamo_tpu.kvbm.transfer import (
     BlockTransferEngine, _extract, _extract_deq, _extract_q, _inject,
-    _inject_q, _inject_quant, _is_packed, _pad_pow2, dequantize_block,
-    pack_kv_block, unpack_kv_block)
+    _inject_q, _inject_quant, _is_packed, _packed_kind, _pad_pow2,
+    dequantize_block, pack_kv_block, unpack_kv_block)
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("kvbm.distributed")
@@ -145,33 +145,49 @@ class ShardedBlockTransferEngine(BlockTransferEngine):
                         payload_ref.shape[3], payload_ref.shape[4])
         starts, stops = local_box(payload_ref)
         loc_shape = (stops[0] - starts[0], BS, stops[3] - starts[3], D)
+        int4_cache = quant_cache and payload_ref.dtype == jnp.uint8
+        # loc_shape's trailing dim is the PAYLOAD dim (head_dim/2 when the
+        # cache is packed int4); float blocks carry the logical head_dim.
+        D_log = D * 2 if int4_cache else D
+        loc_logical = loc_shape[:3] + (D_log,)
         packed = _is_packed(blocks[0])
         if quant_cache and packed:
-            ups = [unpack_kv_block(b, loc_shape) for b in blocks + pad]
-            payload = np.stack([p for p, _ in ups])  # [n,2,L_loc,BS,H_loc,D]
-            scales = np.stack([s for _, s in ups])   # [n,2,L_loc,H_loc]
-            p_gshape = (L, len(padded), BS, KH, D)
-            p_offs = (starts[0], 0, 0, starts[3], 0)
-            s_gshape = (L, len(padded), KH)
-            s_offs = (starts[0], 0, starts[3])
-            mk_p = lambda x: self._make_global(
-                np.moveaxis(x, 0, 1), np.int8, p_gshape, p_offs, self._out_spec)
-            mk_s = lambda x: self._make_global(
-                np.moveaxis(x, 0, 1), np.float32, s_gshape, s_offs,
-                self._scale_spec)
-            return self._inject_q(
-                cache_k, cache_v, jnp.asarray(padded, jnp.int32),
-                mk_p(payload[:, 0]), mk_s(scales[:, 0]),
-                mk_p(payload[:, 1]), mk_s(scales[:, 1]))
+            want = "int4" if int4_cache else "int8"
+            if _packed_kind(blocks[0], loc_logical) == want:
+                pdt = np.uint8 if int4_cache else np.int8
+                ups = [unpack_kv_block(b, loc_shape, pdt)
+                       for b in blocks + pad]
+                payload = np.stack([p for p, _ in ups])  # [n,2,L_loc,BS,H_loc,Dp]
+                scales = np.stack([s for _, s in ups])   # [n,2,L_loc,H_loc]
+                p_gshape = (L, len(padded), BS, KH, D)
+                p_offs = (starts[0], 0, 0, starts[3], 0)
+                s_gshape = (L, len(padded), KH)
+                s_offs = (starts[0], 0, starts[3])
+                mk_p = lambda x: self._make_global(
+                    np.moveaxis(x, 0, 1), pdt, p_gshape, p_offs,
+                    self._out_spec)
+                mk_s = lambda x: self._make_global(
+                    np.moveaxis(x, 0, 1), np.float32, s_gshape, s_offs,
+                    self._scale_spec)
+                return self._inject_q(
+                    cache_k, cache_v, jnp.asarray(padded, jnp.int32),
+                    mk_p(payload[:, 0]), mk_s(scales[:, 0]),
+                    mk_p(payload[:, 1]), mk_s(scales[:, 1]))
+            # Cross-kind import: dequantize the local shard, requantize on
+            # device through the float path below.
+            blocks = [dequantize_block(b, loc_logical, np.float32)
+                      for b in blocks]
+            pad = [blocks[-1]] * len(pad)
+            packed = False
         if packed:
-            # int8 snapshot into a float engine: dequantize the local shard.
-            blocks = [dequantize_block(b, loc_shape, payload_ref.dtype)
+            # Quantized snapshot into a float engine: dequantize the local shard.
+            blocks = [dequantize_block(b, loc_logical, payload_ref.dtype)
                       for b in blocks]
             pad = [blocks[-1]] * len(pad)
         data = np.stack(list(blocks) + pad)
         dk_local = np.ascontiguousarray(np.moveaxis(data[:, 0], 0, 1))
         dv_local = np.ascontiguousarray(np.moveaxis(data[:, 1], 0, 1))
-        gshape = (L, len(padded), BS, KH, D)
+        gshape = (L, len(padded), BS, KH, D_log)
         offs = (starts[0], 0, 0, starts[3], 0)  # sharded axes: layers, heads
         dtype = jnp.float32 if quant_cache else payload_ref.dtype
         dk = self._make_global(dk_local, dtype, gshape, offs, self._out_spec)

@@ -45,8 +45,9 @@ class TierStats:
 
 def block_shape(spec: KVCacheSpec) -> tuple[int, ...]:
     """Host-side shape of one tiered block. Quantized specs store the packed
-    flat layout (int8 payload + f32 scale sidecar — see kvbm.transfer), so
-    their tier footprint really is ``bytes_per_block()``, i.e. ~half bf16."""
+    flat layout (int8 or nibble-packed int4 payload + f32 scale sidecar —
+    see kvbm.transfer), so their tier footprint really is
+    ``bytes_per_block()``: ~half bf16 for int8, ~a quarter for int4."""
     if spec.quantized:
         return (spec.bytes_per_block(),)
     return (2, spec.num_layers, spec.block_size, spec.num_kv_heads, spec.head_dim)

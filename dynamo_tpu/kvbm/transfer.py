@@ -14,17 +14,23 @@ Host-side block formats:
 
 * float caches: one ``np.ndarray`` of shape
   ``[2, layers, block_size, kv_heads, head_dim]`` (index 0 = K, 1 = V).
-* int8-quantized caches (engine/cache.py ``{"q","s"}`` pytrees): one FLAT
-  ``uint8`` array of ``spec.bytes_per_block()`` bytes — the int8 payload
-  ``[2, L, BS, KH, D]`` followed by the float32 scales ``[2, L, KH]``
-  (``pack_kv_block``/``unpack_kv_block``). Half the host/disk/DCN footprint
-  of the bf16 block.
+* quantized caches (engine/cache.py ``{"q","s"}`` pytrees): one FLAT
+  ``uint8`` array of ``spec.bytes_per_block()`` bytes — the payload
+  ``[2, L, BS, KH, Dp]`` followed by the float32 scales ``[2, L, KH]``
+  (``pack_kv_block``/``unpack_kv_block``). For int8 the payload trailing
+  dim Dp equals head_dim (one signed byte per element, half the bf16
+  footprint); for int4 it is head_dim/2 (two signed nibbles per byte,
+  ops/paged_attention's split-half packing — a quarter the footprint).
+  The two packed kinds share the flat layout and are told apart by byte
+  LENGTH alone (their payloads differ by exactly 2x for the same logical
+  shape), so stored/DCN'd blocks carry no extra header.
 
 ``inject`` accepts either format against either cache kind and converts at
 the boundary (mixed-precision import: a bf16 snapshot flows into an int8
 engine by on-device requantization, an int8 snapshot into a float engine by
-host-side dequantization). ``extract(dequant=True)`` yields float blocks
-from a quantized cache — the sharded disagg staging path needs the
+host-side dequantization, an int8 snapshot into an int4 engine — or vice
+versa — by host dequant + requant). ``extract(dequant=True)`` yields float
+blocks from a quantized cache — the sharded disagg staging path needs the
 box-sliceable 6-d layout (disagg/sharded.py).
 """
 
@@ -34,8 +40,34 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dynamo_tpu.ops.paged_attention import INT4_QMAX, pack_int4, unpack_int4
+
 #: divide-guard for quantization scales (matches models/llama._KV_SCALE_EPS)
 _EPS = 1e-8
+
+
+def _np_pack_int4(vals: np.ndarray) -> np.ndarray:
+    """Host-side mirror of ops.paged_attention.pack_int4 (same split-half
+    nibble convention): int values in [-8, 7], even trailing dim → uint8
+    with trailing dim halved."""
+    d = vals.shape[-1]
+    if d % 2:
+        raise ValueError(f"int4 packing needs an even trailing dim, got {d}")
+    w = vals.astype(np.int32)
+    lo = w[..., : d // 2] & 0xF
+    hi = w[..., d // 2:] & 0xF
+    return (lo | (hi << 4)).astype(np.uint8)
+
+
+def _np_unpack_int4(packed: np.ndarray) -> np.ndarray:
+    """Host-side mirror of ops.paged_attention.unpack_int4: uint8 nibble
+    pairs → int32 values in [-8, 7] with trailing dim doubled."""
+    w = packed.astype(np.int32)
+    lo = w & 0xF
+    hi = (w >> 4) & 0xF
+    lo = lo - ((lo & 0x8) << 1)
+    hi = hi - ((hi & 0x8) << 1)
+    return np.concatenate([lo, hi], axis=-1)
 
 
 def _pad_pow2(ids: list[int], cap: int = 256) -> list[int]:
@@ -67,7 +99,10 @@ def _extract_q(ck, cv, ids):
 
 
 def _dequant_slice(c, ids):
-    g = c["q"][:, ids].astype(jnp.float32)            # [L, n, BS, KH, D]
+    g = c["q"][:, ids]                                # [L, n, BS, KH, Dp]
+    if g.dtype == jnp.uint8:  # packed int4: widen nibbles first
+        g = unpack_int4(g)
+    g = g.astype(jnp.float32)                         # [L, n, BS, KH, D]
     return g * c["s"][:, ids][:, :, None, :, None]
 
 
@@ -80,20 +115,25 @@ def _inject_q(ck, cv, ids, kq, ks, vq, vs):
             {"q": cv["q"].at[:, ids].set(vq), "s": cv["s"].at[:, ids].set(vs)})
 
 
-def _quantize_lnh(x):
-    """[L, n, BS, KH, D] float → (int8 payload, [L, n, KH] scales):
-    symmetric per-(layer, block, kv-head) abs-max, the same scheme
-    models/llama._scatter_kv_quant commits at write time."""
+def _quantize_lnh(x, int4: bool = False):
+    """[L, n, BS, KH, D] float → (payload, [L, n, KH] scales): symmetric
+    per-(layer, block, kv-head) abs-max, the same scheme
+    models/llama._scatter_kv_quant commits at write time. ``int4`` packs
+    two signed nibbles per byte (uint8 payload, trailing dim halved)."""
+    qmax = INT4_QMAX if int4 else 127.0
     x = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(x), axis=(2, 4))
-    s = jnp.maximum(amax / 127.0, _EPS)
-    q = jnp.clip(jnp.round(x / s[:, :, None, :, None]), -127, 127)
+    s = jnp.maximum(amax / qmax, _EPS)
+    q = jnp.clip(jnp.round(x / s[:, :, None, :, None]), -qmax, qmax)
+    if int4:
+        return pack_int4(q.astype(jnp.int32)), s
     return q.astype(jnp.int8), s
 
 
 def _inject_quant(ck, cv, ids, dk, dv):
-    kq, ks = _quantize_lnh(dk)
-    vq, vs = _quantize_lnh(dv)
+    int4 = ck["q"].dtype == jnp.uint8  # dtype is trace-static under jit
+    kq, ks = _quantize_lnh(dk, int4)
+    vq, vs = _quantize_lnh(dv, int4)
     return _inject_q(ck, cv, ids, kq, ks, vq, vs)
 
 
@@ -101,36 +141,67 @@ def _inject_quant(ck, cv, ids, dk, dv):
 
 def pack_kv_block(kq: np.ndarray, ks: np.ndarray,
                   vq: np.ndarray, vs: np.ndarray) -> np.ndarray:
-    """(payload [L,BS,KH,D] int8 + scales [L,KH] f32) × k,v → flat uint8."""
-    payload = np.ascontiguousarray(np.stack([kq, vq]).astype(np.int8))
+    """(payload [L,BS,KH,Dp] int8|uint8 + scales [L,KH] f32) × k,v → flat
+    uint8. A uint8 payload (packed int4 nibbles) is kept byte-for-byte —
+    NOT re-cast to int8 — so the flat block's length encodes its kind."""
+    payload = np.stack([kq, vq])
+    if payload.dtype != np.uint8:
+        payload = payload.astype(np.int8)
+    payload = np.ascontiguousarray(payload)
     scales = np.ascontiguousarray(np.stack([ks, vs]).astype(np.float32))
     return np.concatenate([payload.reshape(-1).view(np.uint8),
                            scales.reshape(-1).view(np.uint8)])
 
 
-def unpack_kv_block(flat: np.ndarray,
-                    shape: tuple[int, int, int, int]) -> tuple[np.ndarray, np.ndarray]:
-    """flat uint8 → (payload [2,L,BS,KH,D] int8, scales [2,L,KH] f32)."""
-    L, BS, KH, D = shape
-    split = 2 * L * BS * KH * D
-    payload = flat[:split].view(np.int8).reshape(2, L, BS, KH, D)
+def unpack_kv_block(flat: np.ndarray, shape: tuple[int, int, int, int],
+                    payload_dtype=np.int8) -> tuple[np.ndarray, np.ndarray]:
+    """flat uint8 → (payload [2,L,BS,KH,Dp], scales [2,L,KH] f32). ``shape``
+    is the PAYLOAD shape — its trailing dim is head_dim for int8 caches and
+    head_dim/2 for packed-int4 (uint8) caches — so the byte split is the
+    same expression for both kinds."""
+    L, BS, KH, Dp = shape
+    split = 2 * L * BS * KH * Dp
+    payload = flat[:split].view(payload_dtype).reshape(2, L, BS, KH, Dp)
     scales = flat[split:].view(np.float32).reshape(2, L, KH)
     return payload, scales
 
 
-def quantize_block(block: np.ndarray) -> np.ndarray:
-    """Float host block [2, L, BS, KH, D] → packed flat uint8."""
+def _packed_kind(flat: np.ndarray, shape: tuple[int, int, int, int]) -> str:
+    """Which quantization a flat block holds, from its byte length alone.
+    ``shape`` is the LOGICAL [L, BS, KH, D] block shape (full head_dim)."""
+    L, BS, KH, D = shape
+    scales = 2 * L * KH * 4
+    if flat.size == 2 * L * BS * KH * D + scales:
+        return "int8"
+    if flat.size == L * BS * KH * D + scales:
+        return "int4"
+    raise ValueError(
+        f"packed block of {flat.size} bytes matches neither int8 nor int4 "
+        f"for logical shape {shape}")
+
+
+def quantize_block(block: np.ndarray, kv_dtype: str = "int8") -> np.ndarray:
+    """Float host block [2, L, BS, KH, D] → packed flat uint8 (int8 bytes
+    or int4 nibble pairs per ``kv_dtype``)."""
+    qmax = INT4_QMAX if kv_dtype == "int4" else 127.0
     x = np.asarray(block, np.float32)
     amax = np.abs(x).max(axis=(2, 4))                       # [2, L, KH]
-    s = np.maximum(amax / 127.0, _EPS).astype(np.float32)
-    q = np.clip(np.round(x / s[:, :, None, :, None]), -127, 127).astype(np.int8)
+    s = np.maximum(amax / qmax, _EPS).astype(np.float32)
+    q = np.clip(np.round(x / s[:, :, None, :, None]), -qmax, qmax)
+    q = _np_pack_int4(q) if kv_dtype == "int4" else q.astype(np.int8)
     return pack_kv_block(q[0], s[0], q[1], s[1])
 
 
 def dequantize_block(flat: np.ndarray, shape: tuple[int, int, int, int],
                      dtype) -> np.ndarray:
-    """Packed flat uint8 → float host block [2, L, BS, KH, D] of ``dtype``."""
-    payload, scales = unpack_kv_block(flat, shape)
+    """Packed flat uint8 (either kind) → float host block [2, L, BS, KH, D]
+    of ``dtype``. ``shape`` is the logical block shape (full head_dim)."""
+    L, BS, KH, D = shape
+    if _packed_kind(flat, shape) == "int4":
+        packed, scales = unpack_kv_block(flat, (L, BS, KH, D // 2), np.uint8)
+        payload = _np_unpack_int4(packed)
+    else:
+        payload, scales = unpack_kv_block(flat, shape)
     out = payload.astype(np.float32) * scales[:, :, None, :, None]
     return np.ascontiguousarray(out.astype(dtype))
 
@@ -141,13 +212,21 @@ def _is_packed(block: np.ndarray) -> bool:
 
 def ensure_block_format(block: np.ndarray, spec) -> np.ndarray:
     """Convert a host block to ``spec``'s native format (mixed-precision
-    import boundary): packed uint8 for quantized specs, float
-    [2, L, BS, KH, D] of ``spec.dtype`` otherwise. No-op when it already
-    matches."""
+    import boundary): packed uint8 of ``spec.kv_dtype``'s kind for
+    quantized specs, float [2, L, BS, KH, D] of ``spec.dtype`` otherwise.
+    No-op when it already matches; a packed block of the OTHER quantized
+    kind (int8 snapshot into an int4 engine or vice versa) round-trips
+    through float on the host."""
     shape = (spec.num_layers, spec.block_size, spec.num_kv_heads,
              spec.head_dim)
     if spec.quantized:
-        return block if _is_packed(block) else quantize_block(block)
+        if not _is_packed(block):
+            return quantize_block(block, spec.kv_dtype)
+        want = "int4" if getattr(spec, "packed_int4", False) else "int8"
+        if _packed_kind(block, shape) == want:
+            return block
+        return quantize_block(
+            dequantize_block(block, shape, np.float32), spec.kv_dtype)
     if _is_packed(block):
         return dequantize_block(block, shape, jnp.dtype(spec.dtype))
     return block
@@ -224,19 +303,33 @@ class BlockTransferEngine:
             packed = _is_packed(blocks[0])
             if quant_cache and packed:
                 cq = cache_k["q"]
-                shape = (cq.shape[0], cq.shape[2], cq.shape[3], cq.shape[4])
-                ups = [unpack_kv_block(b, shape) for b in blocks + pad]
-                payload = np.stack([p for p, _ in ups])    # [n,2,L,BS,KH,D]
-                scales = np.stack([s for _, s in ups])     # [n,2,L,KH]
-                return self._inject_q(
-                    cache_k, cache_v, jnp.asarray(padded, jnp.int32),
-                    jnp.asarray(np.moveaxis(payload[:, 0], 0, 1)),
-                    jnp.asarray(np.moveaxis(scales[:, 0], 0, 1)),
-                    jnp.asarray(np.moveaxis(payload[:, 1], 0, 1)),
-                    jnp.asarray(np.moveaxis(scales[:, 1], 0, 1)),
-                )
+                int4_cache = cq.dtype == jnp.uint8
+                Dp = cq.shape[4]
+                logical = (cq.shape[0], cq.shape[2], cq.shape[3],
+                           Dp * 2 if int4_cache else Dp)
+                want = "int4" if int4_cache else "int8"
+                if _packed_kind(blocks[0], logical) == want:
+                    pshape = (cq.shape[0], cq.shape[2], cq.shape[3], Dp)
+                    pdt = np.uint8 if int4_cache else np.int8
+                    ups = [unpack_kv_block(b, pshape, pdt)
+                           for b in blocks + pad]
+                    payload = np.stack([p for p, _ in ups])  # [n,2,L,BS,KH,Dp]
+                    scales = np.stack([s for _, s in ups])   # [n,2,L,KH]
+                    return self._inject_q(
+                        cache_k, cache_v, jnp.asarray(padded, jnp.int32),
+                        jnp.asarray(np.moveaxis(payload[:, 0], 0, 1)),
+                        jnp.asarray(np.moveaxis(scales[:, 0], 0, 1)),
+                        jnp.asarray(np.moveaxis(payload[:, 1], 0, 1)),
+                        jnp.asarray(np.moveaxis(scales[:, 1], 0, 1)),
+                    )
+                # Cross-kind import (int8 block into an int4 engine or vice
+                # versa): dequantize on host, requantize on device below.
+                blocks = [dequantize_block(b, logical, np.float32)
+                          for b in blocks]
+                pad = [blocks[-1]] * len(pad)
+                packed = False
             if packed:
-                # int8 snapshot into a float engine: dequantize on host.
+                # Quantized snapshot into a float engine: dequantize on host.
                 L, BS, KH, D = (cache_k.shape[0], cache_k.shape[2],
                                 cache_k.shape[3], cache_k.shape[4])
                 blocks = [dequantize_block(b, (L, BS, KH, D), cache_k.dtype)

@@ -71,10 +71,11 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                    help="max proposed tokens per verify step")
     p.add_argument("--quantization", choices=["none", "int8"], default="none",
                    help="weight-only quantization (int8)")
-    p.add_argument("--kv-dtype", choices=["bfloat16", "int8"],
+    p.add_argument("--kv-dtype", choices=["bfloat16", "int8", "int4"],
                    default="bfloat16",
                    help="paged KV cache storage dtype (int8: in-kernel "
-                        "dequant, ~2x KV capacity)")
+                        "dequant, ~2x KV capacity; int4: packed nibbles, "
+                        "~4x capacity, even head_dim only)")
     p.add_argument("--decode-window", type=int, default=1,
                    help="decode steps fused per device dispatch (stop checks "
                         "lag by up to window-1 tokens; output is unchanged)")

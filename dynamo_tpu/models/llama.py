@@ -181,18 +181,28 @@ def _scatter_kv(cache, new: jax.Array, slot_idx: jax.Array):
 
 
 def _scatter_kv_quant(cache: dict, new: jax.Array, slot_idx: jax.Array) -> dict:
-    """Int8 scatter: abs-max over the block update sets/merges the block's
-    per-head scale, existing rows of touched blocks are rescaled to the new
-    scale, then the new rows are quantized and written.
+    """Int8/int4 scatter: abs-max over the block update sets/merges the
+    block's per-head scale, existing rows of touched blocks are rescaled to
+    the new scale, then the new rows are quantized and written.
 
     A write at block offset 0 marks the block as freshly (re)tenanted and
     resets its scale — otherwise a recycled block would inherit the previous
     tenant's (possibly much larger) scale forever. Mid-block writes merge via
     max so already-committed rows never lose range. Rows past the write
     frontier hold stale garbage but every reader masks by kv_len.
+
+    A uint8 payload means packed int4 (engine/cache.py): values quantize to
+    ±7 and pack two nibbles per byte along head_dim; the scale lifecycle
+    (reset / max-merge / requant of committed rows) is identical — requant
+    unpacks, rescales, and repacks the touched blocks.
     """
+    from dynamo_tpu.ops.paged_attention import pack_int4, unpack_int4
+
     q, s = cache["q"], cache["s"]
-    nb, bs, kh, d = q.shape
+    int4 = q.dtype == jnp.uint8
+    qmax = 7.0 if int4 else 127.0
+    nb, bs, kh, _dp = q.shape
+    d = new.shape[-1]
     idx = slot_idx.reshape(-1)                                   # [N]
     vals = new.reshape(-1, kh, d).astype(jnp.float32)            # [N,KH,D]
     blk = jnp.clip(idx // bs, 0, nb - 1)
@@ -202,7 +212,7 @@ def _scatter_kv_quant(cache: dict, new: jax.Array, slot_idx: jax.Array) -> dict:
     upd_amax = jnp.zeros((nb, kh), jnp.float32).at[blk].max(row_amax)
     resets = jnp.zeros((nb,), jnp.int32).at[blk].max(
         (off == 0).astype(jnp.int32)) > 0                        # fresh tenant
-    s_cand = upd_amax / 127.0
+    s_cand = upd_amax / qmax
     s_new = jnp.where(resets[:, None], s_cand, jnp.maximum(s, s_cand))
     s_new = jnp.maximum(s_new, jnp.where(upd_amax > 0, _KV_SCALE_EPS, s_new))
 
@@ -210,25 +220,35 @@ def _scatter_kv_quant(cache: dict, new: jax.Array, slot_idx: jax.Array) -> dict:
     # token row (duplicates write identical values) keeps shapes static; cost
     # is bounded by (tokens-in-update × block_size), not by NB.
     ratio = jnp.where(s_new > 0, s / jnp.maximum(s_new, _KV_SCALE_EPS), 0.0)
-    old = q[blk].astype(jnp.float32)                             # [N,BS,KH,D]
+    old = q[blk]                                                 # [N,BS,KH,Dp]
+    old = (unpack_int4(old) if int4 else old).astype(jnp.float32)  # [N,BS,KH,D]
     requant = jnp.clip(jnp.round(old * ratio[blk][:, None, :, None]),
-                       -127, 127).astype(jnp.int8)
+                       -qmax, qmax).astype(jnp.int32)
+    requant = pack_int4(requant) if int4 else requant.astype(jnp.int8)
     q = q.at[blk].set(requant, mode="drop")
 
     # Quantize and write the new rows (overwrites the rescaled slots).
     s_rows = jnp.maximum(s_new[blk], _KV_SCALE_EPS)              # [N,KH]
-    q_rows = jnp.clip(jnp.round(vals / s_rows[:, :, None]), -127, 127)
-    flat = q.reshape(nb * bs, kh, d)
-    flat = flat.at[idx].set(q_rows.astype(jnp.int8), mode="drop")
-    return {"q": flat.reshape(nb, bs, kh, d), "s": s_new}
+    q_rows = jnp.clip(jnp.round(vals / s_rows[:, :, None]), -qmax, qmax)
+    q_rows = (pack_int4(q_rows.astype(jnp.int32)) if int4
+              else q_rows.astype(jnp.int8))
+    flat = q.reshape(nb * bs, kh, -1)
+    flat = flat.at[idx].set(q_rows, mode="drop")
+    return {"q": flat.reshape(q.shape), "s": s_new}
 
 
 def _gather_kv(cache, block_tables: jax.Array) -> jax.Array:
     """Gather context KV: cache [NB,BS,KH,D], block_tables [B,NBLK] →
     [B, NBLK*BS, KH, D] laid out in position order. Quantized caches are
-    dequantized on gather (dense fallback path)."""
+    dequantized on gather (dense fallback path); packed-int4 payloads
+    (uint8) unpack their nibbles first."""
     if isinstance(cache, dict):
-        g = cache["q"][block_tables].astype(jnp.float32)  # [B,NBLK,BS,KH,D]
+        from dynamo_tpu.ops.paged_attention import unpack_int4
+
+        g = cache["q"][block_tables]                      # [B,NBLK,BS,KH,Dp]
+        if g.dtype == jnp.uint8:
+            g = unpack_int4(g)
+        g = g.astype(jnp.float32)
         g = g * cache["s"][block_tables][:, :, None, :, None]
         b, nblk, bs, kh, d = g.shape
         return g.reshape(b, nblk * bs, kh, d)
@@ -339,6 +359,7 @@ def forward(
     embed_override: jax.Array | None = None,  # [B, T, H] multimodal embeds
     embed_mask: jax.Array | None = None,      # [B, T] True → use override
     pp_microbatches: int = 0,                 # pp>1: schedule depth (0 = auto)
+    attn_num_splits: int = 0,                 # split-K: 0 auto, 1 off, N forced
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One engine step. Returns (last_hidden [B,H], cache_k, cache_v) —
     or (hidden [B,T,H], ...) with ``return_all_hidden`` (the speculative
@@ -358,7 +379,8 @@ def forward(
         # Pipeline-parallel path: layer blocks sharded over "pipe".
         return forward_pp(params, cfg, token_ids, q_start, q_len, block_tables,
                           cache_k, cache_v, mesh, attn_impl=attn_impl,
-                          microbatches=pp_microbatches)
+                          microbatches=pp_microbatches,
+                          attn_num_splits=attn_num_splits)
     if attn_impl in ("pallas", "pallas_interpret") and tp > 1 and (
         cfg.num_kv_heads % tp != 0 or b % dp != 0
     ):
@@ -429,12 +451,12 @@ def forward(
                     # psum in the wo projection completes the TP contraction.
                     attn = paged_attention_sharded(
                         mesh, q, ck, cv, block_tables, q_start, kv_lens,
-                        interpret=interp,
+                        num_splits=attn_num_splits, interpret=interp,
                     )
                 else:
                     attn = paged_attention_kernel(
                         q, ck, cv, block_tables, q_start, kv_lens,
-                        interpret=interp,
+                        num_splits=attn_num_splits, interpret=interp,
                     )
         else:
             with _perf_phase("gather"):
@@ -486,6 +508,7 @@ def forward_pp(
     mesh,
     attn_impl: str = "dense",
     microbatches: int = 0,
+    attn_num_splits: int = 0,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Pipeline-parallel forward: layer blocks sharded over the "pipe" axis.
 
@@ -587,7 +610,8 @@ def forward_pp(
             h_in = jnp.where(s == 0, h0_mb[mbc], h_cur)
             h_out, ck, cv = _pp_stage_block(
                 cfg, lp_stack, ck, cv, h_in, pos_mb[mbc], slot_t, bt_mb[mbc],
-                kl_mb[mbc], attn_impl=attn_impl, q_start=qs_mb[mbc])
+                kl_mb[mbc], attn_impl=attn_impl, q_start=qs_mb[mbc],
+                attn_num_splits=attn_num_splits)
             out = out.at[mbc].add(jnp.where((s == pp - 1) & live, h_out, 0))
             h_nxt = lax.ppermute(
                 h_out, "pipe", [(j, (j + 1) % pp) for j in range(pp)])
@@ -614,7 +638,7 @@ def forward_pp(
 
 
 def _pp_stage_block(cfg, lp_stack, ck_loc, cv_loc, h, pos, slot, bt, kv_lens,
-                    attn_impl="dense", q_start=None):
+                    attn_impl="dense", q_start=None, attn_num_splits=0):
     """One pipeline stage's layer block — the shared layer math of BOTH pp
     schedules (microbatched and sequential fallback): same per-layer flow
     as forward's layer_fn, attention over the stage's local cache slice.
@@ -637,6 +661,7 @@ def _pp_stage_block(cfg, lp_stack, ck_loc, cv_loc, h, pos, slot, bt, kv_lens,
 
             attn = paged_attention_kernel(
                 q, ck, cv, bt, q_start, kv_lens,
+                num_splits=attn_num_splits,
                 interpret=(attn_impl == "pallas_interpret"))
         else:
             ctx_k = _gather_kv(ck, bt)

@@ -19,8 +19,14 @@ Conventions (stated once, relied on by tests/test_perf_obs.py):
 * HBM bytes count reads + writes of tensors that round-trip HBM under the
   serving access pattern: weights stream once per step, activations are
   assumed resident (XLA fuses them), KV blocks stream per step.
-* int8 KV halves the KV payload and adds the per-(block, head) f32 scales;
+* int8 KV halves the KV payload, packed int4 quarters it (two nibbles per
+  byte — 0.5 bytes/elem), and both add the per-(block, head) f32 scales;
   int8 weights count 1 byte/elem (models/quant.py streams them packed).
+* split-K (``num_splits > 1``) adds the combine step's traffic: each split
+  writes f32 partial state (acc rows of head_dim plus the lane-padded m
+  and l columns, 128 each) that the jnp combine reads back, plus its
+  elementwise merge FLOPs — so MFU/BW-util stay honest when the kernel
+  trades extra HBM round-trips for grid parallelism.
 
 This module is dependency-free on purpose — no jax import — so the bench
 parent process can compute predicted device numbers without touching a
@@ -37,7 +43,9 @@ __all__ = [
     "HardwareSpec",
     "KernelCost",
     "HW_SPECS",
+    "KV_DTYPES",
     "hw_spec_for",
+    "auto_num_splits",
     "paged_attention_cost",
     "ring_attention_cost",
     "dense_matmul_cost",
@@ -129,12 +137,43 @@ class KernelCost:
         return "compute" if self.intensity >= hw.ridge_intensity else "bandwidth"
 
 
-def _kv_itemsize(kv_dtype: str) -> int:
-    return 1 if kv_dtype == "int8" else 2
+#: every KV storage mode the cache supports (engine/cache.py), in scoreboard
+#: order — perf_report rows and the bench kv_dtype sweep iterate this.
+KV_DTYPES = ("bfloat16", "int8", "int4")
+
+
+def _kv_itemsize(kv_dtype: str) -> float:
+    """KV payload bytes per element: bf16 2, int8 1, packed int4 0.5."""
+    if kv_dtype == "int8":
+        return 1.0
+    if kv_dtype == "int4":
+        return 0.5
+    return 2.0
 
 
 def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
+
+
+def auto_num_splits(num_blocks: int, *, batch: int, q_chunks: int = 1,
+                    core_count: int = 8, min_blocks_per_split: int = 4,
+                    max_splits: int = 16) -> int:
+    """Split-K split count for one paged-attention call (deterministic,
+    jax-free — callable at trace time from ops/paged_attention.py).
+
+    Picks the smallest split count that fills ``core_count`` parallel grid
+    streams given the ``batch × q_chunks`` programs that already exist,
+    without shrinking any split below ``min_blocks_per_split`` context
+    blocks (below that the combine's extra HBM round-trip outweighs the
+    latency win — each split's partial state costs ~(D + 256) f32 per row
+    against the ~BS·KH·D·itemsize bytes a block walk reads).
+    """
+    if num_blocks <= min_blocks_per_split:
+        return 1
+    streams = max(1, batch * q_chunks)
+    want = _ceil_div(core_count, streams)
+    cap = max(1, num_blocks // min_blocks_per_split)
+    return max(1, min(want, cap, max_splits))
 
 
 def paged_attention_cost(
@@ -148,6 +187,7 @@ def paged_attention_cost(
     block_size: int,
     kv_dtype: str = "bfloat16",
     act_bytes: int = 2,
+    num_splits: int = 1,
 ) -> KernelCost:
     """One paged-attention invocation (Pallas kernel and the dense-gather
     fallback execute the same matmul volume over the same KV blocks).
@@ -155,18 +195,33 @@ def paged_attention_cost(
     FLOPs: the QK^T and PV matmuls — ``4 · B · T · H · D · S`` with S the
     block-rounded context. HBM: Q read + output write (activation dtype),
     plus both K and V caches streamed once per invocation; int8 caches move
-    half the payload plus the per-(block, kv-head) f32 scales.
+    half the payload, packed int4 a quarter, both plus the per-(block,
+    kv-head) f32 scales.
+
+    ``num_splits > 1`` (split-K flash decode) adds the combine step:
+    per split and per query row (B·T·H of them) the kernel writes f32
+    partial state — acc (head_dim) plus the lane-padded m and l columns
+    (128 each) — which the combine reads back, so
+    ``combine_bytes = 8 · NS · B · T · H · (D + 256)`` (4-byte elems,
+    write + read). The merge's elementwise work is charged as
+    ``combine_flops = NS · B · T · H · (2 · D + 8)`` (scale + sum of acc,
+    plus the exp/max/l bookkeeping per row).
     """
     nblk = _ceil_div(max(kv_len, 1), block_size)
     s = nblk * block_size
     flops = 4.0 * batch * q_tokens * num_heads * head_dim * s
     q_bytes = batch * q_tokens * num_heads * head_dim * act_bytes
     kv_block = block_size * num_kv_heads * head_dim * _kv_itemsize(kv_dtype)
-    if kv_dtype == "int8":
+    if kv_dtype in ("int8", "int4"):
         kv_block += num_kv_heads * 4  # per-(block, head) f32 scale
     kv_bytes = 2.0 * batch * nblk * kv_block
     out_bytes = q_bytes
-    return KernelCost("paged_attention", flops, q_bytes + kv_bytes + out_bytes)
+    hbm = q_bytes + kv_bytes + out_bytes
+    if num_splits > 1:
+        rows = batch * q_tokens * num_heads
+        hbm += 8.0 * num_splits * rows * (head_dim + 256)
+        flops += num_splits * rows * (2.0 * head_dim + 8)
+    return KernelCost("paged_attention", flops, hbm)
 
 
 def ring_attention_cost(
@@ -212,6 +267,7 @@ def model_step_cost(
     block_size: int,
     kv_dtype: str = "bfloat16",
     quantization: str = "none",
+    attn_num_splits: int = 1,
 ) -> dict[str, KernelCost]:
     """Aggregate cost of ONE dispatched engine step, by phase.
 
@@ -244,31 +300,33 @@ def model_step_cost(
     proj_act = (n * h * 2 + n * (cfg.q_size + 2 * cfg.kv_size)) * ab * L
     proj = KernelCost("proj", proj_flops, proj_w + proj_act)
 
-    # KV scatter: the step's new K/V rows written at cache dtype; an int8
-    # cache additionally re-reads + re-writes each touched block to requant
-    # committed rows against the merged scale (llama._scatter_kv_quant).
+    # KV scatter: the step's new K/V rows written at cache dtype; a
+    # quantized cache (int8/int4) additionally re-reads + re-writes each
+    # touched block to requant committed rows against the merged scale
+    # (llama._scatter_kv_quant).
     kvb = _kv_itemsize(kv_dtype)
     scatter_bytes = 2.0 * n * cfg.kv_size * kvb * L
-    if kv_dtype == "int8":
+    if kv_dtype in ("int8", "int4"):
         blocks_touched = _ceil_div(n, block_size) + 1
         scatter_bytes += (2.0 * 2.0 * blocks_touched * block_size
                           * cfg.kv_size * kvb * L)
     scatter = KernelCost("scatter", 0.0, scatter_bytes)
 
-    attn_per_layer = paged_attention_cost(
-        batch=1, q_tokens=1, num_heads=cfg.num_heads,
-        num_kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
-        kv_len=block_size, block_size=block_size, kv_dtype=kv_dtype)
     # Rebuild from the aggregated volumes: flops scale with attn_q_ctx,
     # KV bytes with kv_blocks, Q/out bytes with tokens.
     kv_block_bytes = block_size * cfg.num_kv_heads * cfg.head_dim * kvb
-    if kv_dtype == "int8":
+    if kv_dtype in ("int8", "int4"):
         kv_block_bytes += cfg.num_kv_heads * 4
-    attention = KernelCost(
-        "paged_attention",
-        4.0 * cfg.num_heads * cfg.head_dim * attn_q_ctx * L,
-        (2.0 * n * cfg.q_size * ab + 2.0 * kv_blocks * kv_block_bytes) * L,
-    )
+    attn_flops = 4.0 * cfg.num_heads * cfg.head_dim * attn_q_ctx * L
+    attn_bytes = (2.0 * n * cfg.q_size * ab
+                  + 2.0 * kv_blocks * kv_block_bytes) * L
+    if attn_num_splits > 1:
+        # Split-K combine (same per-row formula as paged_attention_cost):
+        # each query row's per-split f32 partial state round-trips HBM.
+        rows = n * cfg.num_heads
+        attn_bytes += 8.0 * attn_num_splits * rows * (cfg.head_dim + 256) * L
+        attn_flops += attn_num_splits * rows * (2.0 * cfg.head_dim + 8) * L
+    attention = KernelCost("paged_attention", attn_flops, attn_bytes)
 
     if cfg.is_moe:
         m = cfg.moe_intermediate_size
@@ -314,6 +372,7 @@ def decode_step_cost(
     block_size: int,
     kv_dtype: str = "bfloat16",
     quantization: str = "none",
+    attn_num_splits: int = 1,
 ) -> dict[str, KernelCost]:
     """Uniform-batch decode step (every row: 1 query token, same context) —
     the bench / perf_report / prediction entry point."""
@@ -322,7 +381,8 @@ def decode_step_cost(
         cfg, tokens=batch, logit_rows=batch,
         attn_q_ctx=float(batch * nblk * block_size),
         kv_blocks=float(batch * nblk), block_size=block_size,
-        kv_dtype=kv_dtype, quantization=quantization)
+        kv_dtype=kv_dtype, quantization=quantization,
+        attn_num_splits=attn_num_splits)
 
 
 def prefill_cost(
@@ -377,12 +437,14 @@ def predicted_decode_perf(
     block_size: int = 16,
     kv_dtype: str = "bfloat16",
     quantization: str = "none",
+    attn_num_splits: int = 1,
 ) -> dict:
     """Roofline prediction for a decode config on ``hw`` — what bench.py
     attaches as the device forecast when only the CPU fallback could run."""
     phases = decode_step_cost(cfg, batch=batch, kv_len=kv_len,
                               block_size=block_size, kv_dtype=kv_dtype,
-                              quantization=quantization)
+                              quantization=quantization,
+                              attn_num_splits=attn_num_splits)
     cost = total_cost(phases)
     step_s = cost.time_bound(hw)
     tok_s = batch / step_s if step_s > 0 else 0.0

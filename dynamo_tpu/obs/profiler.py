@@ -129,7 +129,8 @@ class PerfMetrics:
         self.tok_s = registry.gauge(
             "engine_perf_tokens_per_second",
             "Generated tokens/s over recent engine steps (EWMA), by kind "
-            "(decode|prefill)")
+            "(decode|prefill) and kv_dtype (bfloat16|int8|int4) — label set "
+            "declared in tools/lint_metrics.py PERF_METRIC_LABELS")
         self.mfu = registry.gauge(
             "engine_perf_mfu",
             "Model-FLOPs utilization over recent engine steps (EWMA): "
@@ -257,7 +258,8 @@ class StepPerfProfiler:
         }
         m = get_perf_metrics()
         kind = "decode" if dec_tokens >= pf_tokens else "prefill"
-        m.tok_s.set(self._smooth(f"tok_s:{kind}", tok_s), kind=kind)
+        m.tok_s.set(self._smooth(f"tok_s:{kind}", tok_s), kind=kind,
+                    kv_dtype=self.kv_dtype)
         m.mfu.set(self._smooth("mfu", fields["mfu"]))
         m.bw_util.set(self._smooth("bw_util", fields["bw_util"]))
         m.roofline.set(self._smooth("roofline", fields["roofline_frac"]))

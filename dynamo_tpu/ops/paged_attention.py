@@ -16,13 +16,26 @@ lowering on hardware and reports which attention impl actually ran.
 Design notes (reference has no TPU analog; its one kernel is a CUDA block
 copy, lib/llm/src/kernels/block_copy.cu — paged attention itself lives
 inside vLLM/TRT-LLM, which we replace):
-- grid = (B, NBLK): batch is parallel; the context-block axis is sequential
-  ("arbitrary") carrying the softmax state in VMEM scratch (acc, row-max m,
-  row-sum l), one slab per kv head.
+- grid = (B, NQ, NS, SPB): batch and q-chunk are parallel; the context-block
+  walk is partitioned into NS splits of SPB blocks each (split-K flash
+  decode). Within a split the block axis is sequential ("arbitrary"),
+  carrying the online-softmax state in VMEM scratch (acc, row-max m, row-sum
+  l) — one slab per kv head, re-initialized at each split's first step.
+- num_splits=1 IS the sequential kernel: one split walks all blocks and
+  normalizes in-kernel, exactly the pre-split-K code path. num_splits>1
+  emits per-split partial ``(acc, m, l)`` state as float32 outputs and a
+  small jnp combine (logsumexp-weighted merge) produces the final rows —
+  long-context decode latency drops from O(NBLK) sequential grid steps to
+  O(NBLK / NS).
+- ragged early-exit: per-row used-block counts ride the scalar-prefetch
+  channel; the K/V index maps clamp the context-block lookup at a row's last
+  real block, so every grid step past it re-requests the same HBM block and
+  Pallas elides the DMA (revisited block ⇒ no copy), while pl.when skips the
+  matmuls. Batch cost is proportional to total context, not B × max_blocks.
 - block tables + positions are scalar-prefetched (PrefetchScalarGridSpec)
   so the K/V BlockSpec index maps can address HBM blocks by table lookup —
   the DMA pipeline chases the page table, the kernel body never sees HBM.
-- K/V blocks load ALL kv heads at once — block shape ``(1, BS, KH, D)``
+- K/V blocks load ALL kv heads at once — block shape ``(1, BS, KH, Dp)``
   equals the array's trailing dims, which always satisfies Mosaic's tiling
   constraint (the round-1 kernel's per-head block ``(1, BS, 1, D)`` had a
   second-to-minor dim of 1 against KH=8 and failed to lower). The kv-head
@@ -31,8 +44,11 @@ inside vLLM/TRT-LLM, which we replace):
 - q rows are pre-laid-out ``[B, KH, T*REP, D]`` (rep = query heads per kv
   head) outside the kernel so each head's queries are one contiguous 2D
   slab — one MXU matmul covers all query heads of the kv head.
-- blocks past a sequence's kv_len skip compute via pl.when (their DMA still
-  runs; the trash-block index 0 keeps it in-bounds).
+- quantized caches: int8 payloads DMA at 1 byte/elem and the per-(block,
+  kv-head) scale folds into the MXU results; packed int4 payloads (uint8,
+  two nibbles per byte, trailing dim D/2 — engine/cache.py) additionally
+  unpack in VMEM via integer shifts before the matmuls, so KV streams from
+  HBM at half a byte per element.
 """
 
 from __future__ import annotations
@@ -55,8 +71,12 @@ _SCRATCH_CAP_BYTES = 4 * 2**20  # online-softmax VMEM scratch budget
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
 # Mosaic min-tile sublane count by dtype itemsize (lane is always 128):
-# f32 → (8, 128), bf16 → (16, 128), int8/fp8 → (32, 128).
+# f32 → (8, 128), bf16 → (16, 128), int8/uint8/fp8 → (32, 128).
 _MIN_SUBLANE = {4: 8, 2: 16, 1: 32}
+
+#: int4 payloads clip to ±7 (not -8): symmetric range keeps dequant a pure
+#: scale multiply, mirroring int8's ±127.
+INT4_QMAX = 7.0
 
 
 def _sublane(dtype) -> int:
@@ -71,7 +91,9 @@ def mosaic_block_shape_ok(block_shape: tuple[int, ...],
     failure was exactly this: a per-head block ``(1, 16, 1, 128)`` against
     a ``[NB, BS, KH, D]`` cache put 1 in the second-to-minor position where
     KH was 8 — neither equal nor divisible — and the kernel refused to
-    lower on TPU (BENCH_r01.json)."""
+    lower on TPU (BENCH_r01.json). Packed-int4 caches keep the whole-axis
+    property (their trailing dim is D/2 on both block and array), so they
+    pass the same rule."""
     if len(block_shape) < 2 or len(array_shape) < 2:
         return True
     sub, lane = block_shape[-2], block_shape[-1]
@@ -85,7 +107,9 @@ def _validate_block_specs(specs: list[tuple[str, tuple[int, ...],
                                             tuple[int, ...], "jnp.dtype"]]) -> None:
     """Static trace-time guard: fail with a readable error instead of a
     deep Mosaic lowering failure on hardware. ``specs`` is a list of
-    (name, block_shape, array_shape, dtype)."""
+    (name, block_shape, array_shape, dtype). Covers the q/kv/out blocks AND
+    the split-K partial-state outputs (acc/m/l, float32) plus packed-int4
+    payload blocks."""
     bad = [
         f"{name}: block {blk} vs array {arr} ({jnp.dtype(dt).name}: "
         f"min tile {_sublane(dt)}x128)"
@@ -99,23 +123,85 @@ def _validate_block_specs(specs: list[tuple[str, tuple[int, ...],
             "the dtype's min tile): " + "; ".join(bad))
 
 
-def _kernel(*refs, bs: int, kh: int, rep: int, quant: bool):
+# ---------------------------------------------------------------------------
+# Packed int4
+# ---------------------------------------------------------------------------
+
+def pack_int4(vals: jax.Array) -> jax.Array:
+    """Pack signed nibbles [-8..7] (any int dtype) into uint8 bytes along the
+    trailing axis, split-half layout: byte j of a length-D/2 packed row holds
+    element j in its low nibble and element j + D/2 in its high nibble. The
+    split-half convention keeps unpack a cheap concat (no interleave) in the
+    kernel's VMEM lane layout."""
+    d = vals.shape[-1]
+    if d % 2:
+        raise ValueError(f"int4 packing needs an even trailing dim, got {d}")
+    w = vals.astype(jnp.int32)
+    lo = w[..., : d // 2] & 0xF
+    hi = w[..., d // 2:] & 0xF
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def unpack_int4(packed: jax.Array) -> jax.Array:
+    """Inverse of :func:`pack_int4`: uint8 [..., D/2] → int32 [..., D] with
+    sign-extended 4-bit values. Pure integer arithmetic (mask/shift/sub) so
+    it lowers inside Pallas kernels and under interpret mode alike."""
+    w = packed.astype(jnp.int32)
+    lo = w & 0xF
+    hi = (w >> 4) & 0xF
+    # sign-extend 4-bit two's complement: x - 16 when bit 3 is set
+    lo = lo - ((lo & 0x8) << 1)
+    hi = hi - ((hi & 0x8) << 1)
+    return jnp.concatenate([lo, hi], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Split-K sizing
+# ---------------------------------------------------------------------------
+
+def resolve_num_splits(num_splits: int, *, nblk: int, batch: int,
+                       q_chunks: int, q_tokens: int) -> int:
+    """Resolve a ``num_splits`` request to the split count actually used.
+
+    0 ("auto") defers to the cost model's :func:`auto_num_splits` for decode
+    (q_tokens == 1); prefill chunks stay sequential — their q-chunk axis
+    already fills the grid and per-split partial state would scale with T.
+    Explicit values are clamped to [1, nblk].
+    """
+    if num_splits <= 0:
+        if q_tokens != 1:
+            return 1
+        from dynamo_tpu.obs.costmodel import auto_num_splits
+        return resolve_num_splits(
+            auto_num_splits(nblk, batch=batch, q_chunks=q_chunks),
+            nblk=nblk, batch=batch, q_chunks=q_chunks, q_tokens=q_tokens)
+    return max(1, min(num_splits, nblk))
+
+
+def _kernel(*refs, bs: int, kh: int, rep: int, spb: int, quant: bool,
+            int4: bool, split: bool):
     if quant:
         # Scales ride the scalar-prefetch channel with the block table, so
-        # dequant needs no extra DMA: the int8 block is widened in-register
-        # and the per-(block, head) scale folds into the MXU results.
-        (bt_ref, qs_ref, kl_ref, ks_ref, vs_ref,
-         q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref) = refs
+        # dequant needs no extra DMA: the int8/int4 block is widened
+        # in-register and the per-(block, head) scale folds into the MXU
+        # results.
+        (bt_ref, qs_ref, kl_ref, ub_ref, ks_ref, vs_ref, *refs) = refs
     else:
-        (bt_ref, qs_ref, kl_ref,
-         q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref) = refs
+        (bt_ref, qs_ref, kl_ref, ub_ref, *refs) = refs
         ks_ref = vs_ref = None
+    if split:
+        (q_ref, k_ref, v_ref, o_ref, mo_ref, lo_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref) = refs
+    del ub_ref  # consumed by the index maps (DMA clamp), not the body
     b = pl.program_id(0)
     qi = pl.program_id(1)
-    j = pl.program_id(2)
-    nblk = pl.num_programs(2)
+    si = pl.program_id(2)
+    jj = pl.program_id(3)
+    g = si * spb + jj  # global context-block index
 
-    @pl.when(j == 0)
+    @pl.when(jj == 0)
     def _init():
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
@@ -123,27 +209,38 @@ def _kernel(*refs, bs: int, kh: int, rep: int, quant: bool):
 
     kv_len = kl_ref[b]
 
-    @pl.when(j * bs < kv_len)
+    @pl.when(g * bs < kv_len)
     def _compute():
         r = q_ref.shape[2]  # rows in this q chunk (row = token*rep + q-head)
         # Causal/visibility mask is head-independent: [R, BS].
         row = lax.broadcasted_iota(jnp.int32, (r, bs), 0) + qi * r
         row_t = row // rep                                            # query token idx
-        ctx = lax.broadcasted_iota(jnp.int32, (r, bs), 1) + j * bs    # context position
+        ctx = lax.broadcasted_iota(jnp.int32, (r, bs), 1) + g * bs    # context position
         q_pos = qs_ref[b] + row_t
         visible = (ctx <= q_pos) & (ctx < kv_len)
 
+        if int4:
+            # Unpack once per block for all kv heads: uint8 [BS, KH, D/2]
+            # → f32 [BS, KH, D] signed nibbles, scales applied per head in
+            # the matmul results below.
+            k_wide = unpack_int4(k_ref[0]).astype(jnp.float32)
+            v_wide = unpack_int4(v_ref[0]).astype(jnp.float32)
+
         for ki in range(kh):
             q = q_ref[0, ki].astype(jnp.float32)                      # [R, D]
-            k = k_ref[0, :, ki].astype(jnp.float32)                   # [BS, D]
-            v = v_ref[0, :, ki].astype(jnp.float32)                   # [BS, D]
+            if int4:
+                k = k_wide[:, ki]                                     # [BS, D]
+                v = v_wide[:, ki]
+            else:
+                k = k_ref[0, :, ki].astype(jnp.float32)               # [BS, D]
+                v = v_ref[0, :, ki].astype(jnp.float32)
             scores = lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             )                                                         # [R, BS]
             if quant:
                 # Symmetric per-(block, head) scale: constant over the
-                # contraction, so scaling the int8 matmul result is exact.
-                scores = scores * ks_ref[bt_ref[b, j], ki]
+                # contraction, so scaling the int matmul result is exact.
+                scores = scores * ks_ref[bt_ref[b, g], ki]
             scores = jnp.where(visible, scores, NEG_INF)
 
             m_prev = m_ref[ki, :, :1]                                 # [R, 1]
@@ -158,43 +255,82 @@ def _kernel(*refs, bs: int, kh: int, rep: int, quant: bool):
                 p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
             )                                                         # [R, D]
             if quant:
-                pv = pv * vs_ref[bt_ref[b, j], ki]
+                pv = pv * vs_ref[bt_ref[b, g], ki]
             acc_ref[ki] = acc_ref[ki] * alpha + pv
             m_ref[ki] = jnp.broadcast_to(m_new, m_ref.shape[1:])
             l_ref[ki] = jnp.broadcast_to(l_new, l_ref.shape[1:])
 
-    @pl.when(j == nblk - 1)
+    @pl.when(jj == spb - 1)
     def _finish():
-        for ki in range(kh):
-            l = l_ref[ki, :, :1]
-            l = jnp.where(l == 0.0, 1.0, l)                           # all-masked rows → 0
-            o_ref[0, ki] = (acc_ref[ki] / l).astype(o_ref.dtype)
+        if split:
+            # Emit this split's raw flash state; the jnp combine outside the
+            # kernel merges splits. Empty splits (every block past kv_len)
+            # emit (m=NEG_INF, l=0, acc=0) and combine to zero weight.
+            for ki in range(kh):
+                o_ref[0, 0, ki] = acc_ref[ki]
+                mo_ref[0, 0, ki] = m_ref[ki]
+                lo_ref[0, 0, ki] = l_ref[ki]
+        else:
+            for ki in range(kh):
+                l = l_ref[ki, :, :1]
+                l = jnp.where(l == 0.0, 1.0, l)                       # all-masked rows → 0
+                o_ref[0, ki] = (acc_ref[ki] / l).astype(o_ref.dtype)
+
+
+def _combine_splits(o_p: jax.Array, m_p: jax.Array, l_p: jax.Array,
+                    out_dtype) -> jax.Array:
+    """Merge per-split flash state [B, NS, KH, R, ·] → final rows
+    [B, KH, R, D]. Standard logsumexp-weighted combine; a row whose every
+    split is empty (kv_len 0 / fully masked) has l_tot 0 and yields 0,
+    matching the sequential kernel's guarded divide."""
+    m = m_p[..., :1]                                      # [B,NS,KH,R,1]
+    l = l_p[..., :1]
+    m_tot = jnp.max(m, axis=1, keepdims=True)             # [B,1,KH,R,1]
+    w = jnp.exp(m - m_tot)                                # [B,NS,KH,R,1]
+    l_tot = jnp.sum(w * l, axis=1)                        # [B,KH,R,1]
+    acc = jnp.sum(o_p * w, axis=1)                        # [B,KH,R,D]
+    l_tot = jnp.where(l_tot == 0.0, 1.0, l_tot)
+    return (acc / l_tot).astype(out_dtype)
 
 
 def paged_attention_kernel(
     q: jax.Array,             # [B, T, H, D]
-    k_cache,                  # [NB, BS, KH, D] — or {"q": int8, "s": f32 [NB, KH]}
+    k_cache,                  # [NB, BS, KH, D] — or {"q": int8 [NB,BS,KH,D]
+                              #   | uint8 packed int4 [NB,BS,KH,D/2],
+                              #   "s": f32 [NB, KH]}
     v_cache,
     block_tables: jax.Array,  # [B, NBLK] int32
     q_start: jax.Array,       # [B] int32 first query position
     kv_lens: jax.Array,       # [B] int32 valid context length
     *,
+    num_splits: int = 0,      # 0 = auto (cost model), 1 = sequential, N = forced
     interpret: bool = False,
 ) -> jax.Array:
     """Flash paged attention over a block-table cache. Returns [B, T, H, D].
 
     Quantized caches (``{"q", "s"}`` — engine/cache.py) DMA int8 blocks
-    (half the HBM bytes of bf16) and fold the per-(block, kv-head) dequant
+    (half the HBM bytes of bf16) or packed-int4 blocks (a quarter — uint8
+    payload, two nibbles per byte) and fold the per-(block, kv-head) dequant
     scale into the per-block MXU matmuls; no widened KV tensor ever exists
     in HBM.
+
+    ``num_splits`` partitions each row's context-block walk across grid
+    programs (split-K flash decode); per-row used-block counts clamp the KV
+    index maps so ragged batches skip DMA + compute past each row's real
+    context.
     """
     quant = isinstance(k_cache, dict)
+    int4 = False
     if quant:
         k_scale = k_cache["s"].astype(jnp.float32)   # [NB, KH]
         v_scale = v_cache["s"].astype(jnp.float32)
         k_cache, v_cache = k_cache["q"], v_cache["q"]
+        int4 = k_cache.dtype == jnp.uint8            # packed marker dtype
     b, t, h, d = q.shape
-    nb, bs, kh, _ = k_cache.shape
+    nb, bs, kh, dp = k_cache.shape
+    if int4 and dp * 2 != d:
+        raise ValueError(
+            f"packed int4 cache trailing dim {dp} != head_dim/2 ({d}//2)")
     nblk = block_tables.shape[1]
     rep = h // kh
     # [B, T, KH, REP, D] → [B, KH, T*REP, D]: one contiguous query slab per
@@ -220,33 +356,72 @@ def paged_attention_kernel(
         rchunk //= 2
     nq = r // rchunk
 
-    if quant:
-        # Index maps see all scalar-prefetch refs after the grid indices.
-        qmap = lambda bi, qi, j, bt, qp, kl, ks, vs: (bi, 0, qi, 0)      # noqa: E731
-        kvmap = lambda bi, qi, j, bt, qp, kl, ks, vs: (bt[bi, j], 0, 0, 0)  # noqa: E731
-        scalars = (block_tables.astype(jnp.int32), q_start.astype(jnp.int32),
-                   kv_lens.astype(jnp.int32), k_scale, v_scale)
-    else:
-        qmap = lambda bi, qi, j, bt, qp, kl: (bi, 0, qi, 0)              # noqa: E731
-        kvmap = lambda bi, qi, j, bt, qp, kl: (bt[bi, j], 0, 0, 0)       # noqa: E731
-        scalars = (block_tables.astype(jnp.int32), q_start.astype(jnp.int32),
-                   kv_lens.astype(jnp.int32))
+    ns = resolve_num_splits(num_splits, nblk=nblk, batch=b, q_chunks=nq,
+                            q_tokens=t)
+    spb = -(-nblk // ns)  # context blocks walked per split
+    split = ns > 1
 
-    _validate_block_specs([
+    # Ragged early-exit: rows see DMAs only up to their last used block —
+    # past it the clamped index map re-requests the same block and Pallas
+    # elides the copy (compute is already pl.when-gated on kv_len).
+    used_blocks = jnp.clip((kv_lens.astype(jnp.int32) + bs - 1) // bs,
+                           0, nblk)
+
+    # Index maps see all scalar-prefetch refs after the grid indices
+    # (bt, q_start, kv_lens, used_blocks[, k_scale, v_scale]).
+    def qmap(bi, qi, si, jj, *_prefetch):
+        return (bi, 0, qi, 0)
+
+    def kvmap(bi, qi, si, jj, *prefetch):
+        bt, ub = prefetch[0], prefetch[3]
+        g = si * spb + jj
+        clamped = jnp.minimum(g, jnp.maximum(ub[bi] - 1, 0))
+        return (bt[bi, clamped], 0, 0, 0)
+
+    def omap_split(bi, qi, si, jj, *_prefetch):
+        return (bi, si, 0, qi, 0)
+
+    scalars = (block_tables.astype(jnp.int32), q_start.astype(jnp.int32),
+               kv_lens.astype(jnp.int32), used_blocks)
+    if quant:
+        scalars = scalars + (k_scale, v_scale)
+
+    check_specs = [
         ("q", (1, kh, rchunk, d), qs.shape, qs.dtype),
-        ("k_cache", (1, bs, kh, d), k_cache.shape, k_cache.dtype),
-        ("v_cache", (1, bs, kh, d), v_cache.shape, v_cache.dtype),
-        ("out", (1, kh, rchunk, d), (b, kh, t * rep, d), q.dtype),
-    ])
+        ("k_cache", (1, bs, kh, dp), k_cache.shape, k_cache.dtype),
+        ("v_cache", (1, bs, kh, dp), v_cache.shape, v_cache.dtype),
+    ]
+    if split:
+        check_specs += [
+            ("out_acc", (1, 1, kh, rchunk, d), (b, ns, kh, r, d), jnp.float32),
+            ("out_m", (1, 1, kh, rchunk, 128), (b, ns, kh, r, 128), jnp.float32),
+            ("out_l", (1, 1, kh, rchunk, 128), (b, ns, kh, r, 128), jnp.float32),
+        ]
+        out_shape = (
+            jax.ShapeDtypeStruct((b, ns, kh, r, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, ns, kh, r, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, ns, kh, r, 128), jnp.float32),
+        )
+        out_specs = (
+            pl.BlockSpec((1, 1, kh, rchunk, d), omap_split),
+            pl.BlockSpec((1, 1, kh, rchunk, 128), omap_split),
+            pl.BlockSpec((1, 1, kh, rchunk, 128), omap_split),
+        )
+    else:
+        check_specs.append(("out", (1, kh, rchunk, d), (b, kh, r, d), q.dtype))
+        out_shape = jax.ShapeDtypeStruct((b, kh, r, d), q.dtype)
+        out_specs = pl.BlockSpec((1, kh, rchunk, d), qmap)
+    _validate_block_specs(check_specs)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=len(scalars),  # block_tables, q_start, kv_lens[, scales]
-        grid=(b, nq, nblk),
+        num_scalar_prefetch=len(scalars),
+        grid=(b, nq, ns, spb),
         in_specs=[
             pl.BlockSpec((1, kh, rchunk, d), qmap),
-            pl.BlockSpec((1, bs, kh, d), kvmap),
-            pl.BlockSpec((1, bs, kh, d), kvmap),
+            pl.BlockSpec((1, bs, kh, dp), kvmap),
+            pl.BlockSpec((1, bs, kh, dp), kvmap),
         ],
-        out_specs=pl.BlockSpec((1, kh, rchunk, d), qmap),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((kh, rchunk, d), jnp.float32),
             pltpu.VMEM((kh, rchunk, 128), jnp.float32),
@@ -254,14 +429,18 @@ def paged_attention_kernel(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, bs=bs, kh=kh, rep=rep, quant=quant),
+        functools.partial(_kernel, bs=bs, kh=kh, rep=rep, spb=spb,
+                          quant=quant, int4=int4, split=split),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kh, t * rep, d), q.dtype),
+        out_shape=out_shape,
         compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
         ),
         interpret=interpret,
     )(*scalars, qs, k_cache, v_cache)
+    if split:
+        out = _combine_splits(*out, out_dtype=q.dtype)
     # [B, KH, T*REP, D] → [B, T, H, D]
     return out.reshape(b, kh, t, rep, d).transpose(0, 2, 1, 3, 4).reshape(b, t, h, d)
 
@@ -275,6 +454,7 @@ def paged_attention_sharded(
     q_start: jax.Array,       # [B]
     kv_lens: jax.Array,       # [B]
     *,
+    num_splits: int = 0,
     interpret: bool = False,
 ) -> jax.Array:
     """TP-sharded paged attention: shard_map the kernel over the "model"
@@ -288,9 +468,11 @@ def paged_attention_sharded(
     if isinstance(k_cache, dict):
         # Quantized cache pytree: payload sharded on kv_heads, scales on
         # their matching head axis — each shard dequantizes its own heads.
+        # Packed-int4 payloads shard identically (packing is along D).
         cache_spec = {"q": P(None, None, "model", None), "s": P(None, "model")}
     fn = shard_map_compat(
-        functools.partial(paged_attention_kernel, interpret=interpret),
+        functools.partial(paged_attention_kernel, num_splits=num_splits,
+                          interpret=interpret),
         mesh=mesh,
         in_specs=(
             P("data", None, "model", None),

@@ -113,10 +113,13 @@ class EngineConfig:
     quantization: str = "none"
     # KV-cache storage dtype (engine/cache.py): "bfloat16" (store at model
     # precision — the default) | "int8" (symmetric per-block-per-kv-head
-    # quantization: payload + f32 scale sidecar). int8 halves the paged
-    # cache's bytes_per_block, so auto-sizing fits ~2x the blocks in the
-    # same HBM budget and decode's KV reads move half the bytes; dequant
-    # folds into the paged-attention kernel's per-block matmuls.
+    # quantization: payload + f32 scale sidecar) | "int4" (same scale
+    # pytree, two signed nibbles packed per byte along head_dim — needs an
+    # even head_dim). int8 halves the paged cache's bytes_per_block and
+    # int4 quarters it, so auto-sizing fits ~2x/~4x the blocks in the same
+    # HBM budget and decode's KV reads move 1/2 / 1/4 the bytes; dequant
+    # (and int4 nibble unpack) folds into the paged-attention kernel's
+    # per-block matmuls.
     kv_dtype: str = "bfloat16"
     enable_prefix_caching: bool = True
     kv_event_publishing: bool = True
@@ -143,6 +146,12 @@ class EngineConfig:
     # Attention implementation: "auto" (pallas on TPU, dense elsewhere),
     # "dense", "pallas", or "pallas_interpret" (CPU-testable kernel path).
     attn_impl: str = "auto"
+    # Split-K flash decode (ops/paged_attention.py): partition each row's
+    # context-block walk across this many grid programs, combining partial
+    # softmax state afterwards. 0 = auto (cost model picks from context
+    # length and core count, decode only), 1 = sequential walk (off),
+    # N>1 = forced split count (clamped to the block count).
+    attn_num_splits: int = 0
     # Fused decode window: run up to this many decode steps inside ONE
     # compiled dispatch (lax.scan on device, sampled tokens feeding back
     # without touching the host). Amortizes the per-dispatch host round

@@ -260,6 +260,10 @@ def test_int8_offload_onboard_determinism():
     ("int8", "int8"),       # packed blocks all the way
     ("int8", "bfloat16"),   # mixed: dequantize at import
     ("bfloat16", "int8"),   # mixed: requantize at import
+    ("int4", "int4"),       # packed nibbles all the way
+    ("int4", "bfloat16"),   # unpack + dequantize at import
+    ("bfloat16", "int4"),   # quantize + pack at import
+    ("int8", "int4"),       # cross-kind: requantize through float
 ])
 def test_export_import_across_kv_dtypes(src_dtype, dst_dtype):
     src = EngineCore(tiny_config(kv_dtype=src_dtype))
@@ -267,7 +271,7 @@ def test_export_import_across_kv_dtypes(src_dtype, dst_dtype):
     hashes = compute_block_hashes_for_tokens(PROMPT, 4)
     plan = src.export_blocks(hashes)
     assert len(plan) == 6  # all full prompt blocks resident + committed
-    if src_dtype == "int8":
+    if src_dtype in ("int8", "int4"):
         assert plan[0][2].dtype == np.uint8 and plan[0][2].ndim == 1
     dst = EngineCore(tiny_config(kv_dtype=dst_dtype))
     assert dst.import_blocks(plan) == 6
@@ -277,3 +281,143 @@ def test_export_import_across_kv_dtypes(src_dtype, dst_dtype):
     stats = dst.metrics.snapshot(dst.sched, dst.pool)
     assert stats["prefix_hit_rate"] > 0
     assert len(out["d"]) == 6
+
+
+# -- int4: packed-nibble KV (quarter bf16 footprint) --------------------------
+
+def test_bytes_per_block_int4_near_quarters():
+    cfg = resolve_model_config("llama-3-8b-lite")
+    bf16 = KVCacheSpec.for_model(cfg, 1, 16)
+    int4 = KVCacheSpec.for_model(cfg, 1, 16, kv_dtype="int4")
+    ratio = int4.bytes_per_block() / bf16.bytes_per_block()
+    assert ratio <= 0.30, f"int4 block is {ratio:.3f}x bf16, want <= 0.30"
+    assert int4.quantized and int4.packed_int4
+    assert int4.payload_dtype == jnp.uint8
+    assert int4.payload_head_dim == cfg.head_dim // 2
+    assert int4.scale_shape == (cfg.num_layers, 1, cfg.num_kv_heads)
+
+
+def test_auto_num_blocks_int4_fits_4x(monkeypatch):
+    """Equal HBM budget fits ~4x the blocks vs bf16 (modulo the per-block
+    scale overhead and flooring)."""
+
+    class FakeDev:
+        def memory_stats(self):
+            return {"bytes_limit": 1 << 30, "bytes_in_use": 0}
+
+    monkeypatch.setattr(jax, "devices", lambda: [FakeDev()])
+    cfg = resolve_model_config("llama-3-8b-lite")
+
+    def auto(kv_dtype):
+        r = ModelRunner.__new__(ModelRunner)
+        r.cfg = cfg
+        r.engine_cfg = EngineConfig(
+            model="llama-3-8b-lite", block_size=16,
+            max_model_len=1 << 20, max_batch_size=1 << 10,
+            kv_dtype=kv_dtype)
+        return r._auto_num_blocks()
+
+    n_bf16, n_int4 = auto("bfloat16"), auto("int4")
+    assert n_int4 >= int(3.8 * n_bf16), (n_bf16, n_int4)
+
+
+def test_int4_odd_head_dim_rejected():
+    spec = KVCacheSpec(num_blocks=8, block_size=4, num_layers=2,
+                       num_kv_heads=2, head_dim=7, dtype="float32",
+                       kv_dtype="int4")
+    with pytest.raises(ValueError, match="even head_dim"):
+        spec.payload_head_dim
+
+
+def test_allocate_cache_int4_shapes():
+    spec = KVCacheSpec(num_blocks=8, block_size=4, num_layers=2,
+                       num_kv_heads=2, head_dim=8, dtype="float32",
+                       kv_dtype="int4")
+    ck, cv = allocate_cache(spec, None)
+    assert ck["q"].shape == spec.payload_shape  # trailing dim = head_dim/2
+    assert ck["q"].shape[-1] == 4
+    assert ck["q"].dtype == jnp.uint8
+    assert ck["s"].shape == spec.scale_shape and ck["s"].dtype == jnp.float32
+    assert cv["q"].shape == spec.payload_shape
+
+
+def _int4_cache(nb=8, bs=4, kh=2, d=8):
+    return {"q": jnp.zeros((nb, bs, kh, d // 2), jnp.uint8),
+            "s": jnp.zeros((nb, kh), jnp.float32)}
+
+
+def test_scatter_gather_roundtrip_int4():
+    """±7 quantization: round-trip error bounded by half a quant step
+    (amax/14) per element."""
+    from dynamo_tpu.models.llama import _gather_kv, _scatter_kv
+
+    rng = np.random.default_rng(0)
+    new = jnp.asarray(rng.normal(size=(2, 8, 2, 8)).astype(np.float32))
+    slots = jnp.asarray([[0, 1, 2, 3, 4, 5, 6, 7],
+                         [8, 9, 10, 11, 12, 13, 14, 15]], jnp.int32)
+    cache = _scatter_kv(_int4_cache(), new, slots)
+    assert cache["q"].dtype == jnp.uint8 and cache["q"].shape[-1] == 4
+    bt = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+    got = _gather_kv(cache, bt)
+    err = np.abs(np.asarray(got) - np.asarray(new)).max()
+    scale = np.abs(np.asarray(new)).max()
+    assert err / scale < 0.08, err / scale
+
+
+def test_int4_scatter_append_merges_scales():
+    """The int8 scale lifecycle (offset-0 reset, max-merge, committed-row
+    requant) must survive the pack/unpack round through uint8 nibbles."""
+    from dynamo_tpu.models.llama import _gather_kv, _scatter_kv
+
+    first = jnp.full((1, 2, 2, 8), 0.5, jnp.float32)
+    cache = _scatter_kv(_int4_cache(), first, jnp.asarray([[0, 1]], jnp.int32))
+    second = jnp.full((1, 2, 2, 8), 4.0, jnp.float32)
+    cache = _scatter_kv(cache, second, jnp.asarray([[2, 3]], jnp.int32))
+    got = np.asarray(_gather_kv(cache, jnp.asarray([[0]], jnp.int32)))[0]
+    assert np.abs(got[:2] - 0.5).max() < 0.3    # 4.0/7 quant step
+    assert np.abs(got[2:4] - 4.0).max() < 0.3
+
+
+@pytest.mark.parametrize("variant", [
+    {},                                    # plain decode
+    {"decode_window": 4},                  # fused windowed decode
+    {"spec_ngram": 2, "spec_k": 4},        # verify path
+    {"attn_impl": "pallas_interpret"},     # kernel path (interpreted)
+    {"attn_impl": "pallas_interpret", "attn_num_splits": 2},  # split-K
+], ids=["dense", "windowed", "verify", "pallas_interpret", "split_k"])
+def test_int4_engine_parity(variant):
+    """int4 vs model-precision engines, same contract as the int8 twin:
+    internal determinism plus an agreeing initial prefix."""
+    toks_f = _greedy("bfloat16", **variant)
+    toks_q = _greedy("int4", **variant)
+    assert toks_f == _greedy("bfloat16", **variant)  # determinism
+    assert toks_q == _greedy("int4", **variant)
+    assert len(toks_f) == len(toks_q) == 6
+    common = 0
+    for a, b in zip(toks_f, toks_q):
+        if a != b:
+            break
+        common += 1
+    assert common >= 1, (toks_f, toks_q)
+
+
+def test_int4_offload_onboard_determinism():
+    """Mirror of the int8 offload round-trip: the packed nibble payload
+    must move through the host tier bit-for-bit."""
+    core = EngineCore(tiny_config(kv_dtype="int4", num_blocks=13,
+                                  host_kv_blocks=64))
+    assert core.kvbm is not None
+    prompt_a = list(range(100, 124))
+    first, _ = run_to_completion(
+        core, [make_req(prompt=prompt_a, max_tokens=6, rid="a1")])
+    fillers = [make_req(prompt=[200 + 30 * i + j for j in range(24)],
+                        max_tokens=4, rid=f"f{i}") for i in range(4)]
+    run_to_completion(core, fillers)
+    assert core.kvbm.stats.offloaded_blocks > 0
+    host = core.kvbm.tiers[0]
+    assert host._arena.dtype == np.uint8
+    assert host._arena.shape[1:] == (core.runner.spec.bytes_per_block(),)
+    second, _ = run_to_completion(
+        core, [make_req(prompt=prompt_a, max_tokens=6, rid="a2")])
+    assert core.kvbm.stats.onboarded_blocks > 0
+    assert second["a2"] == first["a1"]

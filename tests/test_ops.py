@@ -240,3 +240,181 @@ def test_paged_attention_kernel_parity_bench_shapes_int8_cache():
                      jax.nn.softmax(scores, axis=-1), vg.astype(jnp.float32))
     err = np.abs(np.asarray(out_kernel) - np.asarray(ref.reshape(b, 1, h, d))).max()
     assert err < 2e-4, err
+
+
+# -- Split-K flash decode -----------------------------------------------------
+
+@pytest.mark.parametrize("ns", [2, 4])
+def test_split_k_bitwise_equal_sequential_bf16(ns):
+    """The split-K combine must not perturb bf16 decode output at all:
+    partial flash state is f32 and the logsumexp-weighted merge reproduces
+    the sequential accumulator bit-for-bit after the bf16 round."""
+    rng = np.random.default_rng(11)
+    case = _make_case(rng, b=2, t=1, h=8, kh=8, d=128, nb=24, bs=16, nblk=4,
+                      dtype=jnp.bfloat16)
+    q, k_cache, v_cache, block_tables, q_start, q_len = case
+    seq = paged_attention_kernel(
+        q, k_cache, v_cache, block_tables, q_start, q_start + q_len,
+        num_splits=1, interpret=True)
+    split = paged_attention_kernel(
+        q, k_cache, v_cache, block_tables, q_start, q_start + q_len,
+        num_splits=ns, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(split, np.float32), np.asarray(seq, np.float32))
+
+
+def test_split_k_matches_sequential_f32_tight():
+    """f32 split-K differs from sequential only by combine-order float
+    association — tight allclose, not bitwise."""
+    rng = np.random.default_rng(12)
+    case = _make_case(rng, b=3, t=1, h=4, kh=2, d=64, nb=32, bs=16, nblk=8)
+    q, k_cache, v_cache, block_tables, q_start, q_len = case
+    seq = paged_attention_kernel(
+        q, k_cache, v_cache, block_tables, q_start, q_start + q_len,
+        num_splits=1, interpret=True)
+    split = paged_attention_kernel(
+        q, k_cache, v_cache, block_tables, q_start, q_start + q_len,
+        num_splits=4, interpret=True)
+    np.testing.assert_allclose(np.asarray(split), np.asarray(seq),
+                               atol=2e-6, rtol=2e-6)
+
+
+def test_split_k_wildly_ragged_batch_matches_dense():
+    """Ragged rows spanning [1 block, max blocks] under forced split-K:
+    rows whose context ends before a split's range contribute empty
+    partials (m=-inf, l=0) that the combine must ignore."""
+    rng = np.random.default_rng(13)
+    b, t, h, kh, d, nb, bs, nblk = 4, 1, 4, 2, 64, 48, 16, 8
+    q, k_cache, v_cache, block_tables, _, _ = _make_case(
+        rng, b, t, h, kh, d, nb, bs, nblk)
+    # kv_lens 1 (one block, one token) .. 128 (all 8 blocks full)
+    kv_lens = jnp.asarray([1, 16, 63, nblk * bs], jnp.int32)
+    q_start = kv_lens - 1
+    q_len = jnp.ones((b,), jnp.int32)
+    ref = _dense_ref(q, k_cache, v_cache, block_tables, q_start, q_len)
+    for ns in (2, 4, 8):
+        out = paged_attention_kernel(
+            q, k_cache, v_cache, block_tables, q_start, kv_lens,
+            num_splits=ns, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_split_k_forced_beyond_nblk_clamps():
+    """An absurd forced num_splits clamps to nblk and still matches."""
+    from dynamo_tpu.ops.paged_attention import resolve_num_splits
+
+    assert resolve_num_splits(999, nblk=4, batch=1, q_chunks=1, q_tokens=1) == 4
+    assert resolve_num_splits(0, nblk=512, batch=1, q_chunks=1, q_tokens=8) == 1
+    rng = np.random.default_rng(14)
+    case = _make_case(rng, b=2, t=1, h=4, kh=2, d=64, nb=16, bs=16, nblk=2)
+    q, k_cache, v_cache, block_tables, q_start, q_len = case
+    seq = paged_attention_kernel(
+        q, k_cache, v_cache, block_tables, q_start, q_start + q_len,
+        num_splits=1, interpret=True)
+    out = paged_attention_kernel(
+        q, k_cache, v_cache, block_tables, q_start, q_start + q_len,
+        num_splits=999, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(seq),
+                               atol=2e-6, rtol=2e-6)
+
+
+# -- Packed int4 KV -----------------------------------------------------------
+
+def test_pack_unpack_int4_roundtrip_and_odd_dim():
+    from dynamo_tpu.ops.paged_attention import pack_int4, unpack_int4
+
+    rng = np.random.default_rng(15)
+    vals = jnp.asarray(rng.integers(-8, 8, size=(5, 3, 16)), jnp.int32)
+    packed = pack_int4(vals)
+    assert packed.dtype == jnp.uint8 and packed.shape == (5, 3, 8)
+    np.testing.assert_array_equal(np.asarray(unpack_int4(packed)),
+                                  np.asarray(vals))
+    with pytest.raises(ValueError, match="even trailing dim"):
+        pack_int4(jnp.zeros((2, 7), jnp.int32))
+
+
+def test_paged_attention_kernel_parity_bench_shapes_int4_cache():
+    """Bench geometry with the packed-int4 cache (uint8 nibbles, in-kernel
+    unpack + dequant): kernel vs dense gather on identical quantized
+    content, so the only divergence is float association."""
+    from dynamo_tpu.models.llama import _gather_kv, _scatter_kv
+
+    rng = np.random.default_rng(16)
+    nb, bs, kh, d, b, h = 24, 16, 8, 128, 2, 8
+    kc = {"q": jnp.zeros((nb, bs, kh, d // 2), jnp.uint8),
+          "s": jnp.zeros((nb, kh), jnp.float32)}
+    vc = {"q": jnp.zeros((nb, bs, kh, d // 2), jnp.uint8),
+          "s": jnp.zeros((nb, kh), jnp.float32)}
+    ctx = 2 * bs
+    slots = jnp.stack([jnp.arange(ctx), 2 * bs + jnp.arange(ctx)]).astype(jnp.int32)
+    kc = _scatter_kv(kc, jnp.asarray(rng.normal(size=(b, ctx, kh, d)), jnp.float32), slots)
+    vc = _scatter_kv(vc, jnp.asarray(rng.normal(size=(b, ctx, kh, d)), jnp.float32), slots)
+    assert kc["q"].dtype == jnp.uint8 and kc["q"].shape[-1] == d // 2
+    q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+    bt = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+    q_start = jnp.full((b,), ctx - 1, jnp.int32)
+    kv_lens = jnp.full((b,), ctx, jnp.int32)
+
+    out_kernel = paged_attention_kernel(q, kc, vc, bt, q_start, kv_lens,
+                                        interpret=True)
+    kg, vg = _gather_kv(kc, bt), _gather_kv(vc, bt)
+    rep = h // kh
+    qr = (q * (d ** -0.5)).reshape(b, 1, kh, rep, d).astype(jnp.float32)
+    scores = jnp.einsum("btkrd,bskd->btkrs", qr, kg.astype(jnp.float32))
+    mask = jnp.arange(ctx)[None, :] < kv_lens[:, None]
+    scores = jnp.where(mask[:, None, None, None, :], scores, -1e30)
+    ref = jnp.einsum("btkrs,bskd->btkrd",
+                     jax.nn.softmax(scores, axis=-1), vg.astype(jnp.float32))
+    err = np.abs(np.asarray(out_kernel) - np.asarray(ref.reshape(b, 1, h, d))).max()
+    assert err < 5e-4, err
+
+
+def test_int4_cache_split_k_matches_sequential():
+    """Split-K over a packed-int4 cache matches the sequential kernel on
+    the same quantized content (float-association tolerance)."""
+    from dynamo_tpu.models.llama import _scatter_kv
+
+    rng = np.random.default_rng(17)
+    nb, bs, kh, d, b, h, nblk = 16, 16, 2, 64, 2, 4, 4
+    kc = {"q": jnp.zeros((nb, bs, kh, d // 2), jnp.uint8),
+          "s": jnp.zeros((nb, kh), jnp.float32)}
+    vc = {"q": jnp.zeros((nb, bs, kh, d // 2), jnp.uint8),
+          "s": jnp.zeros((nb, kh), jnp.float32)}
+    ctx = nblk * bs
+    slots = jnp.stack([jnp.arange(ctx), ctx + jnp.arange(ctx)]).astype(jnp.int32)
+    kc = _scatter_kv(kc, jnp.asarray(rng.normal(size=(b, ctx, kh, d)), jnp.float32), slots)
+    vc = _scatter_kv(vc, jnp.asarray(rng.normal(size=(b, ctx, kh, d)), jnp.float32), slots)
+    q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+    bt = jnp.asarray([[0, 1, 2, 3], [4, 5, 6, 7]], jnp.int32)
+    q_start = jnp.full((b,), ctx - 1, jnp.int32)
+    kv_lens = jnp.full((b,), ctx, jnp.int32)
+    seq = paged_attention_kernel(q, kc, vc, bt, q_start, kv_lens,
+                                 num_splits=1, interpret=True)
+    split = paged_attention_kernel(q, kc, vc, bt, q_start, kv_lens,
+                                   num_splits=2, interpret=True)
+    np.testing.assert_allclose(np.asarray(split), np.asarray(seq),
+                               atol=2e-6, rtol=2e-6)
+
+
+def test_validate_block_specs_int4_and_split_state():
+    """The static guard understands packed-int4 payload blocks (uint8,
+    trailing dim D/2, whole-axis on both minor dims) and the split-K f32
+    partial-state outputs; a per-head packed block still fails readably."""
+    from dynamo_tpu.ops.paged_attention import (
+        _validate_block_specs,
+        mosaic_block_shape_ok,
+    )
+
+    # int4 payload: whole-axis KH and D/2 pass; per-head slice fails.
+    assert mosaic_block_shape_ok((1, 16, 8, 64), (128, 16, 8, 64), jnp.uint8)
+    assert not mosaic_block_shape_ok((1, 16, 1, 64), (128, 16, 8, 64),
+                                     jnp.uint8)
+    _validate_block_specs([
+        ("k_cache_int4", (1, 16, 8, 64), (128, 16, 8, 64), jnp.uint8),
+        ("acc_split", (1, 1, 8, 4, 128), (2, 4, 8, 4, 128), jnp.float32),
+        ("m_split", (1, 1, 8, 4, 128), (2, 4, 8, 4, 128), jnp.float32),
+    ])
+    with pytest.raises(ValueError, match="k_cache_int4.*uint8"):
+        _validate_block_specs([
+            ("k_cache_int4", (1, 16, 1, 64), (128, 16, 8, 64), jnp.uint8)])

@@ -57,6 +57,66 @@ def test_paged_attention_cost_int8_halves_kv_payload():
     assert int8.hbm_bytes < bf16.hbm_bytes
 
 
+def test_paged_attention_cost_int4_quarters_kv_payload():
+    kw = dict(batch=2, q_tokens=1, num_heads=4, num_kv_heads=2, head_dim=16,
+              kv_len=10, block_size=4)
+    bf16 = cm.paged_attention_cost(kv_dtype="bfloat16", **kw)
+    int4 = cm.paged_attention_cost(kv_dtype="int4", **kw)
+    assert int4.flops == bf16.flops                     # same matmul volume
+    # int4 block: quarter payload (0.5 B/elem) + per-(block, kv-head) f32
+    # scales — hand-computed like the int8 twin above.
+    kv_block = 4 * 2 * 16 * 0.5 + 2 * 4
+    q_bytes = 2 * 1 * 4 * 16 * 2
+    assert int4.hbm_bytes == 2 * q_bytes + 2 * 2 * 3 * kv_block
+    # The KV payload alone (scales excluded) is exactly 0.25x bf16's.
+    bf16_kv_payload = bf16.hbm_bytes - 2 * q_bytes
+    int4_kv_payload = int4.hbm_bytes - 2 * q_bytes - 2 * 2 * 3 * (2 * 4)
+    assert int4_kv_payload == pytest.approx(0.25 * bf16_kv_payload)
+
+
+def test_paged_attention_cost_split_combine_hand_computed():
+    """num_splits > 1 charges exactly the documented combine formula:
+    8·NS·rows·(D+256) HBM bytes and NS·rows·(2D+8) FLOPs; ns=1 is free."""
+    kw = dict(batch=2, q_tokens=1, num_heads=4, num_kv_heads=2, head_dim=16,
+              kv_len=64, block_size=4)
+    seq = cm.paged_attention_cost(num_splits=1, **kw)
+    split = cm.paged_attention_cost(num_splits=4, **kw)
+    rows = 2 * 1 * 4
+    assert split.hbm_bytes == seq.hbm_bytes + 8 * 4 * rows * (16 + 256)
+    assert split.flops == seq.flops + 4 * rows * (2 * 16 + 8)
+    default = cm.paged_attention_cost(**kw)
+    assert (default.flops, default.hbm_bytes) == (seq.flops, seq.hbm_bytes)
+
+
+def test_model_step_cost_split_combine_scales_with_layers():
+    cfg = resolve_model_config("tiny-llama")
+    kw = dict(tokens=4, logit_rows=4, attn_q_ctx=4 * 16.0, kv_blocks=16.0,
+              block_size=4)
+    seq = cm.total_cost(cm.model_step_cost(cfg, **kw))
+    sp = cm.total_cost(cm.model_step_cost(cfg, attn_num_splits=2, **kw))
+    rows = 4 * cfg.num_heads
+    L = cfg.num_layers
+    assert sp.hbm_bytes == seq.hbm_bytes + 8 * 2 * rows * (cfg.head_dim + 256) * L
+    assert sp.flops == seq.flops + 2 * rows * (2 * cfg.head_dim + 8) * L
+
+
+def test_auto_num_splits_policy():
+    # Short context never splits (the combine would cost more than it saves).
+    assert cm.auto_num_splits(4, batch=1) == 1
+    assert cm.auto_num_splits(3, batch=32) == 1
+    # One long row: split to fill the cores.
+    assert cm.auto_num_splits(512, batch=1) == 8
+    # A batch that already fills the cores stays sequential.
+    assert cm.auto_num_splits(512, batch=8) == 1
+    assert cm.auto_num_splits(512, batch=32) == 1
+    # The split count never shrinks a split below min_blocks_per_split.
+    assert cm.auto_num_splits(8, batch=1) == 2
+    # Query chunks count as existing parallel streams.
+    assert cm.auto_num_splits(512, batch=2, q_chunks=4) == 1
+    # max_splits caps a huge core count.
+    assert cm.auto_num_splits(512, batch=1, core_count=64) == 16
+
+
 def test_dense_matmul_cost_hand_computed():
     c = cm.dense_matmul_cost(8, 16, 32)
     assert c.flops == 2 * 8 * 16 * 32
@@ -101,6 +161,29 @@ def test_decode_step_int8_kv_moves_fewer_bytes():
     int8 = cm.total_cost(cm.decode_step_cost(cfg, kv_dtype="int8", **kw))
     assert int8.flops == bf16.flops
     assert int8.hbm_bytes < bf16.hbm_bytes
+
+
+def test_decode_step_kv_dtype_bytes_strictly_ordered():
+    """bf16 > int8 > int4 step bytes at long context — the lever the int4
+    cache pulls — with identical matmul volume across all three."""
+    cfg = MODEL_PRESETS["llama-3-8b-lite"]
+    kw = dict(batch=16, kv_len=8192, block_size=16)
+    costs = {kv: cm.total_cost(cm.decode_step_cost(cfg, kv_dtype=kv, **kw))
+             for kv in cm.KV_DTYPES}
+    assert costs["bfloat16"].flops == costs["int8"].flops == costs["int4"].flops
+    assert (costs["bfloat16"].hbm_bytes > costs["int8"].hbm_bytes
+            > costs["int4"].hbm_bytes)
+
+
+def test_predicted_decode_perf_per_kv_dtype_ordering():
+    """The roofline prediction must rank int4 > int8 > bf16 tok/s in the
+    bandwidth-bound long-context regime (the bench longctx sweep's claim)."""
+    cfg = MODEL_PRESETS["llama-3-8b-lite"]
+    hw = cm.hw_spec_for("tpu v5 lite")
+    preds = {kv: cm.predicted_decode_perf(
+        cfg, hw, batch=16, kv_len=8192, kv_dtype=kv)["tok_s"]
+        for kv in cm.KV_DTYPES}
+    assert preds["int4"] > preds["int8"] > preds["bfloat16"] > 0
 
 
 def test_analytic_param_bytes_matches_runtime():
@@ -306,15 +389,65 @@ def test_perf_report_check_smoke():
     assert perf_main(["--check"]) == 0
 
 
-def test_kernel_rows_cover_both_kv_modes():
+def test_kernel_rows_cover_every_kv_mode():
     cfg = MODEL_PRESETS["llama-3-8b-lite"]
     rows = kernel_rows(cfg, cm.hw_spec_for("tpu v5 lite"), batch=32,
                        context=160, block_size=16, quantization="none",
                        measured_step_s=32 / 440.2)
     pa = {r["kv_dtype"]: r for r in rows if r["kernel"] == "paged_attention"}
-    assert set(pa) == {"bfloat16", "int8"}
+    assert set(pa) == set(cm.KV_DTYPES)
     for r in pa.values():
         assert r["achieved"] and 0 < r["mfu"] < 1 and 0 < r["bw_util"] < 1
+
+
+def test_kernel_rows_split_variant_when_auto_engages():
+    """At a small-batch long-context geometry the auto policy splits, and
+    the scoreboard gains a split-K attention row per kv mode whose bytes
+    exceed the sequential row's (the combine overhead is visible)."""
+    cfg = MODEL_PRESETS["llama-3-8b-lite"]
+    rows = kernel_rows(cfg, cm.hw_spec_for("tpu v5 lite"), batch=2,
+                       context=4096, block_size=16, quantization="none")
+    by = {(r["kernel"], r["kv_dtype"]): r for r in rows}
+    split_rows = [k for k in by if k[0].startswith("paged_attention split=")]
+    assert {kv for _, kv in split_rows} == set(cm.KV_DTYPES)
+    for (kernel, kv) in split_rows:
+        assert by[(kernel, kv)]["hbm_bytes"] > by[("paged_attention", kv)]["hbm_bytes"]
+
+
+def test_perf_tok_s_gauge_labeled_by_kv_dtype():
+    """The tokens/s gauge carries kind AND kv_dtype labels (the contract
+    declared in tools/lint_metrics.py PERF_METRIC_LABELS)."""
+    from dynamo_tpu.obs.profiler import install_perf_metrics
+
+    reg = MetricsRegistry()
+    install_perf_metrics(reg)
+    prof = StepPerfProfiler(tiny_config_model(), tiny_config(kv_dtype="int4"),
+                            device_kind="cpu", enabled=True)
+    prof.measure([("decode", [(0, 8, 1)], [0], _FakeArr((1,)), None)], 0.01)
+    text = reg.expose()
+    assert 'kv_dtype="int4"' in text and 'kind="decode"' in text
+
+
+def test_lint_flags_perf_label_drift(tmp_path):
+    """A tok_s emit whose labels drift from PERF_METRIC_LABELS fails the
+    metrics lint (the dashboard PromQL contract)."""
+    import textwrap
+
+    from tools.lint_metrics import lint_tree
+
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    (obs / "profiler.py").write_text(textwrap.dedent("""
+        class P:
+            def bind(self, registry):
+                self.tok_s = registry.gauge(
+                    "engine_perf_tokens_per_second", "help")
+            def measure(self):
+                self.tok_s.set(1.0, kind="decode")  # kv_dtype missing
+    """))
+    problems = lint_tree(tmp_path)
+    assert any("PERF_METRIC_LABELS" in p and "kv_dtype" in p
+               for p in problems), "\n".join(problems)
 
 
 # ---------------------------------------------------------------------------
@@ -342,3 +475,32 @@ def test_bench_predicted_perf_targets_device():
     assert pred is not None
     assert pred["device"] == "tpu-v5e"
     assert pred["bound"] in ("bandwidth", "compute")
+
+
+def test_bench_longctx_metric_sweeps_kv_dtype_and_split():
+    """The long-context metric predicts bs16/ctx8k decode for every
+    kv_dtype x {split_off, split_on}; quantized KV beats bf16 in this
+    bandwidth-bound regime."""
+    lc = bench._longctx_metric()
+    assert lc["metric"] == "decode_throughput_llama_3_8b_lite_bs16_ctx8k"
+    assert lc["metric"] == bench.LONGCTX_METRIC
+    assert lc["source"] == "costmodel" and lc["unit"] == "tok/s/chip"
+    assert lc["batch"] == 16 and lc["context"] == 8192
+    assert lc["split_on_n"] > 1
+    pred = lc["predicted"]
+    want = {f"{kv}/{arm}" for kv in cm.KV_DTYPES
+            for arm in ("split_off", "split_on")}
+    assert set(pred) == want and len(pred) == 2 * len(cm.KV_DTYPES)
+    assert all(v > 0 for v in pred.values())
+    assert pred["int4/split_off"] > pred["int8/split_off"] > pred["bfloat16/split_off"]
+
+
+def test_bench_fail_line_carries_longctx(capsys):
+    """Even a failure line ships the long-context sweep — the metric is
+    always-green by contract."""
+    with pytest.raises(SystemExit):
+        bench.fail("unit_test", "synthetic failure")
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    lc = out.get("longctx")
+    assert lc and lc["metric"] == bench.LONGCTX_METRIC
+    assert len(lc["predicted"]) == 2 * len(cm.KV_DTYPES)

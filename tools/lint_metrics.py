@@ -67,6 +67,15 @@ PERF_METRICS = (
     "engine_perf_step_seconds",
 )
 
+# Label sets of the perf family's labelled series — the dashboard-facing
+# contract for every ``.set(...)``/``.inc(...)``/``.observe(...)`` keyword
+# in obs/profiler.py. A labelled emit whose metric isn't declared here, or
+# whose label names drift from the declared tuple, fails the lint (changing
+# a label silently breaks every PromQL ``by (label)`` aggregation).
+PERF_METRIC_LABELS = {
+    "engine_perf_tokens_per_second": ("kind", "kv_dtype"),
+}
+
 # The failure-recovery family: health canaries (runtime/health.py),
 # migration re-dispatch (frontend/migration.py), and chaos injection
 # (chaos/metrics.py). Same bidirectional drift rule as KV_TRANSFER_METRICS:
@@ -214,6 +223,52 @@ def _lint_perf_metrics(root: Path, problems: list[str]) -> None:
             "does not register it")
 
 
+def _lint_perf_labels(root: Path, problems: list[str]) -> None:
+    """Labelled emits in obs/profiler.py must carry exactly the label names
+    PERF_METRIC_LABELS declares for their metric (and any newly-labelled
+    metric must be declared). The attr→metric-name map comes from the
+    ``self.<attr> = registry.gauge("<name>", ...)`` assignments in
+    PerfMetrics.bind, so the check follows renames automatically."""
+    path = root / "obs" / "profiler.py"
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError):
+        return
+    attr_to_metric: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Attribute)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr in METHODS and node.value.args):
+            name = _const_str(node.value.args[0])
+            if name is not None:
+                attr_to_metric[node.targets[0].attr] = name
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("set", "inc", "observe")
+                and isinstance(node.func.value, ast.Attribute)):
+            continue
+        metric = attr_to_metric.get(node.func.value.attr)
+        if metric is None:
+            continue
+        labels = tuple(sorted(
+            kw.arg for kw in node.keywords if kw.arg is not None))
+        declared = PERF_METRIC_LABELS.get(metric)
+        where = f"{path}:{node.lineno}"
+        if declared is None:
+            if labels:
+                problems.append(
+                    f"{where}: {metric!r} emitted with labels {labels} but "
+                    "has no entry in tools/lint_metrics.py "
+                    "PERF_METRIC_LABELS")
+        elif labels != tuple(sorted(declared)):
+            problems.append(
+                f"{where}: {metric!r} emitted with labels {labels}, "
+                f"PERF_METRIC_LABELS declares {tuple(sorted(declared))}")
+
+
 def _lint_recovery_metrics(root: Path, problems: list[str]) -> None:
     """The recovery family must match what each module actually registers
     — same no-silent-drift rule as KV_TRANSFER_METRICS."""
@@ -269,6 +324,7 @@ def lint_tree(root: Path | None = None) -> list[str]:
     _lint_provider_metrics(root, problems)
     _lint_kv_transfer_metrics(root, problems)
     _lint_perf_metrics(root, problems)
+    _lint_perf_labels(root, problems)
     _lint_recovery_metrics(root, problems)
     return problems
 
