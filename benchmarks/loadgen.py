@@ -55,6 +55,31 @@ def make_prompt(isl: int, seed: int, chars_per_token: float) -> str:
     return " ".join(parts)
 
 
+def make_prefix_prompt(template_id: int, prefix_tokens: int, isl: int,
+                       seed: int, chars_per_token: float) -> str:
+    """Shared-system-prompt workload: ~``prefix_tokens`` of text that is
+    BYTE-IDENTICAL for every request using ``template_id`` (so their block
+    hash chains match and the prefix cache can hit), followed by a
+    per-request unique suffix filling the rest of ``isl``."""
+    rng = random.Random(10_000_019 * (template_id + 1))  # template body only
+    budget = max(int(prefix_tokens * chars_per_token), 8)
+    parts = [f"system template {template_id}:"]
+    size = len(parts[0])
+    while size < budget:
+        w = rng.choice(WORDS)
+        parts.append(w)
+        size += len(w) + 1
+    suffix = make_prompt(max(isl - prefix_tokens, 8), seed, chars_per_token)
+    return " ".join(parts) + " " + suffix
+
+
+def zipf_template(n_templates: int, zipf_s: float, rng: random.Random) -> int:
+    """Zipf-weighted template pick: template k has weight 1/(k+1)^s — a few
+    hot system prompts, a long warm tail, like real multi-tenant traffic."""
+    weights = [1.0 / (k + 1) ** zipf_s for k in range(n_templates)]
+    return rng.choices(range(n_templates), weights=weights)[0]
+
+
 async def calibrate(session: aiohttp.ClientSession, url: str, model: str) -> float:
     """Measure the model's chars-per-token on this endpoint: send a known
     character count, read usage.prompt_tokens back (non-streaming)."""
@@ -99,7 +124,8 @@ async def one_request(session: aiohttp.ClientSession, url: str, model: str,
                       chars_per_token: float,
                       priority: str | None = None,
                       deadline_ms: float | None = None,
-                      client_id: str | None = None) -> RequestResult:
+                      client_id: str | None = None,
+                      prompt: str | None = None) -> RequestResult:
     res = RequestResult()
     res.priority = priority or ""
     headers = {}
@@ -111,7 +137,8 @@ async def one_request(session: aiohttp.ClientSession, url: str, model: str,
         headers["x-client-id"] = client_id
     body = {
         "model": model,
-        "messages": [{"role": "user", "content": make_prompt(isl, seed, chars_per_token)}],
+        "messages": [{"role": "user", "content": prompt if prompt is not None
+                      else make_prompt(isl, seed, chars_per_token)}],
         "max_tokens": osl,
         "temperature": 0.0,
         "ignore_eos": True,
@@ -169,36 +196,108 @@ async def one_request(session: aiohttp.ClientSession, url: str, model: str,
     return res
 
 
+async def scrape_prefix_cache(urls: list[str]) -> "dict[str, float] | None":
+    """Sum dynamo_prefix_cache_* samples across the given /metrics endpoints
+    (frontend and/or per-worker status servers). Labelled series (the
+    route_decisions counter) and histogram _sum/_count lines fold into
+    their base sample name. None when nothing was reachable."""
+    acc: dict[str, float] = {}
+    seen = False
+    for u in urls:
+        if not u.rstrip("/").endswith("/metrics"):
+            u = f"{u.rstrip('/')}/metrics"
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                        u,
+                        timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    if resp.status != 200:
+                        continue
+                    text = await resp.text()
+        except Exception:
+            continue
+        for line in text.splitlines():
+            if not line.startswith("dynamo_prefix_cache_"):
+                continue
+            name = line.split("{")[0].split(" ")[0]
+            try:
+                value = float(line.rsplit(" ", 1)[-1])
+            except ValueError:
+                continue
+            acc[name] = acc.get(name, 0.0) + value
+            seen = True
+    return acc if seen else None
+
+
 async def run_load(url: str, model: str, concurrency: int, num_requests: int,
-                   isl: int, osl: int, warmup: int) -> dict:
+                   isl: int, osl: int, warmup: int,
+                   prefix_templates: int = 0, prefix_tokens: int = 256,
+                   zipf_s: float = 1.1,
+                   metrics_urls: "list[str] | None" = None) -> dict:
     results: list[RequestResult] = []
     counter = iter(range(10 ** 9))
+    pick_rng = random.Random(1234)
     timeout = aiohttp.ClientTimeout(total=None, sock_connect=30)
+
+    def prompt_for(seed: int) -> str | None:
+        if prefix_templates <= 0:
+            return None  # one_request builds the unique-prefix prompt
+        tid = zipf_template(prefix_templates, zipf_s, pick_rng)
+        return make_prefix_prompt(tid, prefix_tokens, isl, seed, cpt)
+
     async with aiohttp.ClientSession(timeout=timeout) as session:
         cpt = await calibrate(session, url, model)
         # Warmup (compile all engine buckets) — excluded from measurement.
         for _ in range(warmup):
             await one_request(session, url, model, isl, osl, next(counter), cpt)
+        scrape_urls = metrics_urls or [url]
+        want_cache = prefix_templates > 0 or metrics_urls is not None
+        before = await scrape_prefix_cache(scrape_urls) if want_cache else None
 
         t_start = time.perf_counter()
         pending: set[asyncio.Task] = set()
         issued = 0
         while issued < num_requests or pending:
             while issued < num_requests and len(pending) < concurrency:
+                seed = next(counter)
                 pending.add(asyncio.create_task(one_request(
-                    session, url, model, isl, osl, next(counter), cpt)))
+                    session, url, model, isl, osl, seed, cpt,
+                    prompt=prompt_for(seed))))
                 issued += 1
             done, pending = await asyncio.wait(
                 pending, return_when=asyncio.FIRST_COMPLETED)
             results.extend(t.result() for t in done)
         wall = time.perf_counter() - t_start
 
+    prefix_summary = None
+    if want_cache:
+        after = await scrape_prefix_cache(scrape_urls)
+        if before is not None and after is not None:
+            def delta(metric: str) -> float:
+                k = f"dynamo_prefix_cache_{metric}"
+                return after.get(k, 0.0) - before.get(k, 0.0)
+            lookups = delta("lookups")
+            count = delta("import_seconds_count")
+            prefix_summary = {
+                "templates": prefix_templates,
+                "prefix_tokens": prefix_tokens,
+                "zipf_s": zipf_s,
+                "lookups": int(lookups),
+                "hits": int(delta("hits")),
+                "hit_rate": round(delta("hits") / lookups, 4) if lookups else 0.0,
+                "imported_blocks": int(delta("imported_blocks")),
+                "recompute_avoided_tokens": int(delta("recompute_avoided_tokens")),
+                "published_blocks": int(delta("published_blocks")),
+                "import_seconds_avg": round(
+                    delta("import_seconds_sum") / count, 5) if count else 0.0,
+            }
+
     good = [r for r in results if r.ok]
     bad = [r for r in results if not r.ok]
     ttfts = [r.ttft_s for r in good]
     itls = [x for r in good for x in r.itl_s]
     total_tokens = sum(r.output_tokens for r in good)
-    return {
+    out = {
         "requests": len(results),
         "failed": len(bad),
         "errors": sorted({r.error for r in bad})[:5],
@@ -216,6 +315,9 @@ async def run_load(url: str, model: str, concurrency: int, num_requests: int,
         "e2e_p50_s": round(percentile([r.latency_s for r in good], 50), 4),
         "e2e_p99_s": round(percentile([r.latency_s for r in good], 99), 4),
     }
+    if prefix_summary is not None:
+        out["prefix_cache"] = prefix_summary
+    return out
 
 
 def _parse_mix(spec: str) -> list[tuple[str, float]]:
@@ -354,6 +456,22 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--isl", type=int, default=128)
     ap.add_argument("--osl", type=int, default=32)
     ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--prefix-mix", type=int, default=0, metavar="N",
+                    help="closed mode: N shared system-prompt templates; "
+                         "each request prepends a zipf-weighted template "
+                         "(byte-identical per template, so the fleet prefix "
+                         "cache can hit) before its unique suffix. 0 = off "
+                         "(all-unique prompts)")
+    ap.add_argument("--prefix-tokens", type=int, default=256,
+                    help="shared template length in tokens (--prefix-mix)")
+    ap.add_argument("--zipf", type=float, default=1.1,
+                    help="zipf exponent for template popularity "
+                         "(--prefix-mix); higher = hotter head")
+    ap.add_argument("--metrics-url", action="append", default=None,
+                    help="scrape dynamo_prefix_cache_* from this /metrics "
+                         "endpoint before and after the run (repeatable — "
+                         "point at each worker's status server); defaults "
+                         "to --url when --prefix-mix is on")
     ap.add_argument("--arrival-rate", type=float, default=50.0,
                     help="overload mode: mean requests/second issued")
     ap.add_argument("--priority-mix", default="interactive=0.2,standard=0.3,batch=0.5",
@@ -390,7 +508,10 @@ def main(argv: list[str] | None = None) -> dict:
         return result
 
     result = asyncio.run(run_load(
-        ns.url, ns.model, ns.concurrency, ns.requests, ns.isl, ns.osl, ns.warmup))
+        ns.url, ns.model, ns.concurrency, ns.requests, ns.isl, ns.osl,
+        ns.warmup, prefix_templates=ns.prefix_mix,
+        prefix_tokens=ns.prefix_tokens, zipf_s=ns.zipf,
+        metrics_urls=ns.metrics_url))
     result["chips"] = ns.chips
     result["output_tok_s_per_chip"] = round(result["output_tok_s"] / ns.chips, 2)
     _record_kv_dtype(result, ns.url, ns.kv_dtype)

@@ -45,6 +45,19 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="mirror ActiveSequences predictions across router replicas")
     p.add_argument("--use-approx", action="store_true",
                    help="ApproxKvIndexer for pools that publish no KV events")
+    p.add_argument("--global-prefix-cache", action="store_true",
+                   help="arbitrate route-vs-pull-vs-recompute against the "
+                        "prefix-cache cost model (workers must publish with "
+                        "--global-prefix-cache for pulls to hit)")
+    p.add_argument("--model", default="tiny-llama",
+                   help="model preset/path the cost model prices prefill for")
+    p.add_argument("--device-kind", default="tpu v5",
+                   help="worker accelerator kind for the cost model "
+                        "(obs/costmodel.py HW_SPECS key, e.g. 'tpu v5')")
+    p.add_argument("--kv-dtype", choices=["bfloat16", "int8", "int4"],
+                   default="bfloat16",
+                   help="workers' KV cache dtype — sets the wire bytes the "
+                        "arbiter charges per pulled block")
     return p.parse_args(argv)
 
 
@@ -52,6 +65,20 @@ async def amain(ns: argparse.Namespace) -> None:
     cfg = RuntimeConfig.from_settings(coordinator_url=ns.coordinator)
     rt = await DistributedRuntime.create(cfg)
     assert rt.client is not None
+
+    prefix_cost = None
+    if ns.global_prefix_cache:
+        from dynamo_tpu.kvbm.metrics import install_prefix_cache_metrics
+        from dynamo_tpu.models.config import resolve_model_config
+        from dynamo_tpu.obs.costmodel import hw_spec_for, prefix_cache_cost
+
+        install_prefix_cache_metrics(rt.metrics)  # route_decisions on /metrics
+        prefix_cost = prefix_cache_cost(
+            resolve_model_config(ns.model), hw_spec_for(ns.device_kind),
+            block_size=ns.block_size, kv_dtype=ns.kv_dtype)
+        log.info("prefix-cache arbitration on: break-even %.1f blocks "
+                 "(%s, %s, kv %s)", prefix_cost.break_even_blocks(),
+                 ns.model, ns.device_kind, ns.kv_dtype)
 
     target_client = await EndpointClient.create(rt, EndpointId.parse(ns.target))
     router = await KvPushRouter.create(target_client, KvRouterConfig(
@@ -61,6 +88,7 @@ async def amain(ns: argparse.Namespace) -> None:
         sync_replicas=ns.sync_replicas,
         use_approx_indexer=ns.use_approx,
         snapshot_interval_s=ns.snapshot_interval,
+        prefix_cost=prefix_cost,
     ))
 
     async def handler(payload: dict, ctx: RequestContext):

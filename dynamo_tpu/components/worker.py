@@ -90,6 +90,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--remote-kv-addr", default=None,
                    help="G4 remote block store host:port ('auto' = discover "
                         "via the coordinator)")
+    p.add_argument("--global-prefix-cache", action="store_true",
+                   help="fleet-wide prefix cache: publish committed prefix "
+                        "blocks to the G4 remote store so cold workers can "
+                        "import instead of recomputing (needs "
+                        "--remote-kv-addr)")
     # Disaggregated serving (reference: vllm decode-first pattern).
     p.add_argument("--disagg", choices=["none", "prefill", "decode"], default="none")
     p.add_argument("--prefill-endpoint", default="dyn://dynamo.prefill.generate",
@@ -236,6 +241,22 @@ async def amain(ns: argparse.Namespace) -> None:
         publisher.start()
     sink = publisher.sink if publisher else None
 
+    # Resolve the G4 remote store address once, for either engine kind.
+    remote_kv = ns.remote_kv_addr
+    if remote_kv == "auto":
+        from dynamo_tpu.kvbm.remote import discover_store
+
+        remote_kv = await discover_store(rt.client)
+        if remote_kv is None:
+            log.warning("--remote-kv-addr auto: no store advertised; "
+                        "continuing without a G4 tier")
+    if ns.host_kv_blocks or ns.disk_kv_path or remote_kv:
+        from dynamo_tpu.kvbm.metrics import install_prefix_cache_metrics
+
+        # KVBM tiers feed dynamo_prefix_cache_* (kvbm/metrics.py); re-home
+        # the singleton so /metrics exposes hit/import/publish counters.
+        install_prefix_cache_metrics(rt.metrics)
+
     follower_shards: list[dict] = []
     if ns.engine == "mocker":
         from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
@@ -246,6 +267,8 @@ async def amain(ns: argparse.Namespace) -> None:
             max_batch_size=ns.max_batch_size,
             max_model_len=ns.max_model_len,
             speedup_ratio=ns.speedup_ratio,
+            remote_kv_addr=remote_kv,
+            global_prefix_cache=ns.global_prefix_cache,
         ), event_sink=sink)
         stats_fn = engine.stats
     else:
@@ -257,14 +280,6 @@ async def amain(ns: argparse.Namespace) -> None:
         # singleton into the runtime registry so /metrics exposes it.
         install_perf_metrics(rt.metrics)
 
-        remote_kv = ns.remote_kv_addr
-        if remote_kv == "auto":
-            from dynamo_tpu.kvbm.remote import discover_store
-
-            remote_kv = await discover_store(rt.client)
-            if remote_kv is None:
-                log.warning("--remote-kv-addr auto: no store advertised; "
-                            "continuing without a G4 tier")
         # Engine construction (param init, cache alloc) blocks for seconds —
         # run off-loop so the lease keep-alive keeps ticking.
         loop = asyncio.get_running_loop()
@@ -288,6 +303,7 @@ async def amain(ns: argparse.Namespace) -> None:
             host_kv_blocks=ns.host_kv_blocks,
             disk_kv_path=ns.disk_kv_path,
             remote_kv_addr=remote_kv,
+            global_prefix_cache=ns.global_prefix_cache,
         ), event_sink=sink,
             op_sink=op_channel.broadcast if op_channel is not None else None))
         stats_fn = engine.stats

@@ -882,7 +882,11 @@ class EngineCore:
                 # multi-host onboard plans are voted down to the mesh-wide
                 # minimum (OffloadManager.vote_plans) instead of refused.
                 vote_plans=(jax.process_count() > 1
-                            and bool(engine_cfg.remote_kv_addr)))
+                            and bool(engine_cfg.remote_kv_addr)),
+                # Fleet-wide prefix cache: committed blocks publish to the
+                # shared G4 store as they form, not only on eviction.
+                publish_tier=(remote if engine_cfg.global_prefix_cache
+                              else None))
 
     def _guided_pieces(self) -> tuple[list[str], list[int]]:
         if self._guided_vocab is None:
@@ -1314,6 +1318,11 @@ class EngineCore:
                     seq, [int(x) for x in toks[i]], lps[i], outputs,
                     count_decode=(kind == "decode"))
         self._record_step(t0, pending)
+        if self.kvbm is not None and not self.sched.has_work():
+            # Engine going idle: this finalize's commits would otherwise sit
+            # in the publish-on-commit queue until the next step_begin —
+            # which may be a long time away on a drained worker.
+            self.kvbm.drain_publish()
         return outputs
 
     def _finalize_verify(self, rows, chunks, toks_dev, lps_dev,

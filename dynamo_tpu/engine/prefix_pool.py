@@ -37,6 +37,12 @@ class PrefixPool:
         # committed block's id is reused — the KVBM offload manager's
         # write-back point (dynamo_tpu.kvbm.offload).
         self.evict_hook: Callable[[int, int], None] | None = None
+        # Called as commit_hook(block_id, seq_hash, parent_hash) after a
+        # block's content hash registers — the KVBM publish-on-commit point
+        # (global prefix cache, dynamo_tpu.kvbm.offload). Fires only for
+        # the canonical (first) commit of a hash, so publishers never see
+        # duplicate-content blocks.
+        self.commit_hook: Callable[[int, int, "int | None"], None] | None = None
         # block 0 reserved (trash)
         self._free: list[int] = list(range(num_blocks - 1, 0, -1))
         self._refcount: dict[int, int] = {}
@@ -133,6 +139,8 @@ class PrefixPool:
             return
         self._by_hash[seq_hash] = bid
         self._hash_of[bid] = seq_hash
+        if self.commit_hook is not None:
+            self.commit_hook(bid, seq_hash, parent_hash)
         self._emit(BlockStored(block_hashes=(seq_hash,), parent_hash=parent_hash))
 
     def release(self, block_ids: list[int]) -> None:

@@ -17,6 +17,8 @@ priority queues + transfer managers). Two flows:
 
 from __future__ import annotations
 
+import time
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable
 
@@ -25,6 +27,7 @@ import numpy as np
 from dynamo_tpu import chaos
 from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
+from dynamo_tpu.kvbm.metrics import get_prefix_cache_metrics
 from dynamo_tpu.kvbm.transfer import BlockTransferEngine
 from dynamo_tpu.utils.logging import get_logger
 
@@ -92,10 +95,12 @@ def inject_and_commit(runner, pool: PrefixPool, transfer: BlockTransferEngine,
 class OffloadStats:
     offloaded_blocks: int = 0
     onboarded_blocks: int = 0
+    published_blocks: int = 0
 
     def to_dict(self) -> dict:
         return {"offloaded_blocks": self.offloaded_blocks,
-                "onboarded_blocks": self.onboarded_blocks}
+                "onboarded_blocks": self.onboarded_blocks,
+                "published_blocks": self.published_blocks}
 
 
 class OffloadManager:
@@ -106,8 +111,15 @@ class OffloadManager:
     donates its inputs, mirroring the engine step functions).
     """
 
+    #: device-extract budget for publish-on-commit per flush — bounds the
+    #: extra gather + remote puts a busy step pays; leftovers carry over.
+    PUBLISH_PER_FLUSH = 8
+    #: remembered published hashes (dedup window) — bounds memory, and a
+    #: redundant re-publish past the window is an idempotent put.
+    PUBLISH_MEMORY = 1 << 16
+
     def __init__(self, runner, pool: PrefixPool, tiers: list, transfer=None,
-                 vote_plans: bool = False):
+                 vote_plans: bool = False, publish_tier=None):
         assert tiers, "OffloadManager needs at least one tier"
         self.runner = runner
         self.pool = pool
@@ -123,9 +135,22 @@ class OffloadManager:
         # chain, so equal lengths ⇒ identical hash sets). Rank-local tiers
         # (G2 host / G3 disk) are deterministic and need no vote.
         self.vote_plans = vote_plans
+        # publish_tier: the global prefix cache's publish-on-commit target
+        # (the shared G4 remote store). Committed prefix blocks are pushed
+        # there PROACTIVELY — not only on LRU eviction — so other engines
+        # can import a hot shared prefix while it is still serving here.
+        # Publish decisions depend only on the commit stream and local
+        # bounded memory (never on shared-tier lookups), so multi-host
+        # ranks queue identical batches — no plan vote needed.
+        self.publish_tier = publish_tier
         self.stats = OffloadStats()
         self._pending: list[tuple[int, int]] = []  # (block_id, seq_hash)
+        self._publish_pending: list[tuple[int, int]] = []
+        self._published: OrderedDict[int, None] = OrderedDict()
+        self._onboarding = False
         pool.evict_hook = self._on_evict
+        if publish_tier is not None:
+            pool.commit_hook = self._on_commit
 
     # -- offload -----------------------------------------------------------
     def _on_evict(self, block_id: int, seq_hash: int) -> None:
@@ -140,29 +165,72 @@ class OffloadManager:
         identical content is idempotent; a rank-divergent device program is
         a hang."""
         top = self.tiers[0]
+        # A queued publish of this block is now stale: once evicted, the
+        # slot can be rewritten before the next flush extracts it, and a
+        # later extract would publish NEW content under the OLD hash. The
+        # eviction write-back below carries the content to the tier cascade
+        # instead.
+        if self._publish_pending:
+            self._publish_pending = [
+                (b, h) for b, h in self._publish_pending if b != block_id]
         if not getattr(top, "shared", False) and seq_hash in top:
             return
         self._pending.append((block_id, seq_hash))
 
+    def _on_commit(self, block_id: int, seq_hash: int,
+                   parent_hash: "int | None") -> None:
+        """PrefixPool commit hook: queue a newly committed block for
+        publish-on-commit. Imports are skipped (their content just came FROM
+        the tiers), as is anything inside the bounded already-published
+        window."""
+        if self._onboarding or seq_hash in self._published:
+            return
+        self._published[seq_hash] = None
+        while len(self._published) > self.PUBLISH_MEMORY:
+            self._published.popitem(last=False)
+        self._publish_pending.append((block_id, seq_hash))
+
     def flush_pending(self) -> int:
-        """Extract all queued evictions in one bucketed transfer and store
-        them in the top tier. Must run before the evicted slots are rewritten
-        (engine step / onboard inject); callers: EngineCore.step,
-        inject_and_commit."""
-        if not self._pending:
+        """Extract all queued evictions — plus this flush's publish-on-commit
+        batch — in one bucketed transfer; evictions store to the top tier,
+        published blocks push to the shared publish tier. Must run before the
+        evicted slots are rewritten (engine step / onboard inject); callers:
+        EngineCore.step, inject_and_commit."""
+        publish = self._publish_pending[: self.PUBLISH_PER_FLUSH]
+        self._publish_pending = self._publish_pending[self.PUBLISH_PER_FLUSH:]
+        if not self._pending and not publish:
             return 0
         # Chaos: an error here propagates into the engine step — the
         # offload cascade failing is engine-fatal, not silently droppable.
         chaos.inject("kvbm.offload", blocks=len(self._pending))
         pending, self._pending = self._pending, []
         blocks = self.transfer.extract(
-            self.runner.cache_k, self.runner.cache_v, [b for b, _ in pending]
+            self.runner.cache_k, self.runner.cache_v,
+            [b for b, _ in pending] + [b for b, _ in publish]
         )
         top = self.tiers[0]
         for (_, seq_hash), block in zip(pending, blocks):
             top.put(seq_hash, block)
+        for (_, seq_hash), block in zip(publish, blocks[len(pending):]):
+            # RemoteBlockPool.put degrades to a drop when the store is
+            # unreachable — publish is strictly best-effort.
+            self.publish_tier.put(seq_hash, block)
+        if publish:
+            self.stats.published_blocks += len(publish)
+            get_prefix_cache_metrics().published_blocks.inc(len(publish))
         self.stats.offloaded_blocks += len(pending)
         return len(pending)
+
+    def drain_publish(self) -> int:
+        """Flush the whole publish-on-commit queue (budgeted slices until
+        empty). Called when the engine goes idle — the final finalize's
+        commits would otherwise sit queued until the next step_begin."""
+        total = 0
+        while self._publish_pending:
+            before = len(self._publish_pending)
+            self.flush_pending()
+            total += before - len(self._publish_pending)
+        return total
 
     # -- onboard -----------------------------------------------------------
     def _lookup(self, seq_hash: int) -> np.ndarray | None:
@@ -179,14 +247,24 @@ class OffloadManager:
         The allocation inside may evict inactive device blocks → reentrant
         ``_on_evict`` (safe: the evicted blocks are disjoint from the ones
         being loaded, and tier ``get`` returned copies)."""
+        t0 = time.perf_counter()
         plan = plan_onboard(self.pool, seq_hashes, self._lookup)
         if self.vote_plans:
             from dynamo_tpu.parallel.multihost import vote_min
 
             plan = plan[: vote_min(len(plan))]
-        n = inject_and_commit(self.runner, self.pool, self.transfer, plan,
-                              flush=self.flush_pending)
+        self._onboarding = True  # imported commits must not re-publish
+        try:
+            n = inject_and_commit(self.runner, self.pool, self.transfer, plan,
+                                  flush=self.flush_pending)
+        finally:
+            self._onboarding = False
         self.stats.onboarded_blocks += n
+        if seq_hashes:
+            get_prefix_cache_metrics().record_onboard(
+                found_blocks=len(plan), imported_blocks=n,
+                block_size=self.pool.block_size,
+                seconds=time.perf_counter() - t0)
         return n
 
     def snapshot(self) -> dict:

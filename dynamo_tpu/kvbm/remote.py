@@ -35,12 +35,13 @@ import threading
 import time
 from collections import OrderedDict
 
+import jax.numpy as jnp
 import msgpack
 import numpy as np
 
 from dynamo_tpu import chaos
 from dynamo_tpu.engine.cache import KVCacheSpec
-from dynamo_tpu.kvbm.pools import TierStats, block_dtype, block_shape
+from dynamo_tpu.kvbm.pools import TierStats
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("kvbm.remote")
@@ -151,8 +152,16 @@ class RemoteBlockServer:
 # ---------------------------------------------------------------------------
 
 def tier_namespace(spec: KVCacheSpec, fingerprint: str = "") -> str:
-    """Same identity recipe as the disk tier's MANIFEST."""
-    return f"{fingerprint}|{block_shape(spec)}|{spec.dtype}|{spec.kv_dtype}"
+    """Model identity + LOGICAL block geometry — deliberately *without* the
+    storage dtype. Blocks on the wire are self-describing by byte length
+    (float [2,L,BS,KH,D] vs the packed int8/int4 flat layouts — see
+    kvbm/transfer.py), so engines running the same model at different
+    kv_dtypes share one namespace: a bf16 engine can onboard a block an
+    int8 engine published, with the conversion at the ``get`` boundary.
+    (The disk tier's MANIFEST still pins the full storage layout — that
+    directory holds raw native-format bytes for one engine only.)"""
+    return (f"{fingerprint}|{spec.num_layers}x{spec.block_size}"
+            f"x{spec.num_kv_heads}x{spec.head_dim}")
 
 
 class RemoteBlockPool:
@@ -188,10 +197,27 @@ class RemoteBlockPool:
         self._broken_until = 0.0
         self._last_len = 0
         self.stats = TierStats()
-        self._dtype = block_dtype(spec)
+        # Byte-length → stored format, for the self-describing wire blocks
+        # (cross-dtype namespace sharing, see tier_namespace). Packed kinds
+        # first; float payloads at an ambiguous itemsize resolve to the
+        # spec's own dtype (listed first).
+        L, bs, kh, d = (spec.num_layers, spec.block_size,
+                        spec.num_kv_heads, spec.head_dim)
+        elems = 2 * L * bs * kh * d
+        scales = 2 * L * kh * 4
+        self._formats: dict[int, str] = {}
+        self._formats[elems + scales] = "int8"
+        self._formats[elems // 2 + scales] = "int4"
+        for fdt in (str(spec.dtype), "bfloat16", "float32"):
+            nbytes = elems * np.dtype(jnp.dtype(fdt)).itemsize
+            self._formats.setdefault(nbytes, fdt)
 
     # -- wire -------------------------------------------------------------
     def _connect(self) -> socket.socket:
+        # Chaos: a connect-time fault (delay models DCN congestion; an
+        # injected ConnectionError a refused/partitioned store) exercises
+        # the degrade-to-recompute path separately from per-op faults.
+        chaos.inject("kvbm.remote.connect", addr=self._addr[0])
         s = socket.create_connection(self._addr, timeout=self._timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
@@ -256,13 +282,25 @@ class RemoteBlockPool:
         data = resp.get("data") if resp else None
         if data is None:
             return None
-        want = int(np.prod(block_shape(self.spec))) * self._dtype.itemsize
-        if len(data) != want:  # geometry mismatch can't happen within a ns; guard anyway
-            log.warning("remote block %x has %d bytes, want %d", seq_hash,
-                        len(data), want)
+        fmt = self._formats.get(len(data))
+        if fmt is None:  # unknown geometry/format — treat as a miss
+            log.warning("remote block %x has %d bytes, matching no known "
+                        "format for %s", seq_hash, len(data), self._ns)
             return None
         self.stats.hits += 1
-        return np.frombuffer(data, self._dtype).reshape(block_shape(self.spec))
+        if fmt in ("int8", "int4"):
+            block = np.frombuffer(data, np.uint8)
+        else:
+            spec = self.spec
+            block = np.frombuffer(data, np.dtype(jnp.dtype(fmt))).reshape(
+                2, spec.num_layers, spec.block_size, spec.num_kv_heads,
+                spec.head_dim)
+        # Convert to this engine's native format here, so downstream
+        # consumers (onboard plans, host-tier puts) always see homogeneous
+        # blocks regardless of which engine published them.
+        from dynamo_tpu.kvbm.transfer import ensure_block_format
+
+        return ensure_block_format(block, spec=self.spec)
 
     def __contains__(self, seq_hash: int) -> bool:
         resp = self._call({"op": "has", "ns": self._ns, "h": seq_hash})

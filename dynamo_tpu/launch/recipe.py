@@ -72,10 +72,15 @@ def _engine_args(engine: dict[str, Any]) -> list[str]:
         "decodeWindow": "--decode-window", "hostKvBlocks": "--host-kv-blocks",
         "diskKvPath": "--disk-kv-path", "remoteKvAddr": "--remote-kv-addr",
     }
+    # Boolean switches: present-and-truthy emits the bare flag.
+    switches = {"globalPrefixCache": "--global-prefix-cache"}
     out: list[str] = []
     for key, flag in flags.items():
         if key in engine:
             out += [flag, str(engine[key])]
+    for key, flag in switches.items():
+        if engine.get(key):
+            out.append(flag)
     return out
 
 

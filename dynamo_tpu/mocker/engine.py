@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import time
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Callable
 
@@ -46,6 +47,13 @@ class MockEngineArgs:
     speedup_ratio: float = 10.0     # divide all times by this
     enable_prefix_caching: bool = True
     watermark: float = 0.01
+    # Fleet-wide prefix cache mirror (device-free): a real RemoteBlockPool
+    # against the shared G4 store, carrying tiny stand-in payloads — block
+    # ACCOUNTING and the publish/import policy are exercised exactly like
+    # the JAX engine's (publish-on-commit, admission-time import shrinking
+    # simulated prefill), without any device transfer.
+    remote_kv_addr: str | None = None
+    global_prefix_cache: bool = False
 
 
 @dataclass
@@ -96,6 +104,32 @@ class MockEngine:
         self.prefix_lookups = 0
         self.steps = 0
         self.deadline_cancelled = 0
+        # Fleet-wide prefix cache mirror: a REAL RemoteBlockPool client (so
+        # mocker fleets exercise the wire protocol, breaker, and chaos
+        # points) over a deliberately tiny KV geometry — the payload is a
+        # stand-in; only the hash-keyed accounting matters here.
+        self.remote = None
+        self._payload = None
+        self._importing = False
+        self.imported_blocks = 0
+        self.published_blocks = 0
+        if self.args.remote_kv_addr:
+            import numpy as np
+
+            from dynamo_tpu.engine.cache import KVCacheSpec
+            from dynamo_tpu.kvbm.remote import RemoteBlockPool
+
+            spec = KVCacheSpec(
+                num_blocks=self.args.num_blocks,
+                block_size=self.args.block_size,
+                num_layers=1, num_kv_heads=1, head_dim=2,
+                dtype="float32", kv_dtype="float32")
+            self.remote = RemoteBlockPool(
+                spec, self.args.remote_kv_addr, fingerprint="mocker")
+            self._payload = np.ones(
+                (2, 1, self.args.block_size, 1, 2), dtype=np.float32)
+            if self.args.global_prefix_cache:
+                self.pool.commit_hook = self._on_commit
 
     def start(self) -> None:
         if self._task is None:
@@ -125,6 +159,59 @@ class MockEngine:
         if sp.name == "engine.decode" and seq.trace_tokens:
             attrs.setdefault("tokens", seq.trace_tokens)
         get_tracer().end_span(sp, status=status, **attrs)
+
+    def _on_commit(self, block_id: int, seq_hash: int,
+                   parent_hash: int | None) -> None:
+        """Publish-on-commit mirror (kvbm/offload.py _on_commit →
+        flush_pending): every canonical first commit pushes its stand-in
+        payload to the shared store, best-effort."""
+        if self._importing:
+            return  # imported blocks' content just came FROM the store
+        self.remote.put(seq_hash, self._payload)
+        self.published_blocks += 1
+        from dynamo_tpu.kvbm.metrics import get_prefix_cache_metrics
+
+        get_prefix_cache_metrics().published_blocks.inc(1)
+
+    def _import_remote(self, chain: list[int],
+                       matched: list[int]) -> list[int]:
+        """Admission-time mirror of OffloadManager.onboard: walk the prompt
+        chain past the locally matched prefix, committing contiguous remote
+        hits as matchable blocks (so ``cached_blocks`` grows and the
+        simulated prefill shrinks — the mocker's recompute-avoided tokens).
+        Returns the imported block ids, which join the request's matched
+        set."""
+        if self.remote is None or not chain:
+            return []
+        from dynamo_tpu.kvbm.metrics import get_prefix_cache_metrics
+
+        t0 = time.perf_counter()
+        plan: list[tuple[int, "int | None"]] = []
+        parent = chain[len(matched) - 1] if matched else None
+        for h in chain[len(matched):]:
+            if self.remote.get(h) is None:
+                break  # contiguity gap: later blocks are unmatchable
+            plan.append((h, parent))
+            parent = h
+        found = len(plan)
+        ids: list[int] = []
+        if plan:
+            try:
+                ids = self.pool.allocate(len(plan))
+            except NoFreeBlocks:
+                plan = []
+        self._importing = True
+        try:
+            for bid, (h, par) in zip(ids, plan):
+                self.pool.commit(bid, h, par)
+        finally:
+            self._importing = False
+        self.imported_blocks += len(ids)
+        get_prefix_cache_metrics().record_onboard(
+            found_blocks=found, imported_blocks=len(ids),
+            block_size=self.args.block_size,
+            seconds=time.perf_counter() - t0)
+        return ids
 
     def _token_for(self, rid: str, i: int) -> int:
         digest = hashlib.md5(f"{rid}:{i}".encode()).digest()
@@ -194,6 +281,7 @@ class MockEngine:
                 hashes = seq.block_seq.sequence_hashes()
                 matchable = max((len(seq.req.token_ids) - 1) // a.block_size, 0)
                 matched = self.pool.match_prefix(hashes[:matchable])
+                matched += self._import_remote(hashes[:matchable], matched)
                 need = -(-len(seq.req.token_ids) // a.block_size) - len(matched)
                 try:
                     fresh = self.pool.allocate(max(need, 0))
@@ -318,6 +406,8 @@ class MockEngine:
             "prefix_hit_rate": self.prefix_hits / max(self.prefix_lookups, 1),
             "num_steps": self.steps,
             "deadline_cancelled": self.deadline_cancelled,
+            "prefix_cache_imported_blocks": self.imported_blocks,
+            "prefix_cache_published_blocks": self.published_blocks,
         }
 
     async def clear_kv(self) -> None:

@@ -139,7 +139,8 @@ def load_library() -> ctypes.CDLL | None:
             ctypes.c_void_p, ctypes.c_uint64, u64p, ctypes.c_size_t]
         lib.dyn_indexer_remove_worker.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.dyn_indexer_find_matches.argtypes = [
-            ctypes.c_void_p, u64p, ctypes.c_size_t, u64p, u32p, ctypes.c_size_t]
+            ctypes.c_void_p, u64p, ctypes.c_size_t, u64p, u32p,
+            ctypes.c_size_t, u32p]
         lib.dyn_indexer_find_matches.restype = ctypes.c_size_t
         lib.dyn_indexer_block_count.argtypes = [ctypes.c_void_p]
         lib.dyn_indexer_block_count.restype = ctypes.c_size_t
@@ -215,10 +216,13 @@ class NativeRadixIndexer:
         cap = 4096  # routing fleets are tens of workers; 4096 is a hard roof
         workers = (ctypes.c_uint64 * cap)()
         scores = (ctypes.c_uint32 * cap)()
+        chain = ctypes.c_uint32(0)
         n = self._lib.dyn_indexer_find_matches(
-            self._ptr, _arr(seq_hashes), len(seq_hashes), workers, scores, cap)
+            self._ptr, _arr(seq_hashes), len(seq_hashes), workers, scores,
+            cap, ctypes.byref(chain))
         for i in range(n):
             out.scores[workers[i]] = scores[i]
+        out.chain_depth = chain.value
         return out
 
     def dump_events(self) -> list:

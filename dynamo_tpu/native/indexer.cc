@@ -119,19 +119,25 @@ void dyn_indexer_remove_worker(void* p, uint64_t worker) {
 
 // Walk the request's hash chain; out_workers/out_scores receive one entry
 // per worker that held any prefix (score = contiguous depth). Returns the
-// number of entries written (bounded by max_out).
+// number of entries written (bounded by max_out). out_chain_depth receives
+// the depth reached by ANY worker — the fleet-wide availability ceiling
+// the route-vs-pull arbiter prices pulls against (router/arbiter.py);
+// the walk keeps going for it after per-worker contiguity breaks.
 size_t dyn_indexer_find_matches(void* p, const uint64_t* hashes, size_t n,
                                 uint64_t* out_workers, uint32_t* out_scores,
-                                size_t max_out) {
+                                size_t max_out, uint32_t* out_chain_depth) {
     auto* idx = static_cast<Indexer*>(p);
     // `active` = workers still contiguous at the current depth; workers
     // that drop out keep the depth they reached (already recorded).
     std::vector<uint64_t> active;
     std::unordered_map<uint64_t, uint32_t> scores;
+    uint32_t chain = 0;
     bool first = true;
     for (size_t depth = 1; depth <= n; depth++) {
         auto it = idx->nodes.find(hashes[depth - 1]);
         if (it == idx->nodes.end() || it->second.workers.empty()) break;
+        chain = static_cast<uint32_t>(depth);
+        if (!first && active.empty()) continue;  // chain-depth walk only
         if (first) {
             active = it->second.workers;
             first = false;
@@ -140,11 +146,11 @@ size_t dyn_indexer_find_matches(void* p, const uint64_t* hashes, size_t n,
             next.reserve(active.size());
             for (uint64_t w : active)
                 if (it->second.holds(w)) next.push_back(w);
-            if (next.empty()) break;
-            active.swap(next);
+            active.swap(next);  // may empty: per-worker scoring is done
         }
         for (uint64_t w : active) scores[w] = static_cast<uint32_t>(depth);
     }
+    if (out_chain_depth) *out_chain_depth = chain;
     size_t i = 0;
     for (const auto& [w, s] : scores) {
         if (i >= max_out) break;

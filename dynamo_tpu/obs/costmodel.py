@@ -58,6 +58,9 @@ __all__ = [
     "mfu",
     "bw_util",
     "roofline_fraction",
+    "PrefixCacheCost",
+    "kv_block_wire_bytes",
+    "prefix_cache_cost",
 ]
 
 
@@ -458,6 +461,120 @@ def predicted_decode_perf(
         "mfu_at_roofline": round(mfu(cost.flops, step_s, hw), 4),
         "bw_util_at_roofline": round(bw_util(cost.hbm_bytes, step_s, hw), 4),
     }
+
+
+# ---------------------------------------------------------------------------
+# Fleet-wide prefix cache: route-vs-pull break-even
+# ---------------------------------------------------------------------------
+
+#: Effective per-stream DCN bandwidth for pod-to-pod KV block pulls. One TCP
+#: stream over the data-center network sustains far less than the NIC line
+#: rate; this is the conservative planning number the router arbitrates
+#: against (overridable per deployment via KvRouterConfig).
+DCN_BYTES_PER_S = 12.5e9
+
+#: Achieved MFU assumed for recompute-prefill when converting FLOPs to
+#: seconds. Prefill runs compute-bound near the roofline on real batches;
+#: 0.4 matches the scoreboard's achieved numbers rather than the peak.
+PREFILL_MFU = 0.4
+
+
+def kv_block_wire_bytes(*, num_layers: int, block_size: int,
+                        num_kv_heads: int, head_dim: int,
+                        kv_dtype: str = "bfloat16") -> float:
+    """Bytes one KV block occupies on the wire in kvbm's host format
+    (kvbm/transfer.py): K and V payload at the cache itemsize, plus the
+    per-(layer, kv-head) f32 scale sidecar for quantized caches — the same
+    accounting paged_attention_cost charges for the HBM stream."""
+    elems = 2.0 * num_layers * block_size * num_kv_heads * head_dim
+    nbytes = elems * _kv_itemsize(kv_dtype)
+    if kv_dtype in ("int8", "int4"):
+        nbytes += 2.0 * num_layers * num_kv_heads * 4
+    return nbytes
+
+
+@dataclass(frozen=True)
+class PrefixCacheCost:
+    """Route-vs-pull arbiter inputs for the fleet-wide prefix cache.
+
+    Two ways to satisfy a shared prefix on a worker that doesn't hold it:
+
+    * **recompute** — run prefill over the prefix tokens:
+      ``tokens · flops_per_token / (peak_flops · prefill_mfu)`` seconds;
+    * **pull** — fetch the packed KV blocks from the remote tier:
+      ``overhead + blocks · wire_bytes_per_block / dcn_bytes_per_s``.
+
+    Everything is plain floats so the router can arbitrate without a model
+    runtime; build one from a ModelConfig with :func:`prefix_cache_cost`.
+    """
+
+    flops_per_token: float
+    wire_bytes_per_block: float
+    block_size: int
+    peak_flops: float
+    prefill_mfu: float = PREFILL_MFU
+    dcn_bytes_per_s: float = DCN_BYTES_PER_S
+    #: fixed per-import cost: remote-tier RTTs + the device scatter dispatch.
+    import_overhead_s: float = 2e-3
+
+    @property
+    def seconds_per_token(self) -> float:
+        eff = self.peak_flops * self.prefill_mfu
+        return self.flops_per_token / eff if eff > 0 else 0.0
+
+    def recompute_seconds(self, tokens: float) -> float:
+        return max(tokens, 0.0) * self.seconds_per_token
+
+    def pull_seconds(self, blocks: int) -> float:
+        if blocks <= 0:
+            return 0.0
+        return (self.import_overhead_s
+                + blocks * self.wire_bytes_per_block
+                / max(self.dcn_bytes_per_s, 1.0))
+
+    def break_even_blocks(self) -> float:
+        """Prefix depth (blocks) above which pulling beats recomputing on an
+        otherwise idle worker — the docs/PERF.md formula:
+        ``pull_s(n) < recompute_s(n · bs)``."""
+        per_block_pull = self.wire_bytes_per_block / max(self.dcn_bytes_per_s, 1.0)
+        per_block_recompute = self.block_size * self.seconds_per_token
+        gain = per_block_recompute - per_block_pull
+        if gain <= 0:
+            return float("inf")
+        return self.import_overhead_s / gain
+
+
+def prefix_cache_cost(
+    cfg: ModelConfig,
+    hw: HardwareSpec,
+    *,
+    block_size: int,
+    kv_dtype: str = "bfloat16",
+    quantization: str = "none",
+    rep_prefix_tokens: int = 1024,
+    dcn_bytes_per_s: float = DCN_BYTES_PER_S,
+    prefill_mfu: float = PREFILL_MFU,
+) -> PrefixCacheCost:
+    """Linearized PrefixCacheCost for a model/device pair. Per-token prefill
+    FLOPs are taken at a representative shared-prefix length (the attention
+    term grows with context, so this slightly undercharges very long
+    prefixes — i.e. the arbiter errs toward recompute, the safe side)."""
+    n = max(rep_prefix_tokens, block_size)
+    phases = prefill_cost(cfg, batch=1, chunk=n, kv_len=n,
+                          block_size=block_size, kv_dtype=kv_dtype,
+                          quantization=quantization)
+    flops_per_token = total_cost(phases).flops / n
+    return PrefixCacheCost(
+        flops_per_token=flops_per_token,
+        wire_bytes_per_block=kv_block_wire_bytes(
+            num_layers=cfg.num_layers, block_size=block_size,
+            num_kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
+            kv_dtype=kv_dtype),
+        block_size=block_size,
+        peak_flops=hw.peak_flops,
+        prefill_mfu=prefill_mfu,
+        dcn_bytes_per_s=dcn_bytes_per_s,
+    )
 
 
 def mfu(flops: float, wall_s: float, hw: HardwareSpec) -> float:

@@ -31,6 +31,13 @@ class OverlapScores:
 
     scores: dict[WorkerId, int] = field(default_factory=dict)
     total_blocks: int = 0  # blocks in the query
+    # Contiguous leading chain blocks resident on ANY worker — longer than
+    # any single worker's score when the chain is split across the fleet.
+    # This is the route-vs-pull arbiter's pull ceiling: with the global
+    # prefix cache on, publish-on-commit mirrors every committed block into
+    # the shared remote store, so "some worker holds it" ⇒ "a cold worker
+    # can import it" (router/arbiter.py).
+    chain_depth: int = 0
 
     def best(self) -> int:
         return max(self.scores.values(), default=0)
@@ -94,12 +101,17 @@ class RadixIndexer:
             node = self._nodes.get(h)
             if node is None or not node.workers:
                 break
+            out.chain_depth = depth  # the chain exists SOMEWHERE up to here
+            if active is not None and not active:
+                continue  # per-worker contiguity already broken fleet-wide
             holders = node.workers if active is None else (active & node.workers)
-            if not holders:
-                break  # workers that dropped out keep their previous depth
+            if holders:
+                for w in holders:
+                    out.scores[w] = depth
+            # Workers that dropped out keep their previous depth; the walk
+            # continues for chain_depth even when no single worker holds
+            # the whole prefix.
             active = holders
-            for w in holders:
-                out.scores[w] = depth
         return out
 
     # ------------------------------------------------------------------
@@ -144,6 +156,7 @@ class ApproxKvIndexer:
             holders = {w for w, exp in self._entries.get(h, {}).items() if exp > now}
             if not holders:
                 break
+            out.chain_depth = depth
             for w in holders:
                 out.scores[w] = depth
         return out

@@ -16,7 +16,10 @@ from typing import Any, AsyncIterator
 
 import msgpack
 
+from dynamo_tpu.kvbm.metrics import get_prefix_cache_metrics
+from dynamo_tpu.obs.costmodel import PrefixCacheCost
 from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.router.arbiter import RouteDecision, arbitrate
 from dynamo_tpu.router.events import RouterEvent
 from dynamo_tpu.router.indexer import ApproxKvIndexer, WorkerId
 from dynamo_tpu.router.publisher import kv_events_subject, load_metrics_subject
@@ -49,6 +52,13 @@ class KvRouterConfig:
     # replica loads it before consuming live events, so its first routing
     # decision already sees the fleet's caches. 0 disables dumping.
     snapshot_interval_s: float = 5.0
+    # Fleet-wide prefix cache arbitration (router/arbiter.py): when set —
+    # the workers run with --global-prefix-cache, so published blocks are
+    # importable from the shared store — routing prices route-to-warm vs
+    # pull-to-cold vs plain recompute against this roofline cost model
+    # instead of the heuristic overlap/load scheduler. None = classic
+    # scheduling.
+    prefix_cost: "PrefixCacheCost | None" = None
 
 
 class KvRouter:
@@ -68,6 +78,9 @@ class KvRouter:
         ))
         self.active = ActiveSequences()
         self.worker_metrics: dict[WorkerId, dict] = {}
+        # The prefix-cache arbiter's most recent verdict (observability;
+        # only written when config.prefix_cost is set).
+        self.last_decision: RouteDecision | None = None
 
     # ------------------------------------------------------------------
     def apply_events(self, events: list[RouterEvent]) -> None:
@@ -112,8 +125,19 @@ class KvRouter:
                 total_blocks=int(m.get("kv_total_blocks", 1) or 1),
                 num_waiting=int(m.get("num_waiting", 0)),
             )
-        wid = self.scheduler.schedule(total_blocks, overlaps, loads)
-        overlap = overlaps.scores.get(wid, 0)
+        if self.config.prefix_cost is not None:
+            dec = arbitrate(total_blocks, overlaps, loads,
+                            self.config.prefix_cost)
+            get_prefix_cache_metrics().route_decisions.inc(action=dec.action)
+            self.last_decision = dec
+            wid, overlap = dec.worker_id, dec.overlap_blocks
+            log.debug("prefix-cache arbiter: %s -> worker %x (%s, overlap %d,"
+                      " pull %d, %.4fs predicted)", request_id, wid,
+                      dec.action, overlap, dec.pull_blocks,
+                      dec.predicted_seconds)
+        else:
+            wid = self.scheduler.schedule(total_blocks, overlaps, loads)
+            overlap = overlaps.scores.get(wid, 0)
         self.active.add_request(request_id, wid, total_blocks - overlap, overlap)
         if self.config.use_approx_indexer:
             self.approx.note_routed(hashes, wid)

@@ -80,10 +80,18 @@ class HealthCheckConfig:
 
 
 class _CanaryContext:
-    """Minimal RequestContext stand-in for canary calls."""
+    """Minimal RequestContext stand-in for canary calls. Mirrors the QoS
+    surface handlers touch (worker handlers stamp ``deadline_ts`` and poll
+    ``is_expired``) so a canary replay can't AttributeError a healthy
+    worker into NotReady."""
+
+    deadline_ts: float | None = None
+
+    def is_expired(self) -> bool:
+        return self.deadline_ts is not None and time.time() >= self.deadline_ts
 
     def is_cancelled(self) -> bool:
-        return False
+        return self.is_expired()
 
 
 class EndpointHealthMonitor:

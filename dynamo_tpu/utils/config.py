@@ -133,6 +133,12 @@ class EngineConfig:
     # G4 remote block store ("host:port" of a RemoteBlockServer); chained
     # after host/disk in the offload cascade.
     remote_kv_addr: str | None = None
+    # Fleet-wide prefix cache: publish committed prefix blocks to the G4
+    # remote store PROACTIVELY (publish-on-commit, kvbm/offload.py) so a
+    # cold worker can import a shared prefix another worker computed
+    # instead of recomputing it. Requires remote_kv_addr; the import side
+    # (admission-time onboard) is always on when tiers exist.
+    global_prefix_cache: bool = False
     # N-gram speculative decoding (engine/spec.py): 0 = off; n>0 proposes
     # continuations of the trailing n-gram, verified k at a time in one
     # forward pass. Greedy-exact; mutually exclusive with decode_window>1.

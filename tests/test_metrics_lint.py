@@ -43,3 +43,20 @@ def test_lint_accepts_clean_module(tmp_path: Path):
             reg.histogram("lat_seconds", help_="latency", buckets=(0.1,))
     """))
     assert lint_tree(tmp_path) == []
+
+
+def test_prefix_cache_drift_detected(tmp_path: Path):
+    """Bidirectional drift on the prefix-cache family: a registration the
+    declaration doesn't know about AND every declared-but-unregistered name
+    must each produce a violation."""
+    (tmp_path / "kvbm").mkdir()
+    (tmp_path / "kvbm" / "metrics.py").write_text(textwrap.dedent("""
+        def bind(reg):
+            reg.counter("prefix_cache_lookups", "onboard lookups")
+            reg.counter("prefix_cache_surprise", "undeclared registration")
+    """))
+    problems = lint_tree(tmp_path)
+    assert any("prefix_cache_surprise" in p and "PREFIX_CACHE_METRICS" in p
+               for p in problems)
+    assert any("prefix_cache_hits" in p and "does not register" in p
+               for p in problems)

@@ -1,0 +1,336 @@
+"""Fleet-wide prefix cache: route-vs-pull-vs-recompute arbitration
+(router/arbiter.py) with hand-computed break-evens, fleet-wide chain depth
+in the indexers, publish-on-commit → cold-engine import e2e, cross-dtype
+imports through the shared store, chaos degradation to recompute, and the
+mocker's device-free mirror of the same policy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from dynamo_tpu import chaos
+from dynamo_tpu.engine.cache import KVCacheSpec
+from dynamo_tpu.engine.engine import EngineCore
+from dynamo_tpu.kvbm.metrics import get_prefix_cache_metrics
+from dynamo_tpu.kvbm.remote import RemoteBlockPool, tier_namespace
+from dynamo_tpu.kvbm.transfer import dequantize_block, quantize_block
+from dynamo_tpu.obs.costmodel import PrefixCacheCost
+from dynamo_tpu.router.arbiter import arbitrate
+from dynamo_tpu.router.indexer import ApproxKvIndexer, OverlapScores, RadixIndexer
+from dynamo_tpu.router.scheduler import WorkerLoad
+
+from tests.test_engine import make_req, run_to_completion, tiny_config
+from tests.test_kvbm_remote import StoreFixture
+from tests.test_router import stored
+
+
+@pytest.fixture()
+def store():
+    s = StoreFixture()
+    yield s
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# Arbiter: hand-computed break-evens
+# ---------------------------------------------------------------------------
+
+# Unit-friendly numbers: seconds_per_token = 1 s, so recomputing one block
+# costs block_size = 4 s; pulling one block costs 1 s + a 2 s fixed setup.
+COST = PrefixCacheCost(
+    flops_per_token=1.0, wire_bytes_per_block=1.0, block_size=4,
+    peak_flops=1.0, prefill_mfu=1.0, dcn_bytes_per_s=1.0,
+    import_overhead_s=2.0)
+
+
+def idle(*worker_ids, **active):
+    return {w: WorkerLoad(worker_id=w, active_blocks=active.get(f"w{w}", 0),
+                          total_blocks=100) for w in worker_ids}
+
+
+def test_arbiter_pull_wins_on_cold_fleet_with_published_chain():
+    # Nobody holds the prefix locally, but the whole 10-block chain is in
+    # the shared store (chain_depth). Pull = 2 + 10·1 = 12 s; recompute =
+    # 10·4 = 40 s.
+    ov = OverlapScores(scores={}, total_blocks=10, chain_depth=10)
+    dec = arbitrate(10, ov, idle(1, 2), COST)
+    assert dec.action == "pull"
+    assert dec.pull_blocks == 10
+    assert dec.predicted_seconds == pytest.approx(12.0)
+    assert dec.overlap_blocks == 0
+
+
+def test_arbiter_route_wins_when_holder_queue_is_cheap():
+    # Worker 1 holds 8/10 blocks but has 1 active block queued
+    # (queue = 1·4·1 = 4 s). Route = 4 + 2·4 = 12 s beats
+    # pull-to-idle-2 = (2 + 8·1) + 2·4 = 18 s and recompute = 40 s.
+    ov = OverlapScores(scores={1: 8}, total_blocks=10, chain_depth=8)
+    dec = arbitrate(10, ov, idle(1, 2, w1=1), COST)
+    assert dec.action == "route"
+    assert dec.worker_id == 1
+    assert dec.overlap_blocks == 8
+    assert dec.pull_blocks == 0
+    assert dec.predicted_seconds == pytest.approx(12.0)
+
+
+def test_arbiter_recompute_wins_below_break_even():
+    # A 100 s import overhead makes any pull a loss; route and recompute
+    # then tie at 2·4 = 8 s and the least-data-movement precedence picks
+    # recompute.
+    cost = dataclasses.replace(COST, import_overhead_s=100.0)
+    ov = OverlapScores(scores={}, total_blocks=2, chain_depth=2)
+    dec = arbitrate(2, ov, idle(1, 2), cost)
+    assert dec.action == "recompute"
+    assert dec.pull_blocks == 0
+    assert dec.predicted_seconds == pytest.approx(8.0)
+
+
+def test_arbiter_flips_exactly_at_break_even():
+    # Per-block gain = 4 − 1 = 3 s, overhead 7 s → break-even 7/3 blocks.
+    cost = dataclasses.replace(COST, import_overhead_s=7.0)
+    assert cost.break_even_blocks() == pytest.approx(7.0 / 3.0)
+    # 2 blocks (< 7/3): recompute 8 s beats pull 7 + 2 = 9 s.
+    ov2 = OverlapScores(scores={}, total_blocks=2, chain_depth=2)
+    assert arbitrate(2, ov2, idle(1), cost).action == "recompute"
+    # 3 blocks (> 7/3): pull 7 + 3 = 10 s beats recompute 12 s.
+    ov3 = OverlapScores(scores={}, total_blocks=3, chain_depth=3)
+    dec = arbitrate(3, ov3, idle(1), cost)
+    assert dec.action == "pull" and dec.pull_blocks == 3
+
+
+def test_arbiter_pull_only_covers_the_published_chain():
+    # 10-block prompt but only 6 published: pull imports 6 and recomputes
+    # the 4-block tail — (2 + 6) + 4·4 = 24 s, still beating 40 s.
+    ov = OverlapScores(scores={}, total_blocks=10, chain_depth=6)
+    dec = arbitrate(10, ov, idle(1), COST)
+    assert dec.action == "pull"
+    assert dec.pull_blocks == 6
+    assert dec.predicted_seconds == pytest.approx(24.0)
+
+
+def test_arbiter_rejects_empty_fleet():
+    with pytest.raises(ValueError):
+        arbitrate(1, OverlapScores(), {}, COST)
+
+
+# ---------------------------------------------------------------------------
+# Indexers: fleet-wide chain depth (the pull ceiling)
+# ---------------------------------------------------------------------------
+
+def test_radix_chain_depth_spans_workers():
+    idx = RadixIndexer()
+    h = [100, 101, 102, 103]
+    idx.apply_event(stored(1, h[:2]))   # worker 1 holds the head...
+    idx.apply_event(stored(2, h[2:]))   # ...worker 2 the tail
+    s = idx.find_matches(h)
+    assert s.scores == {1: 2}           # no single worker past block 2
+    assert s.chain_depth == 4           # but the chain exists fleet-wide
+    # A gap in the chain stops the ceiling even if later blocks exist.
+    s = idx.find_matches([h[0], h[1], 999, h[3]])
+    assert s.chain_depth == 2
+
+
+def test_radix_chain_depth_single_worker_matches_score():
+    idx = RadixIndexer()
+    h = [7, 8, 9]
+    idx.apply_event(stored(1, h))
+    s = idx.find_matches(h)
+    assert s.scores[1] == 3 and s.chain_depth == 3
+
+
+def test_approx_chain_depth_spans_workers():
+    idx = ApproxKvIndexer(ttl_s=60.0)
+    h = [5, 6, 7]
+    idx.note_routed(h[:1], worker_id=1, now=0.0)
+    idx.note_routed(h[1:], worker_id=2, now=0.0)
+    s = idx.find_matches(h, now=1.0)
+    assert s.chain_depth == 3
+    assert s.scores == {1: 1, 2: 3}
+
+
+def test_native_chain_depth_parity():
+    from dynamo_tpu.native import NativeRadixIndexer, load_library
+
+    if load_library() is None:
+        pytest.skip("native toolchain unavailable")
+    h = [40, 41, 42, 43]
+    py, cc = RadixIndexer(), NativeRadixIndexer()
+    for idx in (py, cc):
+        idx.apply_event(stored(1, h[:2]))
+        idx.apply_event(stored(2, h[2:]))
+    sp, sc = py.find_matches(h), cc.find_matches(h)
+    assert sc.scores == sp.scores
+    assert sc.chain_depth == sp.chain_depth == 4
+
+
+# ---------------------------------------------------------------------------
+# Engine e2e: publish-on-commit → cold import
+# ---------------------------------------------------------------------------
+
+def test_publish_on_commit_feeds_cold_engine(store):
+    """Engine A publishes its committed prefix WITHOUT eviction churn;
+    cold engine B imports it at admission, skips the prefill, and still
+    produces the identical greedy continuation."""
+    prompt = list(range(500, 524))
+    a = EngineCore(tiny_config(remote_kv_addr=store.addr,
+                               global_prefix_cache=True))
+    first, _ = run_to_completion(a, [make_req(prompt=prompt, max_tokens=6,
+                                              rid="a")])
+    # Publish-on-commit pushed the prompt's full blocks proactively — no
+    # filler requests forced eviction here.
+    assert a.kvbm is not None and a.kvbm.stats.offloaded_blocks == 0
+    assert store.server.stats.stores >= 6   # 24-token prompt @ block_size 4
+
+    m = get_prefix_cache_metrics()
+    avoided0 = m.recompute_avoided_tokens.get()
+    hits0 = m.hits.get()
+
+    b = EngineCore(tiny_config(remote_kv_addr=store.addr,
+                               global_prefix_cache=True))
+    second, _ = run_to_completion(b, [make_req(prompt=prompt, max_tokens=6,
+                                               rid="b")])
+    assert b.kvbm is not None and b.kvbm.stats.onboarded_blocks > 0
+    assert m.recompute_avoided_tokens.get() > avoided0
+    assert m.hits.get() > hits0
+    assert second["b"] == first["a"]
+
+
+def test_cross_dtype_engine_import_via_store(store):
+    """An int8 publisher and a bf16 importer share one namespace: the
+    importer dequantizes at the wire boundary and serves from the imported
+    prefix (same contract as test_export_import_across_kv_dtypes, through
+    the remote store instead of a direct export plan)."""
+    prompt = list(range(800, 824))
+    pub = EngineCore(tiny_config(kv_dtype="int8", remote_kv_addr=store.addr,
+                                 global_prefix_cache=True))
+    run_to_completion(pub, [make_req(prompt=prompt, max_tokens=1, rid="p")])
+    assert store.server.stats.stores > 0
+
+    imp = EngineCore(tiny_config(remote_kv_addr=store.addr,
+                                 global_prefix_cache=True))
+    out, _ = run_to_completion(imp, [make_req(prompt=prompt, max_tokens=6,
+                                              rid="i")])
+    assert imp.kvbm is not None and imp.kvbm.stats.onboarded_blocks > 0
+    assert len(out["i"]) == 6
+
+
+# ---------------------------------------------------------------------------
+# Cross-dtype wire payloads at bench geometry (kh=8, d=128)
+# ---------------------------------------------------------------------------
+
+_GEOM = dict(num_blocks=4, block_size=4, num_layers=2, num_kv_heads=8,
+             head_dim=128)
+_BF16 = KVCacheSpec(**_GEOM, dtype="bfloat16", kv_dtype="bfloat16")
+_SHAPE = (2, 4, 8, 128)  # (L, BS, KH, D)
+
+
+def _float_block(seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((2, *_SHAPE)).astype(np.float32)
+
+
+@pytest.mark.parametrize("qdtype,qmax", [("int8", 127.0), ("int4", 7.0)])
+def test_cross_dtype_store_roundtrip_within_quant_tolerance(store, qdtype, qmax):
+    quant = KVCacheSpec(**_GEOM, dtype="bfloat16", kv_dtype=qdtype)
+    # Geometry-only namespace: the quantized and float pools interoperate.
+    assert tier_namespace(quant, "m") == tier_namespace(_BF16, "m")
+
+    block = _float_block(11)
+    # Quantization error ≤ scale/2 per element with scale = amax/qmax per
+    # (k-or-v, layer, head); bf16 re-rounding adds ~2^-8 relative. A
+    # tolerance of amax/qmax covers both with margin while still failing on
+    # any scale/packing mix-up.
+    tol = float(np.abs(block).max()) / qmax
+
+    # packed publisher → float importer: get() dequantizes to bf16.
+    pub = RemoteBlockPool(quant, store.addr, fingerprint="m")
+    pub.put(1, quantize_block(block, qdtype))
+    imp = RemoteBlockPool(_BF16, store.addr, fingerprint="m")
+    got = imp.get(1)
+    assert got is not None and got.ndim == 5
+    np.testing.assert_allclose(np.asarray(got, np.float32), block, atol=tol)
+
+    # float publisher → packed importer: get() re-quantizes to the native
+    # packed kind; dequantizing recovers the payload within tolerance.
+    imp.put(2, np.asarray(block, imp.get(1).dtype))
+    back = pub.get(2)
+    assert back is not None and back.ndim == 1 and back.dtype == np.uint8
+    np.testing.assert_allclose(
+        dequantize_block(back, _SHAPE, np.float32), block, atol=2 * tol)
+
+
+# ---------------------------------------------------------------------------
+# Chaos: import degrades to recompute, never a wrong answer or leaked pin
+# ---------------------------------------------------------------------------
+
+def test_chaos_remote_faults_degrade_to_recompute(store, chaos_seed):
+    prompt = list(range(700, 724))
+    baseline = EngineCore(tiny_config())
+    want, _ = run_to_completion(
+        baseline, [make_req(prompt=prompt, max_tokens=6, rid="ref")])
+
+    # Populate the store from a healthy publisher first.
+    pub = EngineCore(tiny_config(remote_kv_addr=store.addr,
+                                 global_prefix_cache=True))
+    run_to_completion(pub, [make_req(prompt=prompt, max_tokens=6, rid="p")])
+    assert store.server.stats.stores > 0
+
+    # Every remote op and connect now fails: the cold engine must fall
+    # back to recomputing the whole prefill.
+    chaos.configure({"seed": chaos_seed, "rules": [
+        {"point": "kvbm.remote", "kind": "error", "rate": 1.0},
+        {"point": "kvbm.remote.connect", "kind": "error", "rate": 1.0},
+    ]})
+    cold = EngineCore(tiny_config(remote_kv_addr=store.addr,
+                                  global_prefix_cache=True))
+    out, finished = run_to_completion(
+        cold, [make_req(prompt=prompt, max_tokens=6, rid="c")])
+    assert finished == {"c"}
+    assert out["c"] == want["ref"]          # degraded, never wrong
+    assert cold.kvbm is not None and cold.kvbm.stats.onboarded_blocks == 0
+    # No leaked pins: after the request finishes, every device block is
+    # back on the free list or parked reusable in the inactive pool.
+    assert cold.pool.num_free == cold.pool.num_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# Mocker fleet mirror (device-free, real wire client)
+# ---------------------------------------------------------------------------
+
+async def test_mocker_fleet_cold_import(store):
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
+    from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+
+    args = dict(num_blocks=64, block_size=4, vocab_size=128,
+                speedup_ratio=1000.0, remote_kv_addr=store.addr,
+                global_prefix_cache=True)
+    a, b = MockEngine(MockEngineArgs(**args)), MockEngine(MockEngineArgs(**args))
+    prompt = list(range(1, 25))
+
+    async def run(eng, rid):
+        req = PreprocessedRequest(token_ids=list(prompt), request_id=rid,
+                                  stop_conditions=StopConditions(max_tokens=4))
+        outs = [o async for o in eng.generate(req)]
+        assert outs[-1].finish_reason is not None
+        return outs
+
+    m = get_prefix_cache_metrics()
+    avoided0 = m.recompute_avoided_tokens.get()
+    try:
+        await run(a, "a")
+        assert a.published_blocks >= 6      # publish-on-commit, no churn
+        assert store.server.stats.stores >= 6
+        await run(b, "b")
+        # B never computed the prefix: the imported blocks joined its
+        # matched set, shrinking the simulated prefill.
+        assert b.imported_blocks > 0
+        assert b.prefix_hits > 0
+        assert b.stats()["prefix_cache_imported_blocks"] == b.imported_blocks
+        assert m.recompute_avoided_tokens.get() > avoided0
+    finally:
+        await a.stop()
+        await b.stop()

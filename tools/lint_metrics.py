@@ -76,6 +76,19 @@ PERF_METRIC_LABELS = {
     "engine_perf_tokens_per_second": ("kind", "kv_dtype"),
 }
 
+# The fleet-wide prefix cache family (kvbm/metrics.py PrefixCacheMetrics):
+# onboard outcomes + route-vs-pull arbiter decisions. Same bidirectional
+# drift rule as KV_TRANSFER_METRICS.
+PREFIX_CACHE_METRICS = (
+    "prefix_cache_lookups",
+    "prefix_cache_hits",
+    "prefix_cache_imported_blocks",
+    "prefix_cache_recompute_avoided_tokens",
+    "prefix_cache_import_seconds",
+    "prefix_cache_published_blocks",
+    "prefix_cache_route_decisions",
+)
+
 # The failure-recovery family: health canaries (runtime/health.py),
 # migration re-dispatch (frontend/migration.py), and chaos injection
 # (chaos/metrics.py). Same bidirectional drift rule as KV_TRANSFER_METRICS:
@@ -206,6 +219,23 @@ def _lint_kv_transfer_metrics(root: Path, problems: list[str]) -> None:
             "does not register it")
 
 
+def _lint_prefix_cache_metrics(root: Path, problems: list[str]) -> None:
+    """The prefix-cache family must match what kvbm/metrics.py actually
+    registers — same no-silent-drift rule as KV_TRANSFER_METRICS."""
+    actual = _registered_names(root / "kvbm" / "metrics.py")
+    if actual is None:
+        return
+    declared = set(PREFIX_CACHE_METRICS)
+    for key in sorted(actual - declared):
+        problems.append(
+            f"kvbm/metrics.py registers {key!r} but it is missing from "
+            "tools/lint_metrics.py PREFIX_CACHE_METRICS")
+    for key in sorted(declared - actual):
+        problems.append(
+            f"PREFIX_CACHE_METRICS declares {key!r} but kvbm/metrics.py "
+            "does not register it")
+
+
 def _lint_perf_metrics(root: Path, problems: list[str]) -> None:
     """The dynamo_engine_perf_* family must match what obs/profiler.py
     actually registers — same no-silent-drift rule as KV_TRANSFER_METRICS."""
@@ -323,6 +353,7 @@ def lint_tree(root: Path | None = None) -> list[str]:
         _lint_module(path, problems)
     _lint_provider_metrics(root, problems)
     _lint_kv_transfer_metrics(root, problems)
+    _lint_prefix_cache_metrics(root, problems)
     _lint_perf_metrics(root, problems)
     _lint_perf_labels(root, problems)
     _lint_recovery_metrics(root, problems)
