@@ -18,6 +18,12 @@ Prints ONE JSON object to stdout; ``--out`` additionally writes it to a file.
 Usage:
     python -m benchmarks.loadgen --url http://127.0.0.1:8000 \
         --model tiny-llama --concurrency 8 --requests 32 --isl 128 --osl 32
+
+``--mode session`` runs multi-turn chatbot conversations instead: N sessions
+of K turns (``--sessions``, ``--turns``, ``--think-time``), each turn
+re-sending the full history under a stable ``x-session-id`` so an engine
+with session KV retention prefills only the new suffix; the summary splits
+TTFT by turn and folds ``dynamo_session_*`` across the scraped workers.
 """
 
 from __future__ import annotations
@@ -105,7 +111,7 @@ def percentile(values: list[float], p: float) -> float:
 
 class RequestResult:
     __slots__ = ("ok", "ttft_s", "itl_s", "output_tokens", "latency_s", "error",
-                 "status", "retry_after", "priority")
+                 "status", "retry_after", "priority", "text")
 
     def __init__(self) -> None:
         self.ok = False
@@ -117,6 +123,8 @@ class RequestResult:
         self.status = 0
         self.retry_after = None  # Retry-After header value, if any
         self.priority = ""
+        self.text = ""  # concatenated content deltas (session mode feeds
+        #                 the reply back into the next turn's prompt)
 
 
 async def one_request(session: aiohttp.ClientSession, url: str, model: str,
@@ -125,7 +133,8 @@ async def one_request(session: aiohttp.ClientSession, url: str, model: str,
                       priority: str | None = None,
                       deadline_ms: float | None = None,
                       client_id: str | None = None,
-                      prompt: str | None = None) -> RequestResult:
+                      prompt: str | None = None,
+                      session_id: str | None = None) -> RequestResult:
     res = RequestResult()
     res.priority = priority or ""
     headers = {}
@@ -135,6 +144,8 @@ async def one_request(session: aiohttp.ClientSession, url: str, model: str,
         headers["x-deadline-ms"] = str(deadline_ms)
     if client_id is not None:
         headers["x-client-id"] = client_id
+    if session_id is not None:
+        headers["x-session-id"] = session_id
     body = {
         "model": model,
         "messages": [{"role": "user", "content": prompt if prompt is not None
@@ -178,6 +189,7 @@ async def one_request(session: aiohttp.ClientSession, url: str, model: str,
                     continue
                 delta = (chunk.get("choices") or [{}])[0].get("delta", {})
                 if delta.get("content"):
+                    res.text += delta["content"]
                     now = time.perf_counter()
                     if res.output_tokens == 0:
                         res.ttft_s = now - t0
@@ -196,11 +208,13 @@ async def one_request(session: aiohttp.ClientSession, url: str, model: str,
     return res
 
 
-async def scrape_prefix_cache(urls: list[str]) -> "dict[str, float] | None":
-    """Sum dynamo_prefix_cache_* samples across the given /metrics endpoints
-    (frontend and/or per-worker status servers). Labelled series (the
-    route_decisions counter) and histogram _sum/_count lines fold into
-    their base sample name. None when nothing was reachable."""
+async def scrape_metrics(urls: list[str],
+                         prefix: str) -> "dict[str, float] | None":
+    """Sum ``prefix``* samples across the given /metrics endpoints (frontend
+    and/or per-worker status servers). Labelled series and histogram
+    _sum/_count lines fold into their base sample name — which is exactly
+    the fleet-wide view a multi-worker run needs. None when nothing was
+    reachable."""
     acc: dict[str, float] = {}
     seen = False
     for u in urls:
@@ -217,7 +231,7 @@ async def scrape_prefix_cache(urls: list[str]) -> "dict[str, float] | None":
         except Exception:
             continue
         for line in text.splitlines():
-            if not line.startswith("dynamo_prefix_cache_"):
+            if not line.startswith(prefix):
                 continue
             name = line.split("{")[0].split(" ")[0]
             try:
@@ -227,6 +241,10 @@ async def scrape_prefix_cache(urls: list[str]) -> "dict[str, float] | None":
             acc[name] = acc.get(name, 0.0) + value
             seen = True
     return acc if seen else None
+
+
+async def scrape_prefix_cache(urls: list[str]) -> "dict[str, float] | None":
+    return await scrape_metrics(urls, "dynamo_prefix_cache_")
 
 
 async def run_load(url: str, model: str, concurrency: int, num_requests: int,
@@ -318,6 +336,103 @@ async def run_load(url: str, model: str, concurrency: int, num_requests: int,
     if prefix_summary is not None:
         out["prefix_cache"] = prefix_summary
     return out
+
+
+async def run_sessions(url: str, model: str, sessions: int, turns: int,
+                       isl: int, osl: int, think_time: float,
+                       concurrency: int,
+                       metrics_urls: "list[str] | None" = None) -> dict:
+    """Chatbot-session mode: ``sessions`` concurrent conversations of
+    ``turns`` turns each, under stable ``x-session-id`` headers. Every turn
+    re-sends the full history — the previous prompt plus the model's ACTUAL
+    streamed reply — with a fresh user suffix appended, which is exactly the
+    workload session KV retention targets: turn N+1's history is
+    byte-identical to turn N's context, so a retaining engine prefills only
+    the suffix. The summary splits TTFT by first turn vs later turns (the
+    user-visible win) and folds the fleet's ``dynamo_session_*`` series
+    across every scraped /metrics endpoint."""
+    sem = asyncio.Semaphore(max(concurrency, 1))
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=30)
+    async with aiohttp.ClientSession(timeout=timeout) as http:
+        cpt = await calibrate(http, url, model)
+        scrape_urls = metrics_urls or [url]
+        before = await scrape_metrics(scrape_urls, "dynamo_session_")
+
+        async def one_session(sid: int) -> list[tuple[int, RequestResult]]:
+            rng = random.Random(987_000 + sid)
+            content = make_prompt(isl, 987_000 + sid, cpt)
+            out: list[tuple[int, RequestResult]] = []
+            for t in range(turns):
+                async with sem:
+                    res = await one_request(
+                        http, url, model, isl, osl, 0, cpt,
+                        client_id=f"loadgen-sess-{sid}",
+                        prompt=content, session_id=f"loadgen-sess-{sid}")
+                out.append((t, res))
+                if not res.ok:
+                    break  # a dead conversation has no history to extend
+                follow = " ".join(rng.choice(WORDS) for _ in range(8))
+                content = f"{content} {res.text} {follow}"
+                if think_time > 0 and t + 1 < turns:
+                    await asyncio.sleep(think_time)
+            return out
+
+        t_start = time.perf_counter()
+        per_session = await asyncio.gather(
+            *(one_session(sid) for sid in range(sessions)))
+        wall = time.perf_counter() - t_start
+        after = await scrape_metrics(scrape_urls, "dynamo_session_")
+
+    flat = [(t, r) for sess in per_session for (t, r) in sess]
+    good = [(t, r) for t, r in flat if r.ok]
+    first = [r.ttft_s for t, r in good if t == 0]
+    later = [r.ttft_s for t, r in good if t > 0]
+    itls = [x for _, r in good for x in r.itl_s]
+    total_tokens = sum(r.output_tokens for _, r in good)
+
+    session_summary: dict = {"scraped": False}
+    if before is not None and after is not None:
+        def delta(metric: str) -> float:
+            k = f"dynamo_session_{metric}"
+            return after.get(k, 0.0) - before.get(k, 0.0)
+        lookups = delta("lookups")
+        session_summary = {
+            "scraped": True,
+            "lookups": int(lookups),
+            "hits": int(delta("hits")),
+            "hit_rate": round(delta("hits") / lookups, 4) if lookups else 0.0,
+            "avoided_tokens": int(delta("avoided_tokens")),
+            "expired": int(delta("expired")),
+            "demoted_blocks": int(delta("demoted_blocks")),
+            # Gauges are live state, not rates: the post-run value is the
+            # interesting one (how much KV the fleet is still holding).
+            "active": int(after.get("dynamo_session_active", 0.0)),
+            "retained_blocks": int(
+                after.get("dynamo_session_retained_blocks", 0.0)),
+        }
+
+    t1_p50 = percentile(first, 50)
+    tn_p50 = percentile(later, 50)
+    return {
+        "mode": "session",
+        "sessions": sessions,
+        "turns": turns,
+        "think_time_s": think_time,
+        "requests": len(flat),
+        "failed": len(flat) - len(good),
+        "errors": sorted({r.error for _, r in flat if not r.ok})[:5],
+        "isl": isl,
+        "osl": osl,
+        "wall_s": round(wall, 3),
+        "output_tok_s": round(total_tokens / wall, 2) if wall > 0 else 0.0,
+        "ttft_turn1_p50_s": round(t1_p50, 4),
+        "ttft_turn2plus_p50_s": round(tn_p50, 4),
+        # < 1.0 means later turns beat the cold first turn even though their
+        # prompts are strictly longer — retention is doing its job.
+        "ttft_turn2plus_over_turn1": round(tn_p50 / t1_p50, 3) if t1_p50 else 0.0,
+        "itl_p50_s": round(percentile(itls, 50), 5),
+        "session": session_summary,
+    }
 
 
 def _parse_mix(spec: str) -> list[tuple[str, float]]:
@@ -448,9 +563,12 @@ def main(argv: list[str] | None = None) -> dict:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", default="http://127.0.0.1:8000")
     ap.add_argument("--model", default="tiny-llama")
-    ap.add_argument("--mode", choices=["closed", "overload"], default="closed",
+    ap.add_argument("--mode", choices=["closed", "overload", "session"],
+                    default="closed",
                     help="closed: fixed-concurrency loop; overload: open-loop "
-                         "Poisson arrivals past capacity (QoS shedding demo)")
+                         "Poisson arrivals past capacity (QoS shedding demo); "
+                         "session: multi-turn chatbot conversations with "
+                         "stable x-session-id (session KV retention demo)")
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--isl", type=int, default=128)
@@ -472,6 +590,14 @@ def main(argv: list[str] | None = None) -> dict:
                          "endpoint before and after the run (repeatable — "
                          "point at each worker's status server); defaults "
                          "to --url when --prefix-mix is on")
+    ap.add_argument("--sessions", type=int, default=8,
+                    help="session mode: concurrent conversations")
+    ap.add_argument("--turns", type=int, default=4,
+                    help="session mode: turns per conversation (each turn "
+                         "re-sends the full history plus a fresh suffix)")
+    ap.add_argument("--think-time", type=float, default=0.0,
+                    help="session mode: seconds a user \"thinks\" between "
+                         "turns (lets session TTLs and demotion engage)")
     ap.add_argument("--arrival-rate", type=float, default=50.0,
                     help="overload mode: mean requests/second issued")
     ap.add_argument("--priority-mix", default="interactive=0.2,standard=0.3,batch=0.5",
@@ -493,6 +619,23 @@ def main(argv: list[str] | None = None) -> dict:
                          "trace JSON from the frontend flight recorder) and "
                          "write it here; analyse with tools/trace_report.py")
     ns = ap.parse_args(argv)
+
+    if ns.mode == "session":
+        result = asyncio.run(run_sessions(
+            ns.url, ns.model, ns.sessions, ns.turns, ns.isl, ns.osl,
+            ns.think_time, ns.concurrency, metrics_urls=ns.metrics_url))
+        result["chips"] = ns.chips
+        _record_kv_dtype(result, ns.url, ns.kv_dtype)
+        print(json.dumps(result))
+        if ns.out:
+            with open(ns.out, "w") as f:
+                json.dump(result, f, indent=2)
+        if ns.trace_out:
+            asyncio.run(fetch_traces(ns.url, ns.trace_out))
+        if result["failed"]:
+            print(f"loadgen: {result['failed']} failed requests: "
+                  f"{result['errors']}", file=sys.stderr)
+        return result
 
     if ns.mode == "overload":
         result = asyncio.run(run_overload(

@@ -72,6 +72,16 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "head scales, in-kernel dequant; halves KV bytes so "
                         "auto-sizing fits ~2x the blocks; int4: packed "
                         "nibbles, quarter bytes / ~4x blocks, even head_dim)")
+    p.add_argument("--session-ttl", type=float, default=0.0,
+                   help="session-sticky KV retention: seconds a finished "
+                        "session's committed blocks stay pinned so the next "
+                        "turn prefills only the suffix (0 = off)")
+    p.add_argument("--no-session-tiers", action="store_true",
+                   help="skip staging expired session KV down the KVBM tier "
+                        "ladder before unpinning")
+    p.add_argument("--ring-prefill-threshold", type=int, default=0,
+                   help="sp>1 only: min prompt tokens for ring prefill "
+                        "(0 = cost-model break-even, -1 = never)")
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--speedup-ratio", type=float, default=10.0, help="mocker only")
     p.add_argument("--no-kv-events", action="store_true")
@@ -256,6 +266,16 @@ async def amain(ns: argparse.Namespace) -> None:
         # KVBM tiers feed dynamo_prefix_cache_* (kvbm/metrics.py); re-home
         # the singleton so /metrics exposes hit/import/publish counters.
         install_prefix_cache_metrics(rt.metrics)
+    if ns.session_ttl > 0:
+        from dynamo_tpu.engine.session import install_session_metrics
+
+        # Session retention feeds dynamo_session_* (engine/session.py).
+        install_session_metrics(rt.metrics)
+    if ns.sp > 1:
+        from dynamo_tpu.obs.ring_prefill import install_ring_prefill_metrics
+
+        # Ring-vs-chunked arbitration feeds dynamo_ring_prefill_*.
+        install_ring_prefill_metrics(rt.metrics)
 
     follower_shards: list[dict] = []
     if ns.engine == "mocker":
@@ -269,6 +289,7 @@ async def amain(ns: argparse.Namespace) -> None:
             speedup_ratio=ns.speedup_ratio,
             remote_kv_addr=remote_kv,
             global_prefix_cache=ns.global_prefix_cache,
+            session_ttl=ns.session_ttl,
         ), event_sink=sink)
         stats_fn = engine.stats
     else:
@@ -304,6 +325,9 @@ async def amain(ns: argparse.Namespace) -> None:
             disk_kv_path=ns.disk_kv_path,
             remote_kv_addr=remote_kv,
             global_prefix_cache=ns.global_prefix_cache,
+            session_ttl=ns.session_ttl,
+            session_tiers=not ns.no_session_tiers,
+            ring_prefill_threshold=ns.ring_prefill_threshold,
         ), event_sink=sink,
             op_sink=op_channel.broadcast if op_channel is not None else None))
         stats_fn = engine.stats

@@ -42,6 +42,7 @@ from dynamo_tpu.engine.sampling import (
     sample,
 )
 from dynamo_tpu.engine.scheduler import Phase, PrefillWork, Scheduler, Seq, StepPlan
+from dynamo_tpu.engine.session import SessionStore, get_session_metrics
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.config import ModelConfig, resolve_model_config
 from dynamo_tpu.obs.profiler import StepPerfProfiler, phase as _perf_phase
@@ -225,6 +226,38 @@ class ModelRunner:
                 "num_kv_heads=%d does not divide tp=%d: pallas attention will "
                 "fall back to the dense gather path", cfg.num_kv_heads,
                 mesh.shape["model"])
+        # Context-parallel ring prefill gate (ops/ring_attention.py promoted
+        # to a serving mode): None = ring off (sp=1 mesh, or the knob set to
+        # -1); otherwise the minimum prompt tokens before a fresh
+        # full-prompt batch rides the seq-sharded ring path. 0 = auto — the
+        # cost model's ring-vs-chunked break-even for this model on this
+        # device (obs/costmodel.py).
+        self.ring_threshold: int | None = None
+        sp = mesh.shape.get("seq", 1) if mesh is not None else 1
+        if sp > 1 and engine_cfg.ring_prefill_threshold >= 0:
+            if engine_cfg.ring_prefill_threshold > 0:
+                self.ring_threshold = engine_cfg.ring_prefill_threshold
+            else:
+                from dynamo_tpu.obs.costmodel import (
+                    hw_spec_for,
+                    ring_prefill_break_even_tokens,
+                )
+
+                self.ring_threshold = ring_prefill_break_even_tokens(
+                    cfg, hw_spec_for(jax.devices()[0].device_kind), sp=sp,
+                    chunk=engine_cfg.prefill_chunk,
+                    block_size=engine_cfg.block_size,
+                    kv_dtype=engine_cfg.kv_dtype,
+                    quantization=engine_cfg.quantization,
+                    max_tokens=engine_cfg.max_model_len)
+            from dynamo_tpu.obs.ring_prefill import get_ring_prefill_metrics
+
+            get_ring_prefill_metrics().threshold_tokens.set(
+                float(self.ring_threshold))
+            log.info("ring prefill engaged: sp=%d threshold=%d tokens%s",
+                     sp, self.ring_threshold,
+                     "" if engine_cfg.ring_prefill_threshold
+                     else " (cost-model auto)")
 
     def _place(self, x):
         """Replicate onto the mesh (global array) or leave as-is off-mesh."""
@@ -461,13 +494,31 @@ class ModelRunner:
             for s, start, length in rows)
         nblk = min(_pow2_bucket(max(nblk_need, 1), 4, self.max_nblk), self.max_nblk)
         # Sequence-parallel prefill: a batch of fresh full-prompt chunks
-        # (every row starts at 0) on a seq>1 mesh rides ring attention.
-        sp_prefill = (
+        # (every row starts at 0) on a seq>1 mesh rides ring attention —
+        # but only past the ring-vs-chunked threshold (explicit knob or
+        # cost-model break-even, resolved in __init__). Shorter prompts
+        # take the dense path: identical program to an sp=1 engine, so
+        # staying below threshold costs zero extra ops.
+        sp_capable = (
             t > 1
             and self.mesh is not None
             and self.mesh.shape.get("seq", 1) > 1
             and all(start == 0 for _, start, _ in rows)
         )
+        sp_prefill = (
+            sp_capable
+            and self.ring_threshold is not None
+            and t_max >= self.ring_threshold
+        )
+        if t > 1 and self.ring_threshold is not None:
+            from dynamo_tpu.obs.ring_prefill import get_ring_prefill_metrics
+
+            rpm = get_ring_prefill_metrics()
+            if sp_prefill:
+                rpm.invocations.inc()
+                rpm.tokens.inc(sum(length for _, _, length in rows))
+            else:
+                rpm.bypassed.inc()
 
         masked = masks is not None and any(m is not None for m in masks)
         tokens = np.zeros((b, t), np.int32)
@@ -721,7 +772,7 @@ class EngineCore:
         params=None,
         event_sink: Callable[[KvCacheEvent], None] | None = None,
     ):
-        if engine_cfg.sp > 1 and (
+        if engine_cfg.sp > 1 and engine_cfg.ring_prefill_threshold >= 0 and (
             engine_cfg.prefill_chunk < engine_cfg.max_model_len
             or engine_cfg.max_tokens_per_step < engine_cfg.max_model_len
         ):
@@ -799,6 +850,15 @@ class EngineCore:
             spec_lookahead=(engine_cfg.spec_k if engine_cfg.spec_ngram > 0
                             else 0),
         )
+        # Session-sticky KV retention (engine/session.py): finished streams
+        # carrying a session.id keep their committed blocks pinned so the
+        # next turn prefills only the suffix. Needs prefix caching — the
+        # retained chain is claimed through the normal admission-time
+        # match_prefix, which is also how avoided tokens get MEASURED.
+        self.sessions: SessionStore | None = None
+        if engine_cfg.session_ttl > 0 and engine_cfg.enable_prefix_caching:
+            self.sessions = SessionStore(self.pool,
+                                         ttl=engine_cfg.session_ttl)
         self.metrics = EngineMetrics(
             kv_cache_bytes=(self.runner.spec.bytes_per_block()
                             * self.runner.spec.num_blocks),
@@ -966,6 +1026,17 @@ class EngineCore:
                 "engine.queue", ctx=seq.trace_ctx,
                 request_id=req.request_id, model=req.model,
                 prompt_tokens=seq.prompt_len, priority=seq.qos_priority)
+        if self.sessions is not None and seq.session_id is not None:
+            # Turn N+1 of a retained session: release the store's pins so
+            # the chain parks in the matchable inactive pool; this seq's
+            # admission-time match_prefix re-references it an instant later
+            # (single-threaded core — nothing allocates in between). The
+            # avoided-token count is MEASURED from that match in step_begin,
+            # not taken from the entry.
+            sm = get_session_metrics()
+            sm.lookups.inc()
+            if self.sessions.claim(seq.session_id, self._step_now) is not None:
+                sm.hits.inc()
         if self.kvbm is not None:
             # Same matchable cap as the scheduler: leave ≥1 prompt token to
             # compute so decode has last-position state. Onboarding is an
@@ -1027,6 +1098,8 @@ class EngineCore:
         to build step N+1, and a finished/stopped stream costs at most one
         speculative row, discarded at finalize.
         """
+        if self.sessions is not None:
+            self._session_sweep()
         plan = self.sched.plan()
         if self.kvbm is not None:
             # Write back blocks evicted during planning before their slots
@@ -1038,6 +1111,18 @@ class EngineCore:
             return None
         self.metrics.num_steps += 1
         self._trace_plan(plan)
+        if self.sessions is not None:
+            # Avoided-token accounting: the blocks a session turn did NOT
+            # recompute are exactly its admission-time prefix hit — a
+            # measured quantity, counted once per seq on its first planned
+            # chunk.
+            for w in plan.prefill:
+                seq = w.seq
+                if seq.session_id is not None and not seq.session_counted:
+                    seq.session_counted = True
+                    if seq.prefix_hit_blocks:
+                        get_session_metrics().avoided_tokens.inc(
+                            seq.prefix_hit_blocks * seq.block_size)
 
         for seq in [w.seq for w in plan.prefill] + plan.decode:
             if not seq.slot_initialized and seq.slot >= 0:
@@ -1272,6 +1357,13 @@ class EngineCore:
         )
         if reason is not None:
             out.finish_reason = reason
+            if (self.sessions is not None and seq.session_id is not None
+                    and reason in (FinishReason.STOP, FinishReason.LENGTH)):
+                # Retain BEFORE sched.finish releases the seq's refs: the
+                # session pin increfs the committed chain while it is still
+                # active, so there is no instant where turn N's KV is
+                # evictable. Cancelled/errored streams never retain.
+                self._retain_session(seq)
             self._trace_finish(seq, reason)
             self.sched.finish(seq, reason)
             self.metrics.num_requests_finished += 1
@@ -1385,6 +1477,62 @@ class EngineCore:
             self._trace_finish(seq, FinishReason.CANCELLED)
             outs[seq.request_id] = LLMEngineOutput(finish_reason=FinishReason.CANCELLED)
         return outs
+
+    # -- session-sticky KV retention (engine/session.py) ----------------
+    def _retain_session(self, seq: Seq) -> None:
+        """Pin a finishing stream's committed chain under its session id."""
+        hashes = seq.block_seq.sequence_hashes()[: seq.committed_blocks]
+        self.sessions.retain(seq.session_id, hashes, self._step_now)
+        # Capacity cap: LRU sessions demote (not just drop) so a later turn
+        # can still re-import from the KVBM ladder.
+        while len(self.sessions) > self.sessions.max_sessions:
+            popped = self.sessions.pop_oldest()
+            if popped is None:  # pragma: no cover - len()>0 guarantees one
+                break
+            self._demote_session(*popped)
+
+    def _session_sweep(self) -> None:
+        """TTL + pool-pressure valve, run before each plan().
+
+        TTL expiry uses the leader-stamped step clock, so multi-host ranks
+        release the same sessions on the same step. The pressure valve
+        mirrors the admission watermark: while the head-of-line waiting seq
+        cannot admit because session pins hold the pool, release the oldest
+        sessions first — retained turns must never starve live traffic.
+        """
+        for sid, entry in self.sessions.pop_expired(self._step_now):
+            self._demote_session(sid, entry)
+        sched = self.sched
+        while len(self.sessions) and sched.waiting:
+            head = sched.waiting[0]
+            need = head.blocks_needed(len(head.tokens))
+            if need + len(sched.running) <= self.pool.num_free:
+                break
+            if need + len(sched.running) > (self.pool.num_free
+                                            + self.sessions.pinned_blocks):
+                break  # releasing every pin still wouldn't admit; keep them
+            popped = self.sessions.pop_oldest()
+            if popped is None:  # pragma: no cover - len() checked above
+                break
+            self._demote_session(*popped)
+
+    def _demote_session(self, session_id: str, entry) -> None:
+        """Release a retained entry's pins, first write-staging the chain
+        down the KVBM tier ladder (host→disk→remote) when session_tiers is
+        on — so a post-eviction turn re-imports instead of recomputing."""
+        sm = get_session_metrics()
+        sm.expired.inc()
+        if (self.engine_cfg.session_tiers and self.kvbm is not None
+                and entry.pinned):
+            try:
+                staged = self.kvbm.stage_blocks(
+                    list(zip(entry.pinned, entry.seq_hashes)))
+                sm.demoted_blocks.inc(staged)
+            except Exception:
+                log.exception("session %s: tier demotion failed; releasing "
+                              "pins to LRU", session_id)
+        self.pool.release(entry.pinned)
+        entry.pinned = []
 
     def step(self) -> dict[str, LLMEngineOutput]:
         """Run one engine step synchronously; returns per-request deltas."""
@@ -1823,6 +1971,10 @@ class EngineCore:
         for rid in rids:
             self.abort(rid)
         self._seqs.clear()
+        if self.sessions is not None:
+            # Retained pins must not outlive the requests that made them —
+            # a failed engine's pool is rebuilt from scratch anyway.
+            self.sessions.release_all()
         return rids
 
 
@@ -2149,6 +2301,8 @@ class AsyncJaxEngine:
         out = self.core.metrics.snapshot(self.core.sched, self.core.pool)
         if self.core.kvbm is not None:
             out["kvbm"] = self.core.kvbm.snapshot()
+        if self.core.sessions is not None:
+            out["session"] = self.core.sessions.snapshot()
         return out
 
 

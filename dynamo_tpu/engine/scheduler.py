@@ -25,6 +25,7 @@ from typing import Iterable
 
 from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
+from dynamo_tpu.engine.session import session_id_of
 from dynamo_tpu.protocols.common import FinishReason, PreprocessedRequest
 from dynamo_tpu.qos.deadline import NO_SPEC_KEY, deadline_of, expired, priority_of
 from dynamo_tpu.qos.wdrr import WdrrQueue
@@ -77,6 +78,12 @@ class Seq:
     # prefill via expire_waiting, mid-decode via the engine's stop check).
     qos_priority: str = "standard"
     deadline_ts: float | None = None
+    # Session-sticky KV retention (engine/session.py): the session.id
+    # annotation, and whether this seq's avoided-prefill tokens have been
+    # counted (once, on its first planned chunk — preemption must not
+    # double-count the re-admission match).
+    session_id: str | None = None
+    session_counted: bool = False
     # Tracing (obs/tracer.py): the wire TraceContext parsed off the
     # request annotations, the one currently-open phase span
     # (engine.queue → engine.prefill → engine.decode), and the token
@@ -93,6 +100,7 @@ class Seq:
         ann = getattr(self.req, "annotations", None)
         self.qos_priority = priority_of(ann, self.qos_priority)
         self.deadline_ts = deadline_of(ann)
+        self.session_id = session_id_of(ann)
 
     @property
     def request_id(self) -> str:

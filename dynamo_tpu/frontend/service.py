@@ -46,6 +46,7 @@ from dynamo_tpu.protocols.openai import (
     ModelList,
 )
 from dynamo_tpu.protocols.sse import DONE_EVENT, encode_sse_json
+from dynamo_tpu.engine.session import SESSION_KEY, session_id_from
 from dynamo_tpu.qos import QosConfig, QosGateway
 from dynamo_tpu.qos.deadline import CLIENT_HEADER, deadline_from, priority_from
 from dynamo_tpu.utils.logging import TraceContext, get_logger
@@ -501,6 +502,13 @@ class HttpService:
         # the same wire-annotation mechanism as the QoS deadline keys.
         pre.annotations[TRACE_KEY] = root.context().header()
         root.attrs["input_tokens"] = len(pre.token_ids)
+        # Session stickiness: the x-session-id header (or session_id body
+        # field) rides the annotations to the router (turn-affinity) and
+        # engine (KV retention) — same wire pattern as the QoS keys.
+        session_id = session_id_from(request.headers, payload)
+        if session_id is not None:
+            pre.annotations[SESSION_KEY] = session_id
+            root.attrs["session_id"] = session_id
 
         # Logprob surface: the sampled token's logprob streams end-to-end;
         # alternatives (top_logprobs / completions logprobs>0) would need the

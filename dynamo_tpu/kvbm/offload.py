@@ -221,6 +221,31 @@ class OffloadManager:
         self.stats.offloaded_blocks += len(pending)
         return len(pending)
 
+    def stage_blocks(self, pairs: "list[tuple[int, int]]") -> int:
+        """Write-through ``(block_id, seq_hash)`` pairs into the tier cascade
+        NOW, while their device slots are still intact — the session
+        retention demotion path (EngineCore._demote_session): a released
+        pin's blocks go LRU-evictable immediately, so queueing them like a
+        normal eviction could extract a rewritten slot. Returns blocks
+        actually staged (already-present hashes in a non-shared top tier
+        are skipped, same dedup rule as _on_evict). On transfer failure the
+        queued pairs are rolled back so a later flush can't extract stale
+        slots."""
+        top = self.tiers[0]
+        shared = getattr(top, "shared", False)
+        queued = set(self._pending)
+        fresh = [(b, h) for b, h in pairs
+                 if (shared or h not in top) and (b, h) not in queued]
+        if not fresh:
+            return 0
+        self._pending.extend(fresh)
+        try:
+            self.flush_pending()
+        except Exception:
+            self._pending = [p for p in self._pending if p not in set(fresh)]
+            raise
+        return len(fresh)
+
     def drain_publish(self) -> int:
         """Flush the whole publish-on-commit queue (budgeted slices until
         empty). Called when the engine goes idle — the final finalize's

@@ -80,6 +80,16 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                    help="decode steps fused per device dispatch (stop checks "
                         "lag by up to window-1 tokens; output is unchanged)")
     p.add_argument("--host-kv-blocks", type=int, default=0, help="G2 host KV tier capacity")
+    p.add_argument("--session-ttl", type=float, default=0.0,
+                   help="session-sticky KV retention: seconds a finished "
+                        "session's committed blocks stay pinned so the next "
+                        "turn prefills only the suffix (0 = off)")
+    p.add_argument("--no-session-tiers", action="store_true",
+                   help="skip staging expired session KV down the KVBM tier "
+                        "ladder before unpinning")
+    p.add_argument("--ring-prefill-threshold", type=int, default=0,
+                   help="sp>1 only: min prompt tokens for ring prefill "
+                        "(0 = cost-model break-even, -1 = never)")
     p.add_argument("--disk-kv-path", default=None, help="G3 disk KV tier directory")
     p.add_argument("--remote-kv-addr", default=None,
                    help="G4 remote block store host:port")
@@ -124,6 +134,9 @@ def build_local_engine(ns: argparse.Namespace) -> tuple[AsyncJaxEngine, EngineCo
         host_kv_blocks=ns.host_kv_blocks,
         disk_kv_path=ns.disk_kv_path,
         remote_kv_addr=ns.remote_kv_addr,
+        session_ttl=ns.session_ttl,
+        session_tiers=not ns.no_session_tiers,
+        ring_prefill_threshold=ns.ring_prefill_threshold,
     )
     from dynamo_tpu.engine.engine import build_engine
 
@@ -163,6 +176,16 @@ async def run_http(ns: argparse.Namespace) -> None:
     # components/worker.py).
     from dynamo_tpu.obs.profiler import install_perf_metrics
     install_perf_metrics(svc.metrics)
+    if ns.session_ttl > 0:
+        from dynamo_tpu.engine.session import install_session_metrics
+
+        # Session retention feeds dynamo_session_* (engine/session.py).
+        install_session_metrics(svc.metrics)
+    if ns.sp > 1:
+        from dynamo_tpu.obs.ring_prefill import install_ring_prefill_metrics
+
+        # Ring-vs-chunked arbitration feeds dynamo_ring_prefill_*.
+        install_ring_prefill_metrics(svc.metrics)
     await svc.start(ns.host, ns.port)
     log.info("serving %s on http://%s:%d/v1", ns.model, ns.host, svc.port)
     try:

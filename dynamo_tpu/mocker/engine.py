@@ -21,6 +21,7 @@ from typing import AsyncIterator, Callable
 from dynamo_tpu import chaos
 from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
+from dynamo_tpu.engine.session import SessionStore, get_session_metrics, session_id_of
 from dynamo_tpu.obs.tracer import get_tracer, trace_context_of
 from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.qos.config import class_rank
@@ -54,6 +55,12 @@ class MockEngineArgs:
     # simulated prefill), without any device transfer.
     remote_kv_addr: str | None = None
     global_prefix_cache: bool = False
+    # Session-sticky KV retention mirror (engine/session.py): finished
+    # streams with a session.id keep their committed blocks pinned for this
+    # many seconds so the next turn's simulated prefill covers only the new
+    # suffix. 0 = off. Same SessionStore the JAX engine uses — block
+    # accounting and the dynamo_session_* metrics are real.
+    session_ttl: float = 0.0
 
 
 @dataclass
@@ -69,6 +76,7 @@ class _MockSeq:
     done: bool = False
     priority: str = "standard"
     deadline_ts: float | None = None
+    session_id: str | None = None
     # Tracing mirrors the real engine (engine/engine.py _trace_plan):
     # one open phase span per seq, decode spans rotated every N tokens.
     trace_ctx: object | None = None
@@ -79,6 +87,7 @@ class _MockSeq:
         ann = getattr(self.req, "annotations", None)
         self.priority = priority_of(ann, self.priority)
         self.deadline_ts = deadline_of(ann)
+        self.session_id = session_id_of(ann)
         self.trace_ctx = trace_context_of(ann)
 
 
@@ -104,6 +113,11 @@ class MockEngine:
         self.prefix_lookups = 0
         self.steps = 0
         self.deadline_cancelled = 0
+        # Session retention mirror — the same store the JAX engine wires up.
+        self.sessions: SessionStore | None = None
+        if self.args.session_ttl > 0 and self.args.enable_prefix_caching:
+            self.sessions = SessionStore(self.pool,
+                                         ttl=self.args.session_ttl)
         # Fleet-wide prefix cache mirror: a REAL RemoteBlockPool client (so
         # mocker fleets exercise the wire protocol, breaker, and chaos
         # points) over a deliberately tiny KV geometry — the payload is a
@@ -258,6 +272,11 @@ class MockEngine:
             # error kills the step loop — the wedged-engine failure canaries
             # are built to catch.
             await chaos.ainject("mocker.step", running=len(self.running))
+            if self.sessions is not None:
+                for _sid, entry in self.sessions.pop_expired(time.monotonic()):
+                    get_session_metrics().expired.inc()
+                    self.pool.release(entry.pinned)
+                    entry.pinned = []
             # reap cancelled
             for seq in [s for s in self.running if s.done]:
                 self._finish(seq, None)
@@ -280,6 +299,15 @@ class MockEngine:
                     continue
                 hashes = seq.block_seq.sequence_hashes()
                 matchable = max((len(seq.req.token_ids) - 1) // a.block_size, 0)
+                if self.sessions is not None and seq.session_id is not None:
+                    # Turn N+1: release the retained pins so the chain is
+                    # matchable; the match below re-references it (same
+                    # claim-then-match protocol as the JAX engine).
+                    sm = get_session_metrics()
+                    sm.lookups.inc()
+                    if self.sessions.claim(seq.session_id,
+                                           time.monotonic()) is not None:
+                        sm.hits.inc()
                 matched = self.pool.match_prefix(hashes[:matchable])
                 matched += self._import_remote(hashes[:matchable], matched)
                 need = -(-len(seq.req.token_ids) // a.block_size) - len(matched)
@@ -304,6 +332,10 @@ class MockEngine:
                 seq.committed = len(matched)
                 self.prefix_lookups += max(len(hashes), 1)
                 self.prefix_hits += len(matched)
+                if (self.sessions is not None and seq.session_id is not None
+                        and matched):
+                    get_session_metrics().avoided_tokens.inc(
+                        len(matched) * a.block_size)
                 self.waiting.pop(0)
                 self.running.append(seq)
                 self._trace_phase(seq, "engine.prefill",
@@ -391,6 +423,12 @@ class MockEngine:
                           finish_reason=str(reason) if reason else "")
         if seq in self.running:
             self.running.remove(seq)
+        if (self.sessions is not None and seq.session_id is not None
+                and reason is FinishReason.LENGTH and seq.committed):
+            # Retain before the release below, mirroring the JAX engine:
+            # pins take their refs while the chain is still active.
+            hashes = seq.block_seq.sequence_hashes()[: seq.committed]
+            self.sessions.retain(seq.session_id, hashes, time.monotonic())
         if seq.block_ids:
             self.pool.release(seq.block_ids)
             seq.block_ids = []
@@ -408,7 +446,11 @@ class MockEngine:
             "deadline_cancelled": self.deadline_cancelled,
             "prefix_cache_imported_blocks": self.imported_blocks,
             "prefix_cache_published_blocks": self.published_blocks,
+            **({"session": self.sessions.snapshot()}
+               if self.sessions is not None else {}),
         }
 
     async def clear_kv(self) -> None:
+        if self.sessions is not None:
+            self.sessions.release_all()
         self.pool.clear()

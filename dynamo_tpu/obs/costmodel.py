@@ -61,6 +61,13 @@ __all__ = [
     "PrefixCacheCost",
     "kv_block_wire_bytes",
     "prefix_cache_cost",
+    "RingPrefillDecision",
+    "chunked_prefill_seconds",
+    "ring_prefill_seconds",
+    "ring_vs_chunked_prefill",
+    "ring_prefill_break_even_tokens",
+    "SessionRetentionCost",
+    "session_retention_cost",
 ]
 
 
@@ -574,6 +581,232 @@ def prefix_cache_cost(
         peak_flops=hw.peak_flops,
         prefill_mfu=prefill_mfu,
         dcn_bytes_per_s=dcn_bytes_per_s,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Context-parallel ring prefill: ring-vs-chunked break-even
+# ---------------------------------------------------------------------------
+
+#: Per-hop ICI bandwidth one rotating KV shard sustains during ring
+#: attention (a v5e 1D ring link, conservative). The ring overlaps the hop
+#: with the block matmuls, so this only binds when the shard is large.
+ICI_BYTES_PER_S = 4.5e10
+
+#: Fixed cost of taking the ring path for one prompt: the whole-prompt
+#: dispatch (one bucketed step fn at the full sequence length), the
+#: seq-axis scatter of the prompt, and the paged-cache writeback gather.
+RING_PREFILL_OVERHEAD_S = 1e-3
+
+
+@dataclass(frozen=True)
+class RingPrefillDecision:
+    """Priced comparison of the two ways an sp>1 engine can prefill one
+    prompt: ``ring`` (one seq-sharded whole-prompt chunk over ICI) vs
+    ``chunked`` (the sequential prefill_chunk walk with the seq axis
+    idle). ``use_ring`` is the auto-select verdict the engine applies when
+    ``ring_prefill_threshold == 0``."""
+
+    prompt_tokens: int
+    sp: int
+    ring_seconds: float
+    chunked_seconds: float
+
+    @property
+    def use_ring(self) -> bool:
+        return self.ring_seconds < self.chunked_seconds
+
+    @property
+    def speedup(self) -> float:
+        return (self.chunked_seconds / self.ring_seconds
+                if self.ring_seconds > 0 else float("inf"))
+
+
+def chunked_prefill_seconds(
+    cfg: ModelConfig,
+    hw: HardwareSpec,
+    *,
+    prompt_tokens: int,
+    chunk: int,
+    block_size: int,
+    kv_dtype: str = "bfloat16",
+    quantization: str = "none",
+    prefill_mfu: float = PREFILL_MFU,
+) -> float:
+    """Sequential chunked prefill of one prompt with the mesh's seq axis
+    idle (every device repeats the same chunk): total FLOPs over the chunk
+    walk at achieved prefill MFU on ONE device's peak."""
+    eff = hw.peak_flops * prefill_mfu
+    if eff <= 0 or prompt_tokens <= 0:
+        return 0.0
+    chunk = max(chunk, 1)
+    flops = 0.0
+    done = 0
+    while done < prompt_tokens:
+        c = min(chunk, prompt_tokens - done)
+        phases = prefill_cost(cfg, batch=1, chunk=c, kv_len=done + c,
+                              block_size=block_size, kv_dtype=kv_dtype,
+                              quantization=quantization)
+        flops += total_cost(phases).flops
+        done += c
+    return flops / eff
+
+
+def ring_prefill_seconds(
+    cfg: ModelConfig,
+    hw: HardwareSpec,
+    *,
+    prompt_tokens: int,
+    sp: int,
+    block_size: int,
+    kv_dtype: str = "bfloat16",
+    quantization: str = "none",
+    prefill_mfu: float = PREFILL_MFU,
+    ici_bytes_per_s: float = ICI_BYTES_PER_S,
+) -> float:
+    """One seq-sharded whole-prompt ring prefill: the same matmul volume
+    split ``sp`` ways, overlapped with the per-layer KV shard rotation over
+    ICI, plus the fixed dispatch/writeback overhead."""
+    eff = hw.peak_flops * prefill_mfu
+    if eff <= 0 or prompt_tokens <= 0:
+        return 0.0
+    phases = prefill_cost(cfg, batch=1, chunk=prompt_tokens,
+                          kv_len=prompt_tokens, block_size=block_size,
+                          kv_dtype=kv_dtype, quantization=quantization)
+    compute_s = total_cost(phases).flops / max(sp, 1) / eff
+    ring = ring_attention_cost(
+        batch=1, seq_len=prompt_tokens, num_heads=cfg.num_heads,
+        num_kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim, sp=sp)
+    ici_s = ring.ici_bytes * cfg.num_layers / max(ici_bytes_per_s, 1.0)
+    return RING_PREFILL_OVERHEAD_S + max(compute_s, ici_s)
+
+
+def ring_vs_chunked_prefill(
+    cfg: ModelConfig,
+    hw: HardwareSpec,
+    *,
+    prompt_tokens: int,
+    sp: int,
+    chunk: int,
+    block_size: int,
+    kv_dtype: str = "bfloat16",
+    quantization: str = "none",
+) -> RingPrefillDecision:
+    """Price both prefill modes for one prompt; the engine's auto-select
+    and tools/perf_report.py both read this one verdict."""
+    return RingPrefillDecision(
+        prompt_tokens=prompt_tokens,
+        sp=sp,
+        ring_seconds=ring_prefill_seconds(
+            cfg, hw, prompt_tokens=prompt_tokens, sp=sp,
+            block_size=block_size, kv_dtype=kv_dtype,
+            quantization=quantization),
+        chunked_seconds=chunked_prefill_seconds(
+            cfg, hw, prompt_tokens=prompt_tokens, chunk=chunk,
+            block_size=block_size, kv_dtype=kv_dtype,
+            quantization=quantization),
+    )
+
+
+def ring_prefill_break_even_tokens(
+    cfg: ModelConfig,
+    hw: HardwareSpec,
+    *,
+    sp: int,
+    chunk: int,
+    block_size: int,
+    kv_dtype: str = "bfloat16",
+    quantization: str = "none",
+    max_tokens: int = 1 << 20,
+) -> int:
+    """Smallest block-aligned prompt length where the ring path beats the
+    chunked walk (the engine's auto threshold). Returns ``max_tokens`` when
+    ring never wins in range (sp=1, or overhead dominates throughout) —
+    callers treat that as "effectively off"."""
+    if sp <= 1:
+        return max_tokens
+
+    def _ring_wins(tokens: int) -> bool:
+        return ring_vs_chunked_prefill(
+            cfg, hw, prompt_tokens=tokens, sp=sp, chunk=chunk,
+            block_size=block_size, kv_dtype=kv_dtype,
+            quantization=quantization).use_ring
+
+    # Doubling probe for the first winning length, then bisect down to
+    # block granularity (the verdict is monotone in tokens: the ring's
+    # fixed overhead amortizes while its compute advantage grows).
+    hi = block_size
+    while hi < max_tokens and not _ring_wins(hi):
+        hi *= 2
+    if hi >= max_tokens:
+        return max_tokens
+    lo = hi // 2
+    while hi - lo > block_size:
+        mid = (lo + hi) // 2 // block_size * block_size
+        if _ring_wins(mid):
+            hi = mid
+        else:
+            lo = mid
+    return hi
+
+
+# ---------------------------------------------------------------------------
+# Session-sticky KV retention: retained bytes vs re-prefill seconds
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SessionRetentionCost:
+    """The session-retention trade: holding one conversation's KV costs
+    ``bytes_per_token`` of cache capacity per retained context token and
+    buys back ``seconds_per_token`` of turn-N+1 prefill per token NOT
+    recomputed. ``seconds_per_gb`` is the docs/PERF.md break-even figure:
+    prefill seconds one retained gigabyte saves at achieved MFU."""
+
+    bytes_per_token: float
+    seconds_per_token: float
+
+    def retained_bytes(self, tokens: float) -> float:
+        return max(tokens, 0.0) * self.bytes_per_token
+
+    def recompute_seconds(self, tokens: float) -> float:
+        return max(tokens, 0.0) * self.seconds_per_token
+
+    @property
+    def seconds_per_gb(self) -> float:
+        if self.bytes_per_token <= 0:
+            return 0.0
+        return self.seconds_per_token * (1 << 30) / self.bytes_per_token
+
+
+def session_retention_cost(
+    cfg: ModelConfig,
+    hw: HardwareSpec,
+    *,
+    block_size: int,
+    kv_dtype: str = "bfloat16",
+    quantization: str = "none",
+    rep_context_tokens: int = 1024,
+    prefill_mfu: float = PREFILL_MFU,
+) -> SessionRetentionCost:
+    """Linearized retention trade for a model/device pair: per-token KV
+    bytes from the cache layout (kv_block_wire_bytes over a block) and
+    per-token prefill seconds at a representative context (same
+    linearization — and the same err-toward-recompute bias — as
+    prefix_cache_cost)."""
+    per_block = kv_block_wire_bytes(
+        num_layers=cfg.num_layers, block_size=block_size,
+        num_kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
+        kv_dtype=kv_dtype)
+    n = max(rep_context_tokens, block_size)
+    phases = prefill_cost(cfg, batch=1, chunk=n, kv_len=n,
+                          block_size=block_size, kv_dtype=kv_dtype,
+                          quantization=quantization)
+    eff = hw.peak_flops * prefill_mfu
+    return SessionRetentionCost(
+        bytes_per_token=per_block / block_size,
+        seconds_per_token=(total_cost(phases).flops / n / eff
+                           if eff > 0 else 0.0),
     )
 
 

@@ -1,0 +1,59 @@
+"""Prometheus family for the context-parallel ring prefill mode.
+
+The engine's sp>1 prefill path (ops/ring_attention.py promoted to a
+serving mode by engine/engine.py) is cost-model arbitrated: prompts past
+the ring-vs-chunked break-even (obs/costmodel.py
+``ring_prefill_break_even_tokens``) prefill as ONE seq-sharded ring chunk;
+shorter prompts ride the normal chunked sequential path even on an sp>1
+mesh. This family makes the arbitration visible on /metrics: how often
+each side won and how many prompt tokens the ring path actually carried.
+
+Same singleton/bind pattern as kvbm/metrics.py; names are cross-checked
+by tools/lint_metrics.py RING_PREFILL_METRICS.
+"""
+
+from __future__ import annotations
+
+from dynamo_tpu.utils.metrics import MetricsRegistry
+
+
+class RingPrefillMetrics:
+    """The dynamo_ring_prefill_* family (names cross-checked by
+    tools/lint_metrics.py RING_PREFILL_METRICS)."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.bind(registry or MetricsRegistry())
+
+    def bind(self, registry: MetricsRegistry) -> None:
+        self.registry = registry
+        self.invocations = registry.counter(
+            "ring_prefill_invocations",
+            "Prefill dispatches that ran the seq-sharded ring path")
+        self.tokens = registry.counter(
+            "ring_prefill_tokens",
+            "Prompt tokens prefilled through ring attention")
+        self.bypassed = registry.counter(
+            "ring_prefill_bypassed",
+            "Prefill dispatches on an sp>1 mesh that stayed on the "
+            "chunked sequential path (below threshold or shape guard)")
+        self.threshold_tokens = registry.gauge(
+            "ring_prefill_threshold_tokens",
+            "Engaged ring-vs-chunked token threshold (explicit knob or "
+            "cost-model break-even)")
+
+
+_metrics: RingPrefillMetrics | None = None
+
+
+def get_ring_prefill_metrics() -> RingPrefillMetrics:
+    global _metrics
+    if _metrics is None:
+        _metrics = RingPrefillMetrics()
+    return _metrics
+
+
+def install_ring_prefill_metrics(registry: MetricsRegistry) -> RingPrefillMetrics:
+    """Re-home the singleton into a runtime registry (worker /metrics)."""
+    m = get_ring_prefill_metrics()
+    m.bind(registry)
+    return m

@@ -159,22 +159,40 @@ def unpack_int4(packed: jax.Array) -> jax.Array:
 # Split-K sizing
 # ---------------------------------------------------------------------------
 
+#: f32 per-split partial-state budget (acc + m + l outputs in HBM). The
+#: split-K prefill gate: partial state scales with ns·R (R = T·rep query
+#: rows), so a big prefill chunk that would emit hundreds of MB of state
+#: stays sequential even when the grid underfills the cores.
+_SPLIT_STATE_CAP_BYTES = 8 * 2**20
+
+
 def resolve_num_splits(num_splits: int, *, nblk: int, batch: int,
-                       q_chunks: int, q_tokens: int) -> int:
+                       q_chunks: int, q_tokens: int,
+                       state_rows: int = 0, kv_heads: int = 0,
+                       head_dim: int = 0) -> int:
     """Resolve a ``num_splits`` request to the split count actually used.
 
-    0 ("auto") defers to the cost model's :func:`auto_num_splits` for decode
-    (q_tokens == 1); prefill chunks stay sequential — their q-chunk axis
-    already fills the grid and per-split partial state would scale with T.
-    Explicit values are clamped to [1, nblk].
+    0 ("auto") defers to the cost model's :func:`auto_num_splits`. Decode
+    (q_tokens == 1) engages whenever the batch underfills the cores.
+    Chunked prefill (q_tokens > 1) engages under the SAME underfill signal —
+    ``batch × q_chunks`` grid programs vs core count — but only while the
+    f32 per-split partial state (which scales with ns·R, unlike decode's
+    R = rep) fits :data:`_SPLIT_STATE_CAP_BYTES`; callers that don't supply
+    the state geometry (``state_rows``/``kv_heads``/``head_dim``) keep the
+    conservative sequential walk. Explicit values are clamped to [1, nblk].
     """
     if num_splits <= 0:
-        if q_tokens != 1:
-            return 1
         from dynamo_tpu.obs.costmodel import auto_num_splits
-        return resolve_num_splits(
-            auto_num_splits(nblk, batch=batch, q_chunks=q_chunks),
-            nblk=nblk, batch=batch, q_chunks=q_chunks, q_tokens=q_tokens)
+
+        want = auto_num_splits(nblk, batch=batch, q_chunks=q_chunks)
+        if q_tokens != 1 and want > 1:
+            if not (state_rows and kv_heads and head_dim):
+                return 1
+            bytes_per_split = (batch * kv_heads * state_rows
+                               * (head_dim + 256) * 4)
+            want = min(want, max(
+                _SPLIT_STATE_CAP_BYTES // max(bytes_per_split, 1), 1))
+        return max(1, min(want, nblk))
     return max(1, min(num_splits, nblk))
 
 
@@ -357,7 +375,8 @@ def paged_attention_kernel(
     nq = r // rchunk
 
     ns = resolve_num_splits(num_splits, nblk=nblk, batch=b, q_chunks=nq,
-                            q_tokens=t)
+                            q_tokens=t, state_rows=r, kv_heads=kh,
+                            head_dim=d)
     spb = -(-nblk // ns)  # context blocks walked per split
     split = ns > 1
 

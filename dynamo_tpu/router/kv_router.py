@@ -11,6 +11,7 @@ and freed on stream end; dead workers purged when their instances vanish).
 from __future__ import annotations
 
 import asyncio
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator
 
@@ -81,6 +82,13 @@ class KvRouter:
         # The prefix-cache arbiter's most recent verdict (observability;
         # only written when config.prefix_cost is set).
         self.last_decision: RouteDecision | None = None
+        # Session affinity (engine/session.py retention): session.id →
+        # the worker holding that session's retained KV. Bounded LRU;
+        # entries for dead workers are purged in remove_worker. A mapped
+        # session routes straight to its holder — its retained blocks are
+        # pinned there, invisible to the radix index's event-driven view.
+        self.session_affinity: "OrderedDict[str, WorkerId]" = OrderedDict()
+        self.max_sessions = 4096
 
     # ------------------------------------------------------------------
     def apply_events(self, events: list[RouterEvent]) -> None:
@@ -96,12 +104,22 @@ class KvRouter:
         self.indexer.remove_worker(worker_id)
         self.active.remove_worker(worker_id)
         self.worker_metrics.pop(worker_id, None)
+        # A dead worker's retained sessions are gone with its HBM; the next
+        # turn falls back to arbiter pricing (tier pull vs recompute).
+        for sid in [s for s, w in self.session_affinity.items()
+                    if w == worker_id]:
+            del self.session_affinity[sid]
 
     # ------------------------------------------------------------------
     def find_best_match(self, request_id: str, token_ids: list[int],
-                        worker_ids: list[WorkerId]) -> tuple[WorkerId, int]:
+                        worker_ids: list[WorkerId],
+                        session_id: str | None = None) -> tuple[WorkerId, int]:
         """Pick a worker; returns (worker_id, overlap_blocks). Registers the
-        decision with the ActiveSequences predictor."""
+        decision with the ActiveSequences predictor. A ``session_id`` whose
+        retention holder is still alive short-circuits scheduling — the
+        suffix-only prefill on the holder beats any cold worker; a dead or
+        unknown holder falls through to normal arbitration (the arbiter
+        prices tier pull vs recompute when prefix_cost is set)."""
         if not worker_ids:
             raise NoInstancesError("no workers")
         # Health gating (reference: health_check.rs consumed by the router):
@@ -115,6 +133,20 @@ class KvRouter:
         hashes = compute_block_hashes_for_tokens(token_ids, self.config.block_size)
         total_blocks = max(len(hashes), 1)
         overlaps = (self.approx if self.config.use_approx_indexer else self.indexer).find_matches(hashes)
+        holder = (self.session_affinity.get(session_id)
+                  if session_id is not None else None)
+        if holder is not None and holder in worker_ids:
+            overlap = overlaps.scores.get(holder, 0)
+            get_prefix_cache_metrics().route_decisions.inc(
+                action="session_affinity")
+            self.session_affinity.move_to_end(session_id)
+            self.active.add_request(request_id, holder,
+                                    total_blocks - overlap, overlap)
+            if self.config.use_approx_indexer:
+                self.approx.note_routed(hashes, holder)
+            log.debug("session affinity: %s (session %s) -> worker %x",
+                      request_id, session_id, holder)
+            return holder, overlap
         loads = {}
         for wid in worker_ids:
             m = self.worker_metrics.get(wid, {})
@@ -141,6 +173,13 @@ class KvRouter:
         self.active.add_request(request_id, wid, total_blocks - overlap, overlap)
         if self.config.use_approx_indexer:
             self.approx.note_routed(hashes, wid)
+        if session_id is not None:
+            # This worker becomes the session's retention holder; the next
+            # turn sticks to it.
+            self.session_affinity[session_id] = wid
+            self.session_affinity.move_to_end(session_id)
+            while len(self.session_affinity) > self.max_sessions:
+                self.session_affinity.popitem(last=False)
         return wid, overlap
 
     def complete(self, request_id: str) -> None:
@@ -285,7 +324,11 @@ class KvPushRouter:
         rspan = (get_tracer().start_span(
             "router.schedule", ctx=tctx, request_id=req.request_id)
             if tctx else None)
-        wid, overlap = self.router.find_best_match(req.request_id, req.token_ids, worker_ids)
+        from dynamo_tpu.engine.session import session_id_of
+
+        wid, overlap = self.router.find_best_match(
+            req.request_id, req.token_ids, worker_ids,
+            session_id=session_id_of(req.annotations))
         req.estimated_prefix_hit_blocks = overlap
         if rspan is not None:
             get_tracer().end_span(rspan, worker_id=f"{wid:x}",

@@ -165,6 +165,23 @@ class EngineConfig:
     # Stop conditions lag by at most window-1 tokens; overrun is discarded
     # at finalize, so emitted streams are bit-identical to window=1.
     decode_window: int = 1
+    # Session-sticky KV retention (engine/session.py): when a stream with a
+    # session.id annotation finishes, its committed KV blocks stay pinned
+    # on device for this many seconds (leader-stamped step clock) so turn
+    # N+1 prefills only the new suffix. 0 = retention off.
+    session_ttl: float = 0.0
+    # On TTL expiry or pool pressure, stage a retained session's blocks
+    # down the KVBM tier ladder (host→disk) before unpinning, so a later
+    # turn can re-import them even after device eviction. False drops the
+    # pins to plain LRU without the write-through.
+    session_tiers: bool = True
+    # Context-parallel ring prefill (sp>1 meshes, ops/ring_attention.py):
+    # minimum prompt tokens before a fresh prompt prefills as ONE
+    # seq-sharded ring chunk instead of the chunked sequential path.
+    # 0 = auto (ring-vs-chunked break-even from obs/costmodel.py),
+    # N>0 = explicit token threshold, -1 = never (ring path fully off —
+    # the engine behaves exactly like an sp=1 chunked engine).
+    ring_prefill_threshold: int = 0
 
     def mesh_shape(self) -> dict[str, int]:
         return {"data": self.dp, "pipe": self.pp, "model": self.tp,

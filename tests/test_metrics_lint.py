@@ -45,6 +45,38 @@ def test_lint_accepts_clean_module(tmp_path: Path):
     assert lint_tree(tmp_path) == []
 
 
+def test_session_drift_detected(tmp_path: Path):
+    """Bidirectional drift on the session-retention family: a registration
+    the declaration doesn't know about AND every declared-but-unregistered
+    name must each produce a violation."""
+    (tmp_path / "engine").mkdir()
+    (tmp_path / "engine" / "session.py").write_text(textwrap.dedent("""
+        def bind(reg):
+            reg.counter("session_lookups", "session claims attempted")
+            reg.counter("session_surprise", "undeclared registration")
+    """))
+    problems = lint_tree(tmp_path)
+    assert any("session_surprise" in p and "SESSION_METRICS" in p
+               for p in problems)
+    assert any("session_hits" in p and "does not register" in p
+               for p in problems)
+
+
+def test_ring_prefill_drift_detected(tmp_path: Path):
+    """Same bidirectional rule for the ring-prefill family."""
+    (tmp_path / "obs").mkdir()
+    (tmp_path / "obs" / "ring_prefill.py").write_text(textwrap.dedent("""
+        def bind(reg):
+            reg.counter("ring_prefill_invocations", "ring engagements")
+            reg.counter("ring_prefill_surprise", "undeclared registration")
+    """))
+    problems = lint_tree(tmp_path)
+    assert any("ring_prefill_surprise" in p and "RING_PREFILL_METRICS" in p
+               for p in problems)
+    assert any("ring_prefill_tokens" in p and "does not register" in p
+               for p in problems)
+
+
 def test_prefix_cache_drift_detected(tmp_path: Path):
     """Bidirectional drift on the prefix-cache family: a registration the
     declaration doesn't know about AND every declared-but-unregistered name

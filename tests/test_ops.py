@@ -319,6 +319,70 @@ def test_split_k_forced_beyond_nblk_clamps():
                                atol=2e-6, rtol=2e-6)
 
 
+# -- q-chunked split-K prefill ------------------------------------------------
+
+def test_split_k_prefill_chunk_parity_bench_geometry():
+    """Forced split-K with T>1 query rows (chunked prefill at the bench
+    attention geometry kh=8, d=128) matches the sequential block walk —
+    the satellite that lets long chunked prefills fill idle TensorCores."""
+    rng = np.random.default_rng(21)
+    b, t, h, kh, d, nb, bs, nblk = 1, 8, 8, 8, 128, 20, 16, 16
+    q, k_cache, v_cache, block_tables, _, _ = _make_case(
+        rng, b, t, h, kh, d, nb, bs, nblk)
+    q_start = jnp.asarray([nblk * bs - t], jnp.int32)  # full-context chunk
+    q_len = jnp.full((b,), t, jnp.int32)
+    seq = paged_attention_kernel(
+        q, k_cache, v_cache, block_tables, q_start, q_start + q_len,
+        num_splits=1, interpret=True)
+    for ns in (2, 4):
+        out = paged_attention_kernel(
+            q, k_cache, v_cache, block_tables, q_start, q_start + q_len,
+            num_splits=ns, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(seq),
+                                   atol=2e-5, rtol=2e-5)
+    ref = _dense_ref(q, k_cache, v_cache, block_tables, q_start, q_len)
+    np.testing.assert_allclose(np.asarray(seq), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_resolve_num_splits_prefill_cost_model():
+    """The auto gate prices splits with the cost model: q-chunked prefill
+    engages split-K exactly when batch × q-chunks underfills the cores,
+    stays sequential for callers without state geometry (legacy decode
+    call sites), and is clamped by the f32 partial-state VMEM budget."""
+    from dynamo_tpu.obs.costmodel import auto_num_splits
+    from dynamo_tpu.ops.paged_attention import (
+        _SPLIT_STATE_CAP_BYTES,
+        resolve_num_splits,
+    )
+
+    # Decode (t=1) auto behavior is unchanged by the prefill gate.
+    assert resolve_num_splits(
+        0, nblk=32, batch=1, q_chunks=1, q_tokens=1
+    ) == auto_num_splits(32, batch=1)
+    # One row-program on an 8-core chip underfills → the cost model's
+    # split count engages for the prefill chunk.
+    want = auto_num_splits(32, batch=1, q_chunks=1)
+    assert want > 1
+    assert resolve_num_splits(
+        0, nblk=32, batch=1, q_chunks=1, q_tokens=8,
+        state_rows=8, kv_heads=8, head_dim=128) == want
+    # batch × q-chunks already fills the cores → sequential.
+    assert resolve_num_splits(
+        0, nblk=32, batch=8, q_chunks=4, q_tokens=8,
+        state_rows=8, kv_heads=8, head_dim=128) == 1
+    # The f32 partial-state budget caps huge chunks back to sequential.
+    rows = 4096
+    assert rows * 8 * (128 + 256) * 4 > _SPLIT_STATE_CAP_BYTES
+    assert resolve_num_splits(
+        0, nblk=64, batch=1, q_chunks=1, q_tokens=rows,
+        state_rows=rows, kv_heads=8, head_dim=128) == 1
+    # Callers that pass no state geometry (pre-existing call sites) keep
+    # the sequential walk for t>1.
+    assert resolve_num_splits(0, nblk=512, batch=1, q_chunks=1,
+                              q_tokens=8) == 1
+
+
 # -- Packed int4 KV -----------------------------------------------------------
 
 def test_pack_unpack_int4_roundtrip_and_odd_dim():

@@ -504,3 +504,70 @@ def test_bench_fail_line_carries_longctx(capsys):
     lc = out.get("longctx")
     assert lc and lc["metric"] == bench.LONGCTX_METRIC
     assert len(lc["predicted"]) == 2 * len(cm.KV_DTYPES)
+
+
+def test_bench_session_metric_analytic_arm():
+    """The analytic session entry mirrors what a measured turn-2 run
+    reports: avoided tokens are the block-rounded turn-1 KV commit (the
+    final sampled token's KV is never written), priced by the retention
+    cost model."""
+    s = bench._session_metric()
+    assert s["metric"] == "session_turn2_prefill_avoided_frac"
+    assert s["metric"] == bench.SESSION_METRIC
+    assert s["source"] == "costmodel" and s["unit"] == "frac"
+    turn1 = bench.SESSION_T1_PROMPT + bench.SESSION_T1_DECODE
+    assert s["turn1_tokens"] == turn1
+    assert s["avoided_tokens"] == ((turn1 - 1) // 16) * 16
+    assert s["turn2_prompt_tokens"] == turn1 + bench.SESSION_SUFFIX
+    assert s["value"] == round(s["avoided_tokens"] / s["turn2_prompt_tokens"], 4)
+    assert 0.0 < s["value"] < 1.0
+    assert s["retained_kv_mib"] > 0 and s["recompute_seconds_saved"] > 0
+
+
+def test_bench_fail_line_carries_session(capsys):
+    """The session metric is always-green by the same contract as
+    longctx: even a failure line ships the analytic entry."""
+    with pytest.raises(SystemExit):
+        bench.fail("unit_test", "synthetic failure")
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    s = out.get("session")
+    assert s and s["metric"] == bench.SESSION_METRIC
+    assert s["source"] == "costmodel" and s["avoided_tokens"] > 0
+
+
+def test_costmodel_ring_vs_chunked_crossover_and_break_even():
+    """Ring prefill loses on one-block prompts (ICI hops dominate a
+    single chunk), wins on long ones (chunked-sequential re-reads the
+    growing KV, ring shards it sp ways); the bisected break-even sits
+    between those two probes, the decision flips exactly there, and sp=1
+    never engages (the probe returns its max_tokens cap)."""
+    cfg = MODEL_PRESETS["llama-3-8b-lite"]
+    hw = cm.hw_spec_for("tpu v5 lite")
+    kw = dict(sp=8, chunk=512, block_size=16)
+    short = cm.ring_vs_chunked_prefill(cfg, hw, prompt_tokens=16, **kw)
+    long = cm.ring_vs_chunked_prefill(cfg, hw, prompt_tokens=131072, **kw)
+    assert not short.use_ring and long.use_ring
+    assert long.speedup > 1.0
+    be = cm.ring_prefill_break_even_tokens(cfg, hw, **kw)
+    assert 16 < be <= 131072 and be % 16 == 0
+    assert cm.ring_vs_chunked_prefill(cfg, hw, prompt_tokens=be, **kw).use_ring
+    assert cm.ring_prefill_break_even_tokens(
+        cfg, hw, sp=1, chunk=512, block_size=16) == 1 << 20
+
+
+def test_costmodel_session_retention_cost_scales_with_kv_dtype():
+    """Retention pricing: quantized KV shrinks bytes/token (cheaper to
+    hold a session) while recompute seconds are dtype-independent, so
+    seconds_per_gb — the knob operators tune TTL against — rises."""
+    cfg = MODEL_PRESETS["llama-3-8b-lite"]
+    hw = cm.hw_spec_for("tpu v5 lite")
+    kw = dict(block_size=16, quantization="none")
+    bf16 = cm.session_retention_cost(cfg, hw, kv_dtype="bfloat16", **kw)
+    int8 = cm.session_retention_cost(cfg, hw, kv_dtype="int8", **kw)
+    assert bf16.bytes_per_token > int8.bytes_per_token > 0
+    assert bf16.seconds_per_token == int8.seconds_per_token > 0
+    assert int8.seconds_per_gb > bf16.seconds_per_gb > 0
+    tokens = 4096
+    assert bf16.retained_bytes(tokens) == bf16.bytes_per_token * tokens
+    assert bf16.recompute_seconds(tokens) == pytest.approx(
+        bf16.seconds_per_token * tokens)

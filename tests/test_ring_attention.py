@@ -81,6 +81,23 @@ def test_ring_attention_sharded_inputs_stay_sharded(seq_mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+def test_ring_attention_bench_geometry_ragged(seq_mesh):
+    """The bench-model attention geometry (kh=8, d=128) with ragged kv_len
+    — the shapes the engine's ring prefill mode actually serves."""
+    rng = np.random.default_rng(7)
+    b, t, h, kh, d = 2, 64, 8, 8, 128
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, kh, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, kh, d)), jnp.float32)
+    kv_len = jnp.asarray([48, 64], jnp.int32)
+    fn = ring_attention_sharded(seq_mesh)
+    out = np.asarray(fn(q, k, v, kv_len))
+    ref = np.asarray(_dense_causal(q, k, v, kv_len))
+    np.testing.assert_allclose(out[0, :48], ref[0, :48], atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(out[1], ref[1], atol=2e-4, rtol=2e-4)
+    assert np.isfinite(out).all()
+
+
 def test_engine_sp_prefill_matches_unsharded():
     """An sp=2 engine (ring-attention prefill over the virtual mesh) must
     generate exactly the same greedy tokens as the unsharded engine —
@@ -97,6 +114,10 @@ def test_engine_sp_prefill_matches_unsharded():
         core = EngineCore(EngineConfig(
             model="tiny-llama", max_batch_size=2, max_model_len=128,
             num_blocks=64, block_size=4, dtype="float32", sp=sp,
+            # Pin the ring path on for any prompt: this test checks ring
+            # parity, not the auto break-even arbitration (which would
+            # rightly bypass ring for a 32-token prompt).
+            ring_prefill_threshold=1,
         ))
         if sp > 1:
             assert core.runner.mesh is not None
@@ -130,6 +151,7 @@ def test_engine_sp_prefill_bucket_used():
     core = EngineCore(EngineConfig(
         model="tiny-llama", max_batch_size=2, max_model_len=64,
         num_blocks=64, block_size=4, dtype="float32", sp=2,
+        ring_prefill_threshold=1,
     ))
     core.add_request(PreprocessedRequest(
         request_id="r", token_ids=list(range(1, 17)),
@@ -140,3 +162,115 @@ def test_engine_sp_prefill_bucket_used():
         core.step()
     assert any(key[3] for key in core.runner._step_fns), (
         f"no sp_prefill bucket compiled: {list(core.runner._step_fns)}")
+
+
+def _sp_engine_tokens(prompt, *, sp, max_tokens=4, **cfg_kw):
+    from dynamo_tpu.engine.engine import EngineCore
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.utils.config import EngineConfig
+
+    base = dict(model="tiny-llama", max_batch_size=2, max_model_len=128,
+                num_blocks=64, block_size=4, dtype="float32", sp=sp)
+    base.update(cfg_kw)
+    core = EngineCore(EngineConfig(**base))
+    core.add_request(PreprocessedRequest(
+        request_id="r", token_ids=list(prompt),
+        sampling_options=SamplingOptions(temperature=0.0),
+        stop_conditions=StopConditions(max_tokens=max_tokens,
+                                       ignore_eos=True)))
+    toks = []
+    while core.has_work():
+        for out in core.step().values():
+            toks.extend(out.token_ids)
+    return toks, core
+
+
+def test_engine_ring_vs_chunked_sequential_prefill():
+    """Ring prefill (sp=2, whole prompt in one sharded pass) vs the
+    chunked-sequential walk (sp=1, prefill_chunk < prompt): identical
+    greedy tokens — the two prefill modes the cost model arbitrates
+    between must be interchangeable."""
+    prompt = list(range(1, 49))  # 48 tokens
+    ring, _ = _sp_engine_tokens(prompt, sp=2, ring_prefill_threshold=1)
+    chunked, core = _sp_engine_tokens(prompt, sp=1, prefill_chunk=16)
+    assert ring == chunked and len(ring) == 4
+    assert not any(key[3] for key in core.runner._step_fns)
+
+
+def test_ring_prefill_threshold_gating():
+    """The arbitration gate: prompts below the threshold take the chunked
+    path (bypassed counter moves, no sp bucket compiles); prompts at or
+    past it engage ring prefill (invocations + tokens move)."""
+    from dynamo_tpu.obs.ring_prefill import get_ring_prefill_metrics
+
+    rm = get_ring_prefill_metrics()
+    prompt = list(range(1, 33))  # 32 tokens
+
+    base_byp = rm.bypassed.get()
+    _, core = _sp_engine_tokens(prompt, sp=2, ring_prefill_threshold=1000)
+    assert core.runner.ring_threshold == 1000
+    assert not any(key[3] for key in core.runner._step_fns)
+    assert rm.bypassed.get() > base_byp
+
+    base_inv, base_tok = rm.invocations.get(), rm.tokens.get()
+    _, core = _sp_engine_tokens(prompt, sp=2, ring_prefill_threshold=32)
+    assert any(key[3] for key in core.runner._step_fns)
+    assert rm.invocations.get() > base_inv
+    assert rm.tokens.get() - base_tok >= len(prompt)
+
+
+def test_ring_prefill_disabled_is_zero_extra_ops():
+    """ring_prefill_threshold=-1 with sp>1 must behave exactly like the
+    sp=1 chunked engine: no threshold, no sp bucket, no ring metric
+    movement, identical tokens."""
+    from dynamo_tpu.obs.ring_prefill import get_ring_prefill_metrics
+
+    rm = get_ring_prefill_metrics()
+    base = (rm.invocations.get(), rm.bypassed.get(), rm.tokens.get())
+    prompt = list(range(1, 33))
+    off, core = _sp_engine_tokens(prompt, sp=2, ring_prefill_threshold=-1)
+    assert core.runner.ring_threshold is None
+    assert not any(key[3] for key in core.runner._step_fns)
+    assert (rm.invocations.get(), rm.bypassed.get(), rm.tokens.get()) == base
+    plain, _ = _sp_engine_tokens(prompt, sp=1)
+    assert off == plain
+
+
+def test_ring_prefill_paged_writeback_roundtrip():
+    """KV written back to the paged cache by ring prefill must be reusable:
+    with prefix caching on, a second request prefix-hits the blocks the
+    ring pass wrote and decodes from them — tokens must match the sp=1
+    engine running the same two-request sequence."""
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    prompt = list(range(1, 33))
+
+    def two_requests(sp, **kw):
+        _, core = _sp_engine_tokens(prompt, sp=sp,
+                                    enable_prefix_caching=True, **kw)
+        pre_hits = core.metrics.num_prefill_tokens
+        core.add_request(PreprocessedRequest(
+            request_id="r2", token_ids=list(prompt) + [7, 8, 9],
+            sampling_options=SamplingOptions(temperature=0.0),
+            stop_conditions=StopConditions(max_tokens=4, ignore_eos=True)))
+        toks = []
+        while core.has_work():
+            for out in core.step().values():
+                toks.extend(out.token_ids)
+        prefilled = core.metrics.num_prefill_tokens - pre_hits
+        return toks, prefilled
+
+    ring_toks, ring_prefilled = two_requests(2, ring_prefill_threshold=1)
+    seq_toks, seq_prefilled = two_requests(1)
+    assert ring_toks == seq_toks
+    # The second request prefilled only its unmatched tail in BOTH engines
+    # — i.e. the ring-written blocks were genuinely reused, not recomputed.
+    assert ring_prefilled == seq_prefilled < len(prompt) + 3

@@ -89,6 +89,29 @@ PREFIX_CACHE_METRICS = (
     "prefix_cache_route_decisions",
 )
 
+# The session KV-retention family (engine/session.py SessionMetrics):
+# per-turn reuse counters plus live retained-state gauges. Same
+# bidirectional drift rule as KV_TRANSFER_METRICS.
+SESSION_METRICS = (
+    "session_lookups",
+    "session_hits",
+    "session_avoided_tokens",
+    "session_retained_blocks",
+    "session_active",
+    "session_expired",
+    "session_demoted_blocks",
+)
+
+# The context-parallel ring prefill family (obs/ring_prefill.py
+# RingPrefillMetrics): engage/bypass counters plus the live auto-threshold
+# gauge. Same bidirectional drift rule as KV_TRANSFER_METRICS.
+RING_PREFILL_METRICS = (
+    "ring_prefill_invocations",
+    "ring_prefill_tokens",
+    "ring_prefill_bypassed",
+    "ring_prefill_threshold_tokens",
+)
+
 # The failure-recovery family: health canaries (runtime/health.py),
 # migration re-dispatch (frontend/migration.py), and chaos injection
 # (chaos/metrics.py). Same bidirectional drift rule as KV_TRANSFER_METRICS:
@@ -299,6 +322,40 @@ def _lint_perf_labels(root: Path, problems: list[str]) -> None:
                 f"PERF_METRIC_LABELS declares {tuple(sorted(declared))}")
 
 
+def _lint_session_metrics(root: Path, problems: list[str]) -> None:
+    """The session-retention family must match what engine/session.py
+    actually registers — same no-silent-drift rule as KV_TRANSFER_METRICS."""
+    actual = _registered_names(root / "engine" / "session.py")
+    if actual is None:
+        return
+    declared = set(SESSION_METRICS)
+    for key in sorted(actual - declared):
+        problems.append(
+            f"engine/session.py registers {key!r} but it is missing from "
+            "tools/lint_metrics.py SESSION_METRICS")
+    for key in sorted(declared - actual):
+        problems.append(
+            f"SESSION_METRICS declares {key!r} but engine/session.py "
+            "does not register it")
+
+
+def _lint_ring_prefill_metrics(root: Path, problems: list[str]) -> None:
+    """The ring-prefill family must match what obs/ring_prefill.py actually
+    registers — same no-silent-drift rule as KV_TRANSFER_METRICS."""
+    actual = _registered_names(root / "obs" / "ring_prefill.py")
+    if actual is None:
+        return
+    declared = set(RING_PREFILL_METRICS)
+    for key in sorted(actual - declared):
+        problems.append(
+            f"obs/ring_prefill.py registers {key!r} but it is missing from "
+            "tools/lint_metrics.py RING_PREFILL_METRICS")
+    for key in sorted(declared - actual):
+        problems.append(
+            f"RING_PREFILL_METRICS declares {key!r} but obs/ring_prefill.py "
+            "does not register it")
+
+
 def _lint_recovery_metrics(root: Path, problems: list[str]) -> None:
     """The recovery family must match what each module actually registers
     — same no-silent-drift rule as KV_TRANSFER_METRICS."""
@@ -356,6 +413,8 @@ def lint_tree(root: Path | None = None) -> list[str]:
     _lint_prefix_cache_metrics(root, problems)
     _lint_perf_metrics(root, problems)
     _lint_perf_labels(root, problems)
+    _lint_session_metrics(root, problems)
+    _lint_ring_prefill_metrics(root, problems)
     _lint_recovery_metrics(root, problems)
     return problems
 
