@@ -37,6 +37,8 @@ import time
 
 import aiohttp
 
+from dynamo_tpu.utils.metrics import fetch_metrics, metric_sum
+
 WORDS = (
     "alpha bravo charlie delta echo foxtrot golf hotel india juliet kilo lima "
     "mike november oscar papa quebec romeo sierra tango uniform victor whiskey "
@@ -218,33 +220,57 @@ async def scrape_metrics(urls: list[str],
     acc: dict[str, float] = {}
     seen = False
     for u in urls:
-        if not u.rstrip("/").endswith("/metrics"):
-            u = f"{u.rstrip('/')}/metrics"
         try:
-            async with aiohttp.ClientSession() as session:
-                async with session.get(
-                        u,
-                        timeout=aiohttp.ClientTimeout(total=5)) as resp:
-                    if resp.status != 200:
-                        continue
-                    text = await resp.text()
+            sample = await fetch_metrics(u, timeout_s=5)
         except Exception:
             continue
-        for line in text.splitlines():
-            if not line.startswith(prefix):
-                continue
-            name = line.split("{")[0].split(" ")[0]
-            try:
-                value = float(line.rsplit(" ", 1)[-1])
-            except ValueError:
-                continue
-            acc[name] = acc.get(name, 0.0) + value
-            seen = True
+        seen = True
+        for (name, _labels), value in sample.items():
+            if name.startswith(prefix):
+                acc[name] = acc.get(name, 0.0) + value
     return acc if seen else None
 
 
 async def scrape_prefix_cache(urls: list[str]) -> "dict[str, float] | None":
     return await scrape_metrics(urls, "dynamo_prefix_cache_")
+
+
+def fleet_slo_summary(sample: "dict[tuple[str, frozenset], float]") -> dict:
+    """Fold the aggregator's SLO gauges into a loadgen summary block:
+    per-SLO budget remaining, burn rates by window, and violation counts
+    (see docs/OBSERVABILITY.md "Fleet aggregation & SLOs")."""
+    slos: dict[str, dict] = {}
+    for (name, labels), value in sample.items():
+        d = dict(labels)
+        slo = d.get("slo")
+        if not slo:
+            continue
+        entry = slos.setdefault(slo, {"burn_rates": {}, "violations": {}})
+        if name == "dynamo_slo_error_budget_remaining":
+            entry["budget_remaining"] = round(value, 4)
+        elif name == "dynamo_slo_burn_rate" and "window" in d:
+            entry["burn_rates"][d["window"]] = round(value, 4)
+        elif name == "dynamo_slo_violations_total":
+            entry["violations"][d.get("severity", "page")] = int(value)
+    return {
+        "scraped": bool(slos),
+        "targets_alive": int(metric_sum(sample, "dynamo_fleet_targets",
+                                        state="fresh")),
+        "targets_stale": int(metric_sum(sample, "dynamo_fleet_targets",
+                                        state="stale")),
+        "slos": slos,
+    }
+
+
+async def scrape_fleet_slo(fleet_url: str) -> "dict | None":
+    """One post-run scrape of the fleet aggregator (--fleet-url): the SLO
+    summary block emitted next to the per-endpoint summaries. None when the
+    aggregator is unreachable — never a run failure."""
+    try:
+        sample = await fetch_metrics(fleet_url, timeout_s=5)
+    except Exception:
+        return None
+    return fleet_slo_summary(sample)
 
 
 async def run_load(url: str, model: str, concurrency: int, num_requests: int,
@@ -533,17 +559,12 @@ async def probe_kv_quant(url: str) -> bool | None:
     (the gauge lives on whatever status server the url fronts; a frontend
     without a metrics proxy just yields None — never a failure)."""
     try:
-        async with aiohttp.ClientSession() as session:
-            async with session.get(f"{url}/metrics",
-                                   timeout=aiohttp.ClientTimeout(total=5)) as resp:
-                if resp.status != 200:
-                    return None
-                text = await resp.text()
-        for line in text.splitlines():
-            if line.startswith("dynamo_engine_kv_quant_enabled"):
-                return bool(float(line.split()[-1]))
+        sample = await fetch_metrics(url, timeout_s=5)
     except Exception:
         return None
+    for (name, _labels), value in sample.items():
+        if name == "dynamo_engine_kv_quant_enabled":
+            return bool(value)
     return None
 
 
@@ -613,6 +634,11 @@ def main(argv: list[str] | None = None) -> dict:
                          "with; recorded in the result JSON and checked "
                          "against the engine's dynamo_engine_kv_quant_enabled "
                          "gauge when /metrics is reachable")
+    ap.add_argument("--fleet-url", default=None,
+                    help="fleet aggregator base URL; scraped once post-run "
+                         "to emit a fleet_slo summary block (burn rates, "
+                         "error budget remaining, target freshness) next to "
+                         "the per-endpoint summaries")
     ap.add_argument("--out", default=None, help="also write the JSON here")
     ap.add_argument("--trace-out", default=None,
                     help="after the run, fetch <url>/debug/traces (Chrome "
@@ -620,12 +646,23 @@ def main(argv: list[str] | None = None) -> dict:
                          "write it here; analyse with tools/trace_report.py")
     ns = ap.parse_args(argv)
 
+    def attach_fleet_slo(result: dict) -> None:
+        if ns.fleet_url is None:
+            return
+        slo = asyncio.run(scrape_fleet_slo(ns.fleet_url))
+        if slo is not None:
+            result["fleet_slo"] = slo
+        else:
+            print(f"loadgen: fleet aggregator unreachable: {ns.fleet_url}",
+                  file=sys.stderr)
+
     if ns.mode == "session":
         result = asyncio.run(run_sessions(
             ns.url, ns.model, ns.sessions, ns.turns, ns.isl, ns.osl,
             ns.think_time, ns.concurrency, metrics_urls=ns.metrics_url))
         result["chips"] = ns.chips
         _record_kv_dtype(result, ns.url, ns.kv_dtype)
+        attach_fleet_slo(result)
         print(json.dumps(result))
         if ns.out:
             with open(ns.out, "w") as f:
@@ -642,6 +679,7 @@ def main(argv: list[str] | None = None) -> dict:
             ns.url, ns.model, ns.arrival_rate, ns.requests, ns.isl, ns.osl,
             ns.priority_mix, ns.expired_frac))
         _record_kv_dtype(result, ns.url, ns.kv_dtype)
+        attach_fleet_slo(result)
         print(json.dumps(result))
         if ns.out:
             with open(ns.out, "w") as f:
@@ -658,6 +696,7 @@ def main(argv: list[str] | None = None) -> dict:
     result["chips"] = ns.chips
     result["output_tok_s_per_chip"] = round(result["output_tok_s"] / ns.chips, 2)
     _record_kv_dtype(result, ns.url, ns.kv_dtype)
+    attach_fleet_slo(result)
     print(json.dumps(result))
     if ns.out:
         with open(ns.out, "w") as f:

@@ -145,6 +145,10 @@ class FleetConfig:
     worker_env: dict[str, str] = field(default_factory=dict)
     frontend_env: dict[str, str] = field(default_factory=dict)
     worker_args: list[str] = field(default_factory=list)
+    aggregator: bool = False                  # spawn a fleet aggregator
+    aggregator_env: dict[str, str] = field(default_factory=dict)
+    scrape_interval_s: float = 0.5            # aggregator sweep cadence
+    staleness_ttl_s: float = 2.0              # aggregator staleness window
 
 
 class MockerFleet:
@@ -159,6 +163,9 @@ class MockerFleet:
         self.coordinator: Proc | None = None
         self.workers: list[Proc] = []
         self.frontend: Proc | None = None
+        self.aggregator: Proc | None = None
+        self.agg_port = free_port() if cfg.aggregator else 0
+        self.agg_base = f"http://127.0.0.1:{self.agg_port}"
 
     # -- lifecycle ---------------------------------------------------------
     def _common_env(self) -> dict[str, str]:
@@ -169,6 +176,10 @@ class MockerFleet:
 
     def _worker_env(self) -> dict[str, str]:
         env = {**self._common_env(), **self.cfg.worker_env}
+        if self.cfg.aggregator:
+            # scrape targets need the per-process status server up so
+            # advertise_metrics() has a /metrics URL to publish
+            env.setdefault("DYN_SYSTEM_ENABLED", "1")
         if self.cfg.chaos_plan is not None:
             env["DYN_CHAOS_PLAN"] = json.dumps(self.cfg.chaos_plan.to_dict())
         if self.cfg.chaos_seed is not None:
@@ -204,6 +215,18 @@ class MockerFleet:
             name="frontend", env={**self._common_env(),
                                   **self.cfg.frontend_env}).start()
         self.frontend.wait_for_line("FRONTEND_READY", 30)
+        if self.cfg.aggregator:
+            self.aggregator = Proc(
+                ["-m", "dynamo_tpu.components.aggregator",
+                 "--coordinator", self.coord_url, "--host", "127.0.0.1",
+                 "--port", str(self.agg_port),
+                 "--scrape-interval", str(self.cfg.scrape_interval_s),
+                 "--scrape-timeout", "2.0",
+                 "--staleness-ttl", str(self.cfg.staleness_ttl_s)],
+                name="aggregator",
+                env={**self._common_env(),
+                     **self.cfg.aggregator_env}).start()
+            self.aggregator.wait_for_line("AGGREGATOR_READY", 30)
         deadline = time.time() + 15
         while time.time() < deadline:
             try:
@@ -215,6 +238,8 @@ class MockerFleet:
         raise TimeoutError("model never discovered:\n" + self.frontend.logs())
 
     def stop(self) -> None:
+        if self.aggregator:
+            self.aggregator.stop()
         if self.frontend:
             self.frontend.stop()
         for w in self.workers:
@@ -235,6 +260,32 @@ class MockerFleet:
 
     def engine_stats(self) -> dict:
         return http_json(self.base + "/engine_stats")
+
+    def aggregator_metrics_text(self) -> str:
+        with urllib.request.urlopen(self.agg_base + "/metrics",
+                                    timeout=10) as r:
+            return r.read().decode()
+
+    def fleet_debug(self) -> dict:
+        return http_json(self.agg_base + "/debug/fleet", timeout=10)
+
+    def wait_fleet_fresh(self, n: int, timeout: float = 30.0) -> dict:
+        """Wait until the aggregator reports >= n fresh scrape targets;
+        returns the final /debug/fleet document."""
+        deadline = time.time() + timeout
+        info: dict = {}
+        while time.time() < deadline:
+            try:
+                info = self.fleet_debug()
+                fresh = sum(1 for t in info.get("targets", [])
+                            if t.get("fresh"))
+                if fresh >= n:
+                    return info
+            except Exception:
+                pass
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"aggregator never reached {n} fresh targets: {info}")
 
     def wait_drained(self, timeout: float = 20.0) -> dict:
         """Wait until every published worker snapshot shows an idle engine;
@@ -302,13 +353,16 @@ class ScenarioResult:
 def _finish(name: str, fleet: MockerFleet,
             outcomes: list[StreamOutcome],
             seed: int | None = None,
-            require_shed_zero: bool = False) -> ScenarioResult:
+            require_shed_zero: bool = False,
+            aggregator_text: str | None = None) -> ScenarioResult:
     """Shared epilogue: drain, then run every fleet-level invariant."""
     checker = InvariantChecker()
     checker.check_streams(outcomes)
     stats = fleet.wait_drained()
     checker.check_block_leaks(stats)
     checker.check_metrics_balance(fleet.metrics_text())
+    if aggregator_text is not None:
+        checker.check_fleet_rollup(aggregator_text)
     if require_shed_zero:
         from dynamo_tpu.chaos.invariants import metric_sum, parse_prometheus
 
@@ -419,12 +473,91 @@ def scenario_slow_rank_stall(seed: int = 1234) -> ScenarioResult:
                        require_shed_zero=True)
 
 
+def scenario_aggregator_partition(seed: int = 1234) -> ScenarioResult:
+    """Scrape targets dying/partitioned mid-interval: the aggregator must
+    degrade the dead target to stale-labeled data with zero crashes while
+    the rest of the fleet stays fresh, count every failed scrape in
+    ``dynamo_fleet_scrape_errors_total``, and — after the worker comes
+    back — its fleet qos_admitted rollup must re-balance against the
+    terminal statuses."""
+    agg_plan = ChaosPlan.from_dict({"seed": seed, "rules": [
+        # a burst of injected scrape faults on top of the real partition
+        {"point": "obs.fleet.scrape", "kind": "error", "rate": 0.2,
+         "count": 6},
+    ]})
+    cfg = FleetConfig(
+        workers=2, aggregator=True, speedup_ratio=10.0,
+        scrape_interval_s=0.3, staleness_ttl_s=1.5,
+        aggregator_env={"DYN_CHAOS_PLAN": json.dumps(agg_plan.to_dict()),
+                        "DYN_CHAOS_SEED": str(seed)})
+    with MockerFleet(cfg) as fleet:
+        # discovery without static target lists: frontend + both workers
+        fleet.wait_fleet_fresh(3)
+        pre = fleet.drive_load(n=6, concurrency=3)
+
+        victim = fleet.workers[1]
+        victim.kill_hard()
+        # the dead target must flip to stale without dropping the others
+        deadline = time.time() + 20
+        degraded: dict = {}
+        while time.time() < deadline:
+            degraded = fleet.fleet_debug()
+            fresh = [t for t in degraded.get("targets", []) if t["fresh"]]
+            stale = [t for t in degraded.get("targets", []) if not t["fresh"]]
+            if stale and len(fresh) >= 2:
+                break
+            time.sleep(0.2)
+        mid = fleet.drive_load(n=4, concurrency=2, timeout=60.0)
+
+        fleet.workers[1] = fleet.start_worker(1)
+        fleet.workers[1].wait_for_line("WORKER_READY", 30)
+        fleet.wait_fleet_fresh(3)
+        post = fleet.drive_load(n=4, concurrency=2, timeout=60.0)
+
+        # the rollup is a scrape-time snapshot: wait for the sweep after
+        # the last terminal status lands before judging the balance
+        fleet.wait_drained()
+        agg_text = ""
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            agg_text = fleet.aggregator_metrics_text()
+            probe = InvariantChecker()
+            probe.check_fleet_rollup(agg_text)
+            if probe.report.passed:
+                break
+            time.sleep(max(cfg.scrape_interval_s, 0.2))
+
+        res = _finish("aggregator_partition", fleet, pre + mid + post,
+                      seed=seed, aggregator_text=agg_text)
+        stale_seen = [t for t in degraded.get("targets", [])
+                      if not t.get("fresh")]
+        if not stale_seen:
+            res.report.fail("dead worker never degraded to stale")
+        else:
+            res.report.ok("partition_degraded_to_stale")
+        from dynamo_tpu.chaos.invariants import metric_sum, parse_prometheus
+
+        errs = metric_sum(parse_prometheus(agg_text),
+                          "dynamo_fleet_scrape_errors_total")
+        if errs <= 0:
+            res.report.fail("dynamo_fleet_scrape_errors_total never moved")
+        else:
+            res.report.ok("scrape_errors_counted")
+        if not fleet.aggregator.alive():
+            res.report.fail("aggregator crashed during the partition:\n"
+                            + fleet.aggregator.logs()[-2000:])
+        else:
+            res.report.ok("aggregator_survived")
+        return res
+
+
 SCENARIOS: dict[str, Callable[[int], ScenarioResult]] = {
     "smoke": scenario_smoke,
     "worker_kill": scenario_worker_kill,
     "coordinator_partition": scenario_coordinator_partition,
     "lease_expiry_storm": scenario_lease_expiry_storm,
     "slow_rank_stall": scenario_slow_rank_stall,
+    "aggregator_partition": scenario_aggregator_partition,
 }
 
 

@@ -25,9 +25,15 @@ can assert two runs of the same seed produce *identical* reports.
 
 from __future__ import annotations
 
-import re
 from dataclasses import dataclass, field
 from typing import Any, Iterable, Mapping
+
+from dynamo_tpu.utils.metrics import metric_sum, parse_prometheus
+
+__all__ = ["parse_prometheus", "metric_sum", "StreamOutcome",
+           "InvariantReport", "InvariantChecker",
+           "ADMITTED_TERMINAL_STATUSES", "SHED_STATUSES",
+           "CLIENT_ERROR_STATUSES", "GENERATE_ROUTES"]
 
 # frontend_requests_total statuses on the chat/completions routes, split by
 # where in the request lifecycle they are emitted (frontend/service.py):
@@ -37,38 +43,6 @@ ADMITTED_TERMINAL_STATUSES = {"200", "499", "500"}
 SHED_STATUSES = {"429", "503", "504"}
 CLIENT_ERROR_STATUSES = {"400", "404", "501", "502"}
 GENERATE_ROUTES = {"chat", "completions"}
-
-_PROM_LINE = re.compile(
-    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[^\s]+)")
-_LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
-
-
-def parse_prometheus(text: str) -> dict[tuple[str, frozenset], float]:
-    """Prometheus exposition text -> {(name, frozenset(label items)): value}."""
-    out: dict[tuple[str, frozenset], float] = {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        m = _PROM_LINE.match(line)
-        if not m:
-            continue
-        try:
-            value = float(m.group("value"))
-        except ValueError:
-            continue
-        labels = frozenset(_LABEL.findall(m.group("labels") or ""))
-        out[(m.group("name"), labels)] = value
-    return out
-
-
-def metric_sum(samples: Mapping[tuple[str, frozenset], float], name: str,
-               **where: str) -> float:
-    """Sum every sample of ``name`` whose labels include ``where``."""
-    want = set(where.items())
-    return sum(v for (n, labels), v in samples.items()
-               if n == name and want <= set(labels))
 
 
 @dataclass
@@ -225,6 +199,42 @@ class InvariantChecker:
                 f"only {shed:g} qos_rejected_total")
         else:
             self.report.ok("metrics_shed_balance")
+
+    # -- fleet rollup ------------------------------------------------------
+    def check_fleet_rollup(self, aggregator_text: str) -> None:
+        """Same admitted-vs-terminal balance, but read from the fleet
+        aggregator's rollup series (``instance="_fleet"``): after targets
+        died and recovered mid-scenario the aggregator's fleet view must
+        still account for every admitted request."""
+        samples = parse_prometheus(aggregator_text)
+        fleet = {"instance": "_fleet"}
+        admitted = metric_sum(samples, "dynamo_qos_admitted_total", **fleet)
+        completed = failed = 0.0
+        for (name, labels), v in samples.items():
+            if name != "dynamo_frontend_requests_total":
+                continue
+            d = dict(labels)
+            if d.get("instance") != "_fleet":
+                continue
+            if d.get("route") not in GENERATE_ROUTES:
+                continue
+            status = d.get("status", "")
+            if status == "200":
+                completed += v
+            elif status in ADMITTED_TERMINAL_STATUSES:
+                failed += v
+        scrape_errors = metric_sum(samples, "dynamo_fleet_scrape_errors_total")
+        self.report.details["fleet_rollup"] = {
+            "admitted": admitted, "completed": completed, "failed": failed,
+            "scrape_errors": scrape_errors,
+        }
+        if admitted != completed + failed:
+            self.report.fail(
+                f"fleet rollup imbalance: qos_admitted_total={admitted:g} "
+                f"but completed({completed:g}) + failed({failed:g}) = "
+                f"{completed + failed:g}")
+        else:
+            self.report.ok("fleet_rollup_admitted_balance")
 
     def finish(self) -> InvariantReport:
         return self.report

@@ -301,6 +301,11 @@ async def amain(ns: argparse.Namespace) -> None:
         install_chaos_metrics(svc.metrics)
     port = await svc.start(ns.host, ns.port,
                            tls_cert=ns.tls_cert, tls_key=ns.tls_key)
+    # Fleet aggregator discovery: the frontend's /metrics lives on its HTTP
+    # service port, not a status server — advertise that (lease-bound).
+    scheme = "https" if ns.tls_cert else "http"
+    await rt.advertise_metrics(
+        "frontend", f"{scheme}://{rt.advertise_address.split(':')[0]}:{port}")
     grpc_srv = None
     if ns.grpc_port is not None:
         from dynamo_tpu.frontend.kserve_grpc import KServeGrpcServer

@@ -18,7 +18,7 @@ from dynamo_tpu.planner.connector import ProcessConnector, VirtualConnector
 from dynamo_tpu.planner.interpolator import (
     DecodeInterpolator, PrefillInterpolator, synthetic_profile)
 from dynamo_tpu.planner.planner_core import Planner, PlannerConfig
-from dynamo_tpu.planner.scrape import FrontendScraper
+from dynamo_tpu.planner.scrape import AggregatorScraper, FrontendScraper
 from dynamo_tpu.transports.client import CoordinatorClient
 from dynamo_tpu.utils.logging import configure_logging, get_logger
 
@@ -28,6 +28,11 @@ log = get_logger("planner.main")
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser("dynamo-planner")
     p.add_argument("--frontend-url", default="http://127.0.0.1:8080")
+    p.add_argument("--fleet-url", default=None,
+                   help="fleet aggregator base URL; when set the planner "
+                        "consumes fleet-wide rollup rates (every frontend) "
+                        "instead of one frontend, and decisions carry the "
+                        "aggregator's SLO snapshot in their reason")
     p.add_argument("--model", default=None)
     p.add_argument("--namespace", default="dynamo")
     p.add_argument("--coordinator", default="tcp://127.0.0.1:6650")
@@ -72,7 +77,11 @@ async def amain(ns: argparse.Namespace) -> None:
         PrefillInterpolator.from_data(data),
         DecodeInterpolator.from_data(data),
     )
-    scraper = FrontendScraper(ns.frontend_url.rstrip("/") + "/metrics", ns.model)
+    if ns.fleet_url is not None:
+        scraper = AggregatorScraper(ns.fleet_url, ns.model)
+    else:
+        scraper = FrontendScraper(ns.frontend_url.rstrip("/") + "/metrics",
+                                  ns.model)
 
     connector = None
     coord = None
@@ -107,9 +116,16 @@ async def amain(ns: argparse.Namespace) -> None:
                 continue
             planner.observe(m)
             decision = planner.plan()
+            reason = decision.reason
+            if isinstance(scraper, AggregatorScraper):
+                # The SLO state that justified this decision travels with
+                # it (VirtualConnector persists reason to the coordinator).
+                slo = scraper.slo_reason()
+                if slo:
+                    reason = f"{reason} | {slo}"
             if connector is not None:
                 await connector.apply(decision.prefill_replicas,
-                                      decision.decode_replicas, decision.reason)
+                                      decision.decode_replicas, reason)
     finally:
         if isinstance(connector, ProcessConnector):
             connector.shutdown()

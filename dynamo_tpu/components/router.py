@@ -108,6 +108,9 @@ async def amain(ns: argparse.Namespace) -> None:
 
     ep = rt.namespace(ns.namespace).component(ns.component).endpoint(ns.endpoint)
     await ep.serve(handler)
+    # Fleet aggregator discovery: the router's metrics (route_decisions etc.)
+    # live on its status server when DYN_SYSTEM_ENABLED is set.
+    await rt.advertise_metrics("router")
     log.info("router ready: %s -> %s", ns.endpoint, ns.target)
     print(f"ROUTER_READY target={ns.target}", flush=True)
 

@@ -518,6 +518,9 @@ async def amain(ns: argparse.Namespace) -> None:
             # k8s readiness mirrors the canary state (reference: the system
             # status server consumes SystemHealth the same way).
             rt.status_server.set_ready_fn(lambda: monitor.ready)
+        # Fleet aggregator discovery: publish this worker's status-server
+        # /metrics under the coordinator's metrics prefix (lease-bound).
+        await rt.advertise_metrics("worker")
 
     metrics_pub = WorkerMetricsPublisher(
         rt.client, ns.namespace, ns.component, rt.instance_id, stats_fn)

@@ -212,9 +212,28 @@ def build_plan(doc: dict, engine_override: str | None = None,
         args=fe_args, replicas=int(fe.get("replicas", 1)),
         ready_line="FRONTEND_READY"))
 
+    agg_port = None
+    if spec.get("aggregator", {}).get("enabled"):
+        ag = spec["aggregator"]
+        agg_port = int(ag.get("port", 9090))
+        ag_args = ["--coordinator", url, "--port", str(agg_port)]
+        for key, flag in (("scrapeInterval", "--scrape-interval"),
+                          ("scrapeTimeout", "--scrape-timeout"),
+                          ("stalenessTtl", "--staleness-ttl"),
+                          ("sloSpec", "--slo-spec")):
+            if key in ag:
+                ag_args += [flag, str(ag[key])]
+        plan.processes.append(Process(
+            name="aggregator", module="dynamo_tpu.components.aggregator",
+            args=ag_args, ready_line="AGGREGATOR_READY"))
+
     if spec.get("planner", {}).get("enabled"):
         pl = spec["planner"]
         pl_args = ["--coordinator", url]
+        if agg_port is not None:
+            # Close the SLA loop: the planner consumes the aggregator's
+            # fleet-wide rollup instead of a single frontend.
+            pl_args += ["--fleet-url", f"http://127.0.0.1:{agg_port}"]
         for key, flag in (("ttftSla", "--ttft-sla"), ("itlSla", "--itl-sla"),
                           ("minReplicas", "--min-replicas"),
                           ("maxReplicas", "--max-replicas"),

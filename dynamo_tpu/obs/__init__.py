@@ -12,6 +12,14 @@ aggregates without an external trace backend.
 """
 
 from dynamo_tpu.obs.bridge import SpanMetricsBridge
+from dynamo_tpu.obs.fleet import (
+    DEFAULT_SLO_SPECS,
+    EwmaAnomaly,
+    FleetAggregator,
+    SloEngine,
+    SloSpec,
+    parse_slo_specs,
+)
 from dynamo_tpu.obs.costmodel import (
     HardwareSpec,
     KernelCost,
@@ -35,8 +43,14 @@ from dynamo_tpu.obs.tracer import (
 )
 
 __all__ = [
+    "DEFAULT_SLO_SPECS",
     "TRACE_KEY",
+    "EwmaAnomaly",
+    "FleetAggregator",
     "FlightRecorder",
+    "SloEngine",
+    "SloSpec",
+    "parse_slo_specs",
     "HardwareSpec",
     "KernelCost",
     "PerfMetrics",

@@ -11,6 +11,7 @@ from dataclasses import dataclass
 
 INSTANCE_PREFIX = "dyn/instances"
 MODEL_PREFIX = "dyn/models"
+METRICS_PREFIX = "dyn/metrics"
 
 
 @dataclass(frozen=True)
@@ -36,6 +37,43 @@ class EndpointId:
 
     def instance_key(self, instance_id: int) -> str:
         return f"{self.instance_prefix}{instance_id:016x}"
+
+
+@dataclass(frozen=True)
+class MetricsTarget:
+    """A scrapeable /metrics endpoint, registered under METRICS_PREFIX and
+    bound to its owner's primary lease: lease death deletes the key, so the
+    fleet aggregator's discovery view tracks process liveness with no
+    static target lists (reference: the Prometheus service-discovery role
+    etcd registration plays for the reference metrics aggregator)."""
+
+    role: str           # "frontend" | "worker" | "router" | ...
+    instance_id: int
+    url: str            # http base URL; <url>/metrics serves the exposition
+    namespace: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{METRICS_PREFIX}/{self.namespace}/{self.role}/{self.instance_id:016x}"
+
+    @property
+    def instance(self) -> str:
+        """Stable per-target label value for the fleet exposition."""
+        return self.url.split("//", 1)[-1].rstrip("/")
+
+    def to_bytes(self) -> bytes:
+        return json.dumps({
+            "role": self.role,
+            "instance_id": self.instance_id,
+            "url": self.url,
+            "namespace": self.namespace,
+        }).encode()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "MetricsTarget":
+        d = json.loads(data)
+        return cls(role=d["role"], instance_id=d["instance_id"],
+                   url=d["url"], namespace=d.get("namespace", ""))
 
 
 @dataclass(frozen=True)

@@ -31,7 +31,7 @@ from typing import Any, AsyncIterator, Callable
 
 from dynamo_tpu.transports.client import CoordinatorClient, Lease
 from dynamo_tpu.transports.wire import Frame, MsgpackConnection
-from dynamo_tpu.runtime.protocols import EndpointId, Instance
+from dynamo_tpu.runtime.protocols import EndpointId, Instance, MetricsTarget
 from dynamo_tpu.utils.config import RuntimeConfig
 from dynamo_tpu.utils.logging import get_logger
 from dynamo_tpu.utils.metrics import MetricsRegistry
@@ -93,6 +93,7 @@ class DistributedRuntime:
             "streams", max_concurrency=self.config.max_handler_streams)
         self._draining = False
         self._reconnect_hooks: list = []
+        self._metrics_targets: dict[str, MetricsTarget] = {}
         # Per-process system status server (reference:
         # system_status_server.rs), env-gated DYN_SYSTEM_ENABLED/PORT.
         self.status_server = None
@@ -204,6 +205,9 @@ class DistributedRuntime:
                 served.endpoint.instance_key(self.instance_id),
                 served.instance.to_bytes(),
                 lease_id=self.primary_lease.id)
+        for target in self._metrics_targets.values():
+            await self.client.put(target.key, target.to_bytes(),
+                                  lease_id=self.primary_lease.id)
         log.info("re-registered %d endpoint(s) after coordinator reconnect",
                  len(self._served))
         for hook in list(self._reconnect_hooks):
@@ -216,6 +220,24 @@ class DistributedRuntime:
         """Register an async callback run after coordinator reconnection +
         instance re-registration (components re-put model cards here)."""
         self._reconnect_hooks.append(hook)
+
+    async def advertise_metrics(self, role: str, url: str | None = None) -> "MetricsTarget | None":
+        """Publish this process's /metrics URL under METRICS_PREFIX, bound
+        to the primary lease, so the fleet aggregator discovers it without
+        static target lists. ``url=None`` advertises the status server (a
+        no-op when DYN_SYSTEM_ENABLED is off — nothing to scrape)."""
+        assert self.client and self.primary_lease
+        if url is None:
+            if self.status_server is None:
+                return None
+            url = f"http://{self._advertise_host}:{self.status_server.port}"
+        target = MetricsTarget(role=role, instance_id=self.instance_id,
+                               url=url, namespace=self.config.namespace)
+        self._metrics_targets[target.key] = target
+        await self.client.put(target.key, target.to_bytes(),
+                              lease_id=self.primary_lease.id)
+        log.info("advertised %s metrics target %s", role, url)
+        return target
 
     @property
     def advertise_address(self) -> str:

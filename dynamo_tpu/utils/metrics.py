@@ -16,10 +16,11 @@ repr-stable formatter.
 from __future__ import annotations
 
 import math
+import re
 import threading
 from collections import defaultdict
 from dataclasses import dataclass, field
-from typing import Callable, Iterable
+from typing import Callable, Iterable, Mapping
 
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
@@ -170,6 +171,96 @@ class Histogram:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} {self.kind}"
         yield from self.samples()
+
+
+# ---------------------------------------------------------------------------
+# Parsing — the inverse of expose(). One parser for every scraper in the
+# tree (planner, chaos invariants, loadgen, fleet aggregator) so label-value
+# escaping has exactly one encoder and one decoder.
+# ---------------------------------------------------------------------------
+
+Sample = dict[tuple[str, frozenset], float]
+
+_SAMPLE_NAME = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_LABEL = re.compile(r'\s*([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"((?:[^"\\]|\\.)*)"\s*(,|\})')
+_UNESCAPE = re.compile(r"\\(.)")
+
+
+def _unescape_label_value(v: str) -> str:
+    """Inverse of _escape_label_value: \\n -> newline, \\" -> ", \\\\ -> \\."""
+    return _UNESCAPE.sub(
+        lambda m: "\n" if m.group(1) == "n" else m.group(1), v)
+
+
+def parse_prometheus(text: str) -> Sample:
+    """Prometheus exposition text -> {(name, frozenset(label items)): value}.
+
+    Label values are unescaped, so round-trips through expose() are exact
+    even for values containing quotes, commas, newlines, or backslashes.
+    Comment lines, malformed lines, and non-numeric values are skipped."""
+    out: Sample = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_NAME.match(line)
+        if not m:
+            continue
+        name = m.group(0)
+        pos = m.end()
+        labels: dict[str, str] = {}
+        if line[pos:pos + 1] == "{":
+            pos += 1
+            if line[pos:pos + 1] == "}":  # empty label set: name{} value
+                pos += 1
+            else:
+                while True:
+                    lm = _LABEL.match(line, pos)
+                    if not lm:
+                        pos = -1
+                        break
+                    labels[lm.group(1)] = _unescape_label_value(lm.group(2))
+                    pos = lm.end()
+                    if lm.group(3) == "}":
+                        break
+            if pos < 0:
+                continue
+        rest = line[pos:].split()
+        if not rest:
+            continue
+        try:
+            value = float(rest[0])
+        except ValueError:
+            continue
+        out[(name, frozenset(labels.items()))] = value
+    return out
+
+
+def metric_sum(samples: Mapping[tuple[str, frozenset], float], name: str,
+               **where: str) -> float:
+    """Sum every sample of ``name`` whose labels include ``where``."""
+    want = set(where.items())
+    return sum(v for (n, labels), v in samples.items()
+               if n == name and want <= set(labels))
+
+
+def metrics_url(url: str) -> str:
+    """Normalize a target URL to its /metrics endpoint (idempotent)."""
+    u = url.rstrip("/")
+    return u if u.endswith("/metrics") else f"{u}/metrics"
+
+
+async def fetch_metrics(url: str, timeout_s: float = 10.0) -> Sample:
+    """GET <url>[/metrics] and parse it. Raises on HTTP/connect errors so
+    callers decide whether a dead target is fatal (planner) or counted and
+    tolerated (loadgen, fleet aggregator)."""
+    import aiohttp  # deferred: the registry itself stays dependency-free
+
+    async with aiohttp.ClientSession() as s:
+        async with s.get(metrics_url(url),
+                         timeout=aiohttp.ClientTimeout(total=timeout_s)) as resp:
+            resp.raise_for_status()
+            return parse_prometheus(await resp.text())
 
 
 @dataclass

@@ -484,3 +484,11 @@ def test_scenario_slow_rank_stall(chaos_seed):
 
     res = run_scenario("slow_rank_stall", seed=chaos_seed)
     assert res.report.passed, res.report.failures
+
+
+@pytest.mark.slow
+def test_scenario_aggregator_partition(chaos_seed):
+    from dynamo_tpu.chaos.harness import run_scenario
+
+    res = run_scenario("aggregator_partition", seed=chaos_seed)
+    assert res.report.passed, res.report.failures

@@ -1,0 +1,501 @@
+"""Fleet observability plane: parser round-trips, SLO burn-rate math,
+aggregation/staleness semantics, and the process-level e2e.
+
+Reference test model: the SRE-workbook multi-window multi-burn-rate
+examples — every burn rate asserted here is hand-computed from the
+(good, total) snapshots fed to the engine, not read back from the code
+under test.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from dynamo_tpu.obs.fleet import (
+    DEFAULT_SLO_SPECS,
+    EwmaAnomaly,
+    FleetAggregator,
+    SloEngine,
+    SloSpec,
+    parse_slo_specs,
+)
+from dynamo_tpu.runtime.protocols import METRICS_PREFIX, MetricsTarget
+from dynamo_tpu.utils.metrics import (
+    MetricsRegistry,
+    metric_sum,
+    metrics_url,
+    parse_prometheus,
+)
+
+
+# -- shared parser: the inverse of expose() ---------------------------------
+
+def test_parse_round_trips_hostile_label_values():
+    """Quotes, commas, newlines, and backslashes in label values must
+    survive expose() -> parse_prometheus() exactly (the old ad-hoc parsers
+    split label bodies on ',' and broke on all of these)."""
+    hostile = [
+        'we"ird, name\nline',
+        'tab\\and\\"both"',
+        ',leading,commas,',
+        'plain',
+        '\\n is two chars, \n is one',
+    ]
+    reg = MetricsRegistry()
+    c = reg.counter("fleet_test_total", "round-trip test counter")
+    for i, v in enumerate(hostile):
+        c.inc(float(i + 1), model=v, route="chat")
+    sample = parse_prometheus(reg.expose())
+    for i, v in enumerate(hostile):
+        key = ("dynamo_fleet_test_total",
+               frozenset({("model", v), ("route", "chat")}.copy()))
+        assert sample[key] == float(i + 1), v
+
+
+def test_parse_round_trips_gauge_histogram_and_empty_labels():
+    reg = MetricsRegistry()
+    g = reg.gauge("fleet_test_gauge", "g")
+    g.set(2.5, slo='a"b')
+    h = reg.histogram("fleet_test_seconds", "h", buckets=(0.1, 1.0))
+    h.observe(0.05, phase="p,1")
+    h.observe(5.0, phase="p,1")
+    reg.counter("fleet_test_empty_total", "never incremented")
+    sample = parse_prometheus(reg.expose())
+    assert sample[("dynamo_fleet_test_gauge",
+                   frozenset({("slo", 'a"b')}))] == 2.5
+    assert sample[("dynamo_fleet_test_seconds_bucket",
+                   frozenset({("phase", "p,1"), ("le", "0.1")}))] == 1.0
+    assert sample[("dynamo_fleet_test_seconds_bucket",
+                   frozenset({("phase", "p,1"), ("le", "+Inf")}))] == 2.0
+    assert sample[("dynamo_fleet_test_seconds_count",
+                   frozenset({("phase", "p,1")}))] == 2.0
+    # a counter with no increments exposes a bare 0 sample
+    assert sample[("dynamo_fleet_test_empty_total", frozenset())] == 0.0
+
+
+def test_metric_sum_and_metrics_url():
+    sample = parse_prometheus(
+        'x_total{a="1",b="2"} 3\nx_total{a="1",b="3"} 4\nx_total{a="2"} 5\n')
+    assert metric_sum(sample, "x_total") == 12.0
+    assert metric_sum(sample, "x_total", a="1") == 7.0
+    assert metric_sum(sample, "x_total", a="1", b="3") == 4.0
+    assert metric_sum(sample, "y_total") == 0.0
+    assert metrics_url("http://h:1") == "http://h:1/metrics"
+    assert metrics_url("http://h:1/") == "http://h:1/metrics"
+    assert metrics_url("http://h:1/metrics") == "http://h:1/metrics"
+
+
+# -- SLO spec parsing --------------------------------------------------------
+
+def test_parse_slo_specs_valid():
+    specs = parse_slo_specs(json.dumps({"slos": [
+        {"name": "ttft_p95", "kind": "latency", "target": 0.95,
+         "histogram": "dynamo_frontend_time_to_first_token_seconds",
+         "threshold_s": 2.0},
+        {"name": "availability", "kind": "availability", "target": 0.999},
+    ]}))
+    assert [s.name for s in specs] == ["ttft_p95", "availability"]
+    assert specs[0].budget == pytest.approx(0.05)
+    assert specs[1].budget == pytest.approx(0.001)
+
+
+@pytest.mark.parametrize("doc", [
+    {"slos": []},
+    {"slos": [{"name": "x", "kind": "nope", "target": 0.9}]},
+    {"slos": [{"name": "x", "kind": "latency", "target": 0.9}]},  # no histogram
+    {"slos": [{"name": "x", "kind": "availability", "target": 1.5}]},
+])
+def test_parse_slo_specs_rejects(doc):
+    with pytest.raises(ValueError):
+        parse_slo_specs(json.dumps(doc))
+
+
+# -- SLO burn-rate engine (hand-computed) ------------------------------------
+
+SPEC = SloSpec(name="ttft_p95", kind="latency", target=0.95,
+               histogram="h", threshold_s=2.0)  # budget 0.05
+
+
+def make_engine():
+    return SloEngine([SPEC], registry=MetricsRegistry())
+
+
+def test_burn_rate_hand_computed_windows():
+    e = make_engine()
+    e.observe("ttft_p95", 0, 0, t=0.0)
+    e.observe("ttft_p95", 900, 1000, t=3300.0)
+    # both windows reach back to t=0: error rate 100/1000 = 0.1, /0.05 = 2.0
+    assert e.burn_rate("ttft_p95", "5m") == pytest.approx(2.0)
+    assert e.burn_rate("ttft_p95", "1h") == pytest.approx(2.0)
+    e.observe("ttft_p95", 900, 1100, t=3600.0)
+    # 5m window [3300, 3600]: 100 new requests, 0 good -> 1.0 / 0.05 = 20
+    assert e.burn_rate("ttft_p95", "5m") == pytest.approx(20.0)
+    # 1h window [0, 3600]: 200 bad of 1100 -> (200/1100) / 0.05
+    assert e.burn_rate("ttft_p95", "1h") == pytest.approx(
+        (200.0 / 1100.0) / 0.05)
+
+
+def test_fast_window_page_fires_on_rising_edge_only():
+    e = make_engine()
+    e.observe("ttft_p95", 0, 0, t=0.0)
+    e.observe("ttft_p95", 0, 1000, t=3600.0)  # all bad: burn 20 in 5m AND 1h
+    out = e.evaluate()
+    assert out["ttft_p95"]["page"] is True
+    assert e.c_violations.get(slo="ttft_p95", severity="page") == 1.0
+    e.evaluate()  # sustained breach: still paging, NOT a second violation
+    assert e.c_violations.get(slo="ttft_p95", severity="page") == 1.0
+    # recovery: a clean 5m window clears the page
+    e.observe("ttft_p95", 1000, 2000, t=3900.0)
+    assert e.evaluate()["ttft_p95"]["page"] is False
+    # second breach -> second rising edge, but only once BOTH fast windows
+    # burn again: at t=4200 the 1h window burns (2000/3000)/0.05 = 13.3 < 14.4
+    e.observe("ttft_p95", 1000, 3000, t=4200.0)
+    assert e.burn_rate("ttft_p95", "5m") == pytest.approx(20.0)
+    assert e.burn_rate("ttft_p95", "1h") == pytest.approx(
+        (2000.0 / 3000.0) / 0.05)
+    assert e.evaluate()["ttft_p95"]["page"] is False
+    e.observe("ttft_p95", 1000, 4000, t=4500.0)  # 1h: (3000/4000)/0.05 = 15
+    assert e.evaluate()["ttft_p95"]["page"] is True
+    assert e.c_violations.get(slo="ttft_p95", severity="page") == 2.0
+
+
+def test_slow_window_warn_without_page():
+    e = make_engine()
+    e.observe("ttft_p95", 0, 0, t=0.0)
+    e.observe("ttft_p95", 0, 9000, t=18000.0)
+    e.observe("ttft_p95", 300, 9300, t=18300.0)
+    # 5m window [18000, 18300] is clean -> no page despite the 1h burn
+    assert e.burn_rate("ttft_p95", "5m") == pytest.approx(0.0)
+    burn_long = (9000.0 / 9300.0) / 0.05  # ~19.35, same base snapshot (t=0)
+    assert e.burn_rate("ttft_p95", "1h") == pytest.approx(burn_long)
+    assert e.burn_rate("ttft_p95", "6h") == pytest.approx(burn_long)
+    out = e.evaluate()
+    assert out["ttft_p95"]["page"] is False
+    assert out["ttft_p95"]["warn"] is True
+    assert e.c_violations.get(slo="ttft_p95", severity="warn") == 1.0
+    assert e.c_violations.get(slo="ttft_p95", severity="page") == 0.0
+
+
+def test_budget_remaining_and_exhaustion():
+    e = make_engine()
+    assert e.budget_remaining("ttft_p95") == 1.0  # no data yet
+    e.observe("ttft_p95", 0, 0, t=0.0)
+    e.observe("ttft_p95", 975, 1000, t=100.0)
+    # error rate 0.025 of a 0.05 budget -> half the budget left
+    assert e.budget_remaining("ttft_p95") == pytest.approx(0.5)
+    e2 = make_engine()
+    e2.observe("ttft_p95", 0, 0, t=0.0)
+    e2.observe("ttft_p95", 0, 1000, t=100.0)  # error rate 1.0 >> budget
+    assert e2.budget_remaining("ttft_p95") == 0.0
+    assert e2.evaluate()["ttft_p95"]["budget_remaining"] == 0.0
+
+
+def test_engine_prunes_history_but_keeps_window_base():
+    e = make_engine()
+    for i in range(100):
+        e.observe("ttft_p95", i * 10, i * 10, t=float(i * 1000))
+    series = e._state["ttft_p95"].series
+    # horizon is max-window (6h) + 1s behind the newest snapshot
+    assert series[0][0] >= 99000.0 - 21601.0 - 1000.0
+    assert len(series) < 100
+    assert e.burn_rate("ttft_p95", "6h") == pytest.approx(0.0)
+
+
+# -- EWMA anomaly detector ---------------------------------------------------
+
+def test_ewma_flags_spike_after_warmup():
+    a = EwmaAnomaly(min_samples=5)
+    flagged = [a.observe(("k",), v)
+               for v in (1.0, 1.1, 0.9, 1.05, 0.95, 1.0)]
+    assert all(f is None for f in flagged), "warmup/steady must not flag"
+    rec = a.observe(("k",), 5.0)
+    assert rec is not None and rec["value"] == 5.0
+    # a different key has its own state: no flag on first sight
+    assert a.observe(("other",), 5.0) is None
+
+
+# -- FleetAggregator (fake client + fake fetch) ------------------------------
+
+class FakeClient:
+    def __init__(self):
+        self.kv: dict[str, bytes] = {}
+
+    async def get_prefix(self, prefix: str) -> dict[str, bytes]:
+        return {k: v for k, v in self.kv.items() if k.startswith(prefix)}
+
+
+FRONTEND_TEXT = """
+dynamo_frontend_requests_total{route="chat",status="200"} 8
+dynamo_frontend_requests_total{route="chat",status="500"} 2
+dynamo_frontend_requests_total{route="health",status="200"} 99
+dynamo_qos_admitted_total{model="m"} 10
+dynamo_frontend_time_to_first_token_seconds_bucket{le="1.0"} 7
+dynamo_frontend_time_to_first_token_seconds_bucket{le="2.5"} 9
+dynamo_frontend_time_to_first_token_seconds_bucket{le="+Inf"} 10
+dynamo_frontend_time_to_first_token_seconds_count 10
+dynamo_frontend_time_to_first_token_seconds_sum 6.0
+"""
+
+WORKER_TEXT = """
+dynamo_engine_perf_mfu 0.31
+dynamo_engine_perf_step_seconds_count 100
+"""
+
+
+def _put_target(client: FakeClient, role: str, iid: int, url: str) -> MetricsTarget:
+    t = MetricsTarget(role=role, instance_id=iid, url=url, namespace="dynamo")
+    client.kv[t.key] = t.to_bytes()
+    return t
+
+
+def make_agg(clock_box):
+    client = FakeClient()
+    _put_target(client, "frontend", 1, "http://10.0.0.1:8080")
+    _put_target(client, "worker", 2, "http://10.0.0.2:9001")
+    _put_target(client, "worker", 3, "http://10.0.0.3:9002")
+    agg = FleetAggregator(client, namespace="dynamo", staleness_ttl_s=5.0,
+                          clock=lambda: clock_box[0])
+    return client, agg
+
+
+async def test_aggregator_discovers_rolls_up_and_degrades(monkeypatch):
+    clock_box = [100.0]
+    client, agg = make_agg(clock_box)
+    dead: set[str] = set()
+
+    async def fake_fetch(url, timeout_s=10.0):
+        if url in dead:
+            raise ConnectionError("connection refused")
+        return parse_prometheus(
+            FRONTEND_TEXT if "8080" in url else WORKER_TEXT)
+
+    monkeypatch.setattr("dynamo_tpu.obs.fleet.fetch_metrics", fake_fetch)
+    await agg.scrape_once()
+
+    # discovery: all three targets, from the prefix, no static lists
+    assert len(agg.targets) == 3
+    assert {st.target.role for st in agg.targets.values()} == \
+        {"frontend", "worker"}
+
+    # rollup equals the sum of per-target scrapes
+    rollup = agg.fleet_sample()
+    assert metric_sum(rollup, "dynamo_engine_perf_mfu") == pytest.approx(0.62)
+    assert metric_sum(rollup, "dynamo_qos_admitted_total") == 10.0
+
+    # exposition: per-target series labeled, rollups under instance=_fleet,
+    # and for every re-exposed family the two layers sum identically
+    sample = parse_prometheus(agg.expose())
+    own = ("dynamo_fleet_", "dynamo_slo_")
+    names = {n for (n, _) in sample if not n.startswith(own)}
+    assert names, "no re-exposed families"
+    for name in names:
+        per_target = sum(
+            v for (n, labels), v in sample.items()
+            if n == name and ("instance", "_fleet") not in labels)
+        assert metric_sum(sample, name, instance="_fleet") == \
+            pytest.approx(per_target), name
+    assert metric_sum(sample, "dynamo_engine_perf_mfu",
+                      instance="10.0.0.2:9001", role="worker") == \
+        pytest.approx(0.31)
+
+    # SLO counts from the rollup: availability ignores non-generate routes;
+    # latency good = cumulative count at the smallest le >= threshold
+    avail = next(s for s in DEFAULT_SLO_SPECS if s.kind == "availability")
+    assert agg._slo_counts(avail, rollup) == (8.0, 10.0)
+    ttft = next(s for s in DEFAULT_SLO_SPECS if s.name == "ttft_p95")
+    assert agg._slo_counts(ttft, rollup) == (9.0, 10.0)
+
+    # one worker dies: stale label + error counter, survivors stay fresh
+    dead.add("http://10.0.0.3:9002")
+    clock_box[0] += 6.0  # past staleness_ttl since its last success
+    await agg.scrape_once()
+    info = agg.debug_info()
+    by_inst = {t["instance"]: t for t in info["targets"]}
+    assert by_inst["10.0.0.3:9002"]["fresh"] is False
+    assert by_inst["10.0.0.3:9002"]["last_error"]
+    assert by_inst["10.0.0.1:8080"]["fresh"] is True
+    assert by_inst["10.0.0.2:9001"]["fresh"] is True
+    assert agg.c_scrape_errors.get(instance="10.0.0.3:9002") >= 1.0
+    # stale data degrades, it does not vanish: last-known sample still rolls
+    assert metric_sum(agg.fleet_sample(),
+                      "dynamo_engine_perf_mfu") == pytest.approx(0.62)
+    text = agg.expose()
+    assert 'instance="10.0.0.3:9002",role="worker",stale="1"' in text
+
+    # deregistration (lease death) + grace expiry drops the target
+    dead_key = next(k for k, st in agg.targets.items()
+                    if st.target.instance == "10.0.0.3:9002")
+    del client.kv[dead_key]
+    clock_box[0] += 6.0
+    await agg.scrape_once()
+    assert dead_key not in agg.targets
+    assert len(agg.targets) == 2
+
+
+async def test_aggregator_survives_fetch_chaos(monkeypatch):
+    """Every scrape failing is a data point, never a crash."""
+    clock_box = [0.0]
+    _, agg = make_agg(clock_box)
+
+    async def explode(url, timeout_s=10.0):
+        raise RuntimeError("boom")
+
+    monkeypatch.setattr("dynamo_tpu.obs.fleet.fetch_metrics", explode)
+    await agg.scrape_once()
+    assert len(agg.targets) == 3
+    assert metric_sum(parse_prometheus(agg.registry.expose()),
+                      "dynamo_fleet_scrape_errors_total") == 3.0
+    assert agg.debug_info()["targets"][0]["fresh"] is False
+
+
+# -- AggregatorScraper: planner feed ----------------------------------------
+
+FLEET_TEXT_T0 = """
+dynamo_frontend_model_requests_total{instance="_fleet",model="m"} 10
+dynamo_frontend_input_tokens_total{instance="_fleet",model="m"} 1000
+dynamo_frontend_output_tokens_total{instance="_fleet",model="m"} 400
+dynamo_slo_error_budget_remaining{slo="ttft_p95"} 0.82
+dynamo_slo_burn_rate{slo="ttft_p95",window="5m"} 0.4
+dynamo_slo_burn_rate{slo="ttft_p95",window="1h"} 0.2
+"""
+
+FLEET_TEXT_T1 = """
+dynamo_frontend_model_requests_total{instance="_fleet",model="m"} 14
+dynamo_frontend_model_requests_total{instance="10.0.0.1:8080",model="m"} 9
+dynamo_frontend_input_tokens_total{instance="_fleet",model="m"} 1400
+dynamo_frontend_output_tokens_total{instance="_fleet",model="m"} 600
+dynamo_slo_error_budget_remaining{slo="ttft_p95"} 0.75
+dynamo_slo_burn_rate{slo="ttft_p95",window="5m"} 1.25
+dynamo_slo_burn_rate{slo="ttft_p95",window="1h"} 0.5
+"""
+
+
+async def test_aggregator_scraper_rates_and_slo_reason(monkeypatch):
+    from dynamo_tpu.planner.scrape import AggregatorScraper
+
+    scraper = AggregatorScraper("http://agg:9090", "m")
+    assert scraper.url == "http://agg:9090/metrics"
+    texts = iter([FLEET_TEXT_T0, FLEET_TEXT_T1])
+
+    async def fake_fetch(self):
+        return parse_prometheus(next(texts))
+
+    monkeypatch.setattr(AggregatorScraper, "fetch", fake_fetch)
+    first = await scraper.observe_interval()
+    assert first.num_req == 0  # baseline scrape
+    m = await scraper.observe_interval()
+    # deltas restricted to the rollup: the per-instance series (9) is NOT
+    # double counted next to instance="_fleet" (14-10=4)
+    assert m.num_req == pytest.approx(4.0)
+    assert m.isl == pytest.approx(100.0)
+    assert m.osl == pytest.approx(50.0)
+    snap = scraper.slo_snapshot()
+    assert snap["ttft_p95"]["budget_remaining"] == pytest.approx(0.75)
+    assert snap["ttft_p95"]["burn_rate_5m"] == pytest.approx(1.25)
+    reason = scraper.slo_reason()
+    assert reason == "slo[ttft_p95 budget=0.75 burn5m=1.25 burn1h=0.50]"
+
+
+# -- process e2e: coordinator + workers + frontend + aggregator + planner ----
+
+def _fleet_rollup_consistent(text: str) -> bool:
+    sample = parse_prometheus(text)
+    own = ("dynamo_fleet_", "dynamo_slo_")
+    names = {n for (n, _) in sample if not n.startswith(own)}
+    if not names:
+        return False
+    for name in names:
+        per_target = sum(
+            v for (n, labels), v in sample.items()
+            if n == name and ("instance", "_fleet") not in labels)
+        if abs(metric_sum(sample, name, instance="_fleet") - per_target) > 1e-6:
+            return False
+    return True
+
+
+def test_fleet_e2e_discovery_rollup_staleness_and_planner():
+    """The acceptance path in one fleet: aggregator discovers every process
+    through the coordinator (no static config), its rollup equals the sum
+    of per-target scrapes, killing one worker flips freshness without
+    dropping the others, and a planner fed by --fleet-url produces a
+    Decision whose persisted reason embeds the SLO snapshot."""
+    import asyncio
+
+    from dynamo_tpu.chaos.harness import (
+        FleetConfig, MockerFleet, Proc, free_port, http_json)
+    from dynamo_tpu.transports.client import CoordinatorClient
+
+    cfg = FleetConfig(workers=2, aggregator=True,
+                      scrape_interval_s=0.3, staleness_ttl_s=2.0)
+    planner = None
+    with MockerFleet(cfg) as fleet:
+        try:
+            # discovery without static target lists
+            info = fleet.wait_fleet_fresh(3)
+            roles = sorted(t["role"] for t in info["targets"])
+            assert roles == ["frontend", "worker", "worker"]
+
+            fleet.drive_load(n=6, concurrency=3)
+            fleet.wait_drained()
+
+            # rollup equals the sum of per-target scrapes (one expose() is
+            # internally consistent; retry across sweeps for a non-empty one)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if _fleet_rollup_consistent(fleet.aggregator_metrics_text()):
+                    break
+                time.sleep(0.3)
+            else:
+                pytest.fail("fleet rollup never matched per-target sums")
+
+            # planner consumes the aggregator and stamps decisions with SLOs
+            planner = Proc(
+                ["-m", "dynamo_tpu.components.planner",
+                 "--coordinator", fleet.coord_url,
+                 "--fleet-url", fleet.agg_base,
+                 "--mode", "virtual", "--adjustment-interval", "1"],
+                name="planner").start()
+            planner.wait_for_line("PLANNER_READY", 30)
+
+            async def read_decision():
+                c = await CoordinatorClient.connect(fleet.coord_url)
+                try:
+                    v = await c.get("planner/decisions/dynamo")
+                    return json.loads(v) if v else None
+                finally:
+                    await c.close()
+
+            decision = None
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                decision = asyncio.run(read_decision())
+                if decision and "slo[" in decision.get("reason", ""):
+                    break
+                time.sleep(0.5)
+            assert decision, "planner never wrote a decision"
+            assert "slo[" in decision["reason"], decision
+            assert "budget=" in decision["reason"], decision
+
+            # kill one worker: its target flips stale, the rest stay fresh
+            fleet.workers[1].kill_hard()
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                info = fleet.fleet_debug()
+                fresh = [t for t in info["targets"] if t["fresh"]]
+                stale = [t for t in info["targets"] if not t["fresh"]]
+                if len(stale) == 1 and len(fresh) == 2:
+                    break
+                time.sleep(0.3)
+            else:
+                pytest.fail(f"staleness never flipped: {info['targets']}")
+            assert stale[0]["role"] == "worker"
+            assert {t["role"] for t in fresh} == {"frontend", "worker"}
+            assert fleet.aggregator.alive()
+            # the aggregator keeps serving while degraded
+            assert http_json(fleet.agg_base + "/health")["status"] == "ready"
+        finally:
+            if planner is not None:
+                planner.stop()

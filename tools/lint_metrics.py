@@ -112,6 +112,26 @@ RING_PREFILL_METRICS = (
     "ring_prefill_threshold_tokens",
 )
 
+# The fleet-aggregation family (obs/fleet.py FleetAggregator): scrape
+# attempts/failures, target freshness, and sweep latency. Same
+# bidirectional drift rule as KV_TRANSFER_METRICS.
+FLEET_METRICS = (
+    "fleet_scrapes_total",
+    "fleet_scrape_errors_total",
+    "fleet_targets",
+    "fleet_scrape_seconds",
+)
+
+# The SLO burn-rate family (obs/fleet.py SloEngine): error-budget gauges
+# plus the rising-edge violation counter. Same bidirectional drift rule
+# as KV_TRANSFER_METRICS (both families register in obs/fleet.py, so one
+# check covers FLEET_METRICS + SLO_METRICS together).
+SLO_METRICS = (
+    "slo_error_budget_remaining",
+    "slo_burn_rate",
+    "slo_violations_total",
+)
+
 # The failure-recovery family: health canaries (runtime/health.py),
 # migration re-dispatch (frontend/migration.py), and chaos injection
 # (chaos/metrics.py). Same bidirectional drift rule as KV_TRANSFER_METRICS:
@@ -356,6 +376,61 @@ def _lint_ring_prefill_metrics(root: Path, problems: list[str]) -> None:
             "does not register it")
 
 
+def _lint_fleet_metrics(root: Path, problems: list[str]) -> None:
+    """FLEET_METRICS + SLO_METRICS together must match what obs/fleet.py
+    actually registers — same no-silent-drift rule as KV_TRANSFER_METRICS.
+    A name in the wrong family is caught by the prefix rule: the fleet
+    family is fleet_*, the SLO family slo_*."""
+    actual = _registered_names(root / "obs" / "fleet.py")
+    if actual is None:
+        return
+    for key in SLO_METRICS:
+        if not key.startswith("slo_"):
+            problems.append(
+                f"SLO_METRICS declares {key!r} which is not slo_*-prefixed")
+    for key in FLEET_METRICS:
+        if not key.startswith("fleet_"):
+            problems.append(
+                f"FLEET_METRICS declares {key!r} which is not "
+                "fleet_*-prefixed")
+    declared = set(FLEET_METRICS) | set(SLO_METRICS)
+    for key in sorted(actual - declared):
+        family = "SLO_METRICS" if key.startswith("slo_") else "FLEET_METRICS"
+        problems.append(
+            f"obs/fleet.py registers {key!r} but it is missing from "
+            f"tools/lint_metrics.py {family}")
+    for key in sorted(declared - actual):
+        problems.append(
+            f"FLEET_METRICS/SLO_METRICS declare {key!r} but obs/fleet.py "
+            "does not register it")
+
+
+def _lint_family_overlap(problems: list[str]) -> None:
+    """No metric name may appear in two declared families: a duplicate
+    means two modules would register (or two dashboards would grep) the
+    same dynamo_<name> series with different meanings."""
+    families: dict[str, tuple[str, ...]] = {
+        "KV_TRANSFER_METRICS": KV_TRANSFER_METRICS,
+        "PERF_METRICS": PERF_METRICS,
+        "PREFIX_CACHE_METRICS": PREFIX_CACHE_METRICS,
+        "SESSION_METRICS": SESSION_METRICS,
+        "RING_PREFILL_METRICS": RING_PREFILL_METRICS,
+        "FLEET_METRICS": FLEET_METRICS,
+        "SLO_METRICS": SLO_METRICS,
+        **{f"RECOVERY_METRICS[{'/'.join(parts)}]": names
+           for parts, names in RECOVERY_METRICS.items()},
+    }
+    seen: dict[str, str] = {}
+    for family, names in families.items():
+        for name in names:
+            if name in seen:
+                problems.append(
+                    f"metric {name!r} declared in both {seen[name]} and "
+                    f"{family} — families must not overlap")
+            else:
+                seen[name] = family
+
+
 def _lint_recovery_metrics(root: Path, problems: list[str]) -> None:
     """The recovery family must match what each module actually registers
     — same no-silent-drift rule as KV_TRANSFER_METRICS."""
@@ -415,7 +490,9 @@ def lint_tree(root: Path | None = None) -> list[str]:
     _lint_perf_labels(root, problems)
     _lint_session_metrics(root, problems)
     _lint_ring_prefill_metrics(root, problems)
+    _lint_fleet_metrics(root, problems)
     _lint_recovery_metrics(root, problems)
+    _lint_family_overlap(problems)
     return problems
 
 
