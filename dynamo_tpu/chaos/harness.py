@@ -145,6 +145,7 @@ class FleetConfig:
     worker_env: dict[str, str] = field(default_factory=dict)
     frontend_env: dict[str, str] = field(default_factory=dict)
     worker_args: list[str] = field(default_factory=list)
+    kv_store: bool = False                    # spawn a G4 remote block store
     aggregator: bool = False                  # spawn a fleet aggregator
     aggregator_env: dict[str, str] = field(default_factory=dict)
     scrape_interval_s: float = 0.5            # aggregator sweep cadence
@@ -163,6 +164,8 @@ class MockerFleet:
         self.coordinator: Proc | None = None
         self.workers: list[Proc] = []
         self.frontend: Proc | None = None
+        self.kv_store: Proc | None = None
+        self.kv_port = free_port() if cfg.kv_store else 0
         self.aggregator: Proc | None = None
         self.agg_port = free_port() if cfg.aggregator else 0
         self.agg_base = f"http://127.0.0.1:{self.agg_port}"
@@ -187,6 +190,8 @@ class MockerFleet:
         return env
 
     def start_worker(self, i: int) -> Proc:
+        extra = (["--remote-kv-addr", f"127.0.0.1:{self.kv_port}"]
+                 if self.cfg.kv_store else [])
         w = Proc(
             ["-m", "dynamo_tpu.components.worker", "--engine", "mocker",
              "--coordinator", self.coord_url,
@@ -194,7 +199,7 @@ class MockerFleet:
              "--speedup-ratio", str(self.cfg.speedup_ratio),
              "--max-model-len", str(self.cfg.max_model_len),
              "--num-blocks", str(self.cfg.num_blocks),
-             *self.cfg.worker_args],
+             *extra, *self.cfg.worker_args],
             name=f"worker{i}", env=self._worker_env()).start()
         return w
 
@@ -203,6 +208,12 @@ class MockerFleet:
             ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
              "--port", str(self.coord_port)], name="coordinator").start()
         self.coordinator.wait_for_line("COORDINATOR_READY", 20)
+        if self.cfg.kv_store:
+            self.kv_store = Proc(
+                ["-m", "dynamo_tpu.components.kv_store", "--host", "127.0.0.1",
+                 "--port", str(self.kv_port)],
+                name="kv_store", env=self._common_env()).start()
+            self.kv_store.wait_for_line("KV_STORE_READY", 20)
         self.workers = [self.start_worker(i) for i in range(self.cfg.workers)]
         for w in self.workers:
             w.wait_for_line("WORKER_READY", 30)
@@ -244,6 +255,8 @@ class MockerFleet:
             self.frontend.stop()
         for w in self.workers:
             w.stop()
+        if self.kv_store:
+            self.kv_store.stop()
         if self.coordinator:
             self.coordinator.stop()
 
@@ -335,6 +348,31 @@ class MockerFleet:
 
         with concurrent.futures.ThreadPoolExecutor(concurrency) as ex:
             return list(ex.map(one, range(n)))
+
+    def complete(self, prompt: str, rid: str, session: str | None = None,
+                 max_tokens: int = 8, timeout: float = 30.0,
+                 ) -> tuple[StreamOutcome, str]:
+        """One completion with optional session affinity; returns the
+        classified outcome plus the generated text (so a follow-up turn
+        can extend the conversation — the ByteTokenizer is prefix-stable,
+        so ``prompt + text`` re-hashes to the same block chain)."""
+        headers = {"x-request-id": rid}
+        if session is not None:
+            headers["x-session-id"] = session
+        try:
+            r = http_json(self.base + "/v1/completions", {
+                "model": "tiny-llama", "prompt": prompt,
+                "max_tokens": max_tokens, "ignore_eos": True,
+            }, timeout=timeout, headers=headers)
+            choice = r["choices"][0]
+            fr = choice.get("finish_reason")
+            if fr:
+                return StreamOutcome(rid, "finished", fr), choice.get("text") or ""
+            return StreamOutcome(rid, "lost", "no finish_reason"), ""
+        except urllib.error.HTTPError as exc:
+            return StreamOutcome(rid, "error", f"http {exc.code}"), ""
+        except Exception as exc:  # noqa: BLE001 - transport-level loss
+            return StreamOutcome(rid, "lost", f"{type(exc).__name__}: {exc}"), ""
 
 
 @dataclass
@@ -551,6 +589,178 @@ def scenario_aggregator_partition(seed: int = 1234) -> ScenarioResult:
         return res
 
 
+def scenario_retire_under_load(seed: int = 1234,
+                               quick: bool = False) -> ScenarioResult:
+    """Drain-aware retirement end to end (runtime/drain.py): a worker
+    holding retained sessions AND live streams is retired while a fresh
+    replica serves on. The drain must lose zero streams, evacuate every
+    session to the G4 store, and turn N+1 of each session must land on
+    the survivor as a warm resume (remote record hit), not a recompute.
+    ``quick=True`` is the sub-30s tier-1 smoke shape."""
+    n_sessions = 2 if quick else 4
+    n_bg = 3 if quick else 8
+    cfg = FleetConfig(
+        workers=1, kv_store=True, speedup_ratio=50.0, lease_ttl_s=3.0,
+        # TTL far beyond the scenario: retention must survive until the
+        # drain evacuates it (pop_oldest ignores TTL); both workers drain
+        # at the end, so no sweep is needed for the leak check either.
+        worker_args=["--session-ttl", "120",
+                     "--drain-deadline", "6" if quick else "12"])
+    with MockerFleet(cfg) as fleet:
+        outcomes: list[StreamOutcome] = []
+        turn1: dict[str, str] = {}
+        # Turn 1: every session lands on worker0 (the only worker).
+        for s in range(n_sessions):
+            sid = f"sess-{s}"
+            prompt = f"retire scenario session {s} context " * 3
+            o, text = fleet.complete(prompt, f"turn1-{s}", session=sid)
+            outcomes.append(o)
+            turn1[sid] = prompt + text
+
+        # Scale up, then retire worker0 mid-traffic.
+        fleet.workers.append(fleet.start_worker(1))
+        fleet.workers[1].wait_for_line("WORKER_READY", 30)
+        victim = fleet.workers[0]
+        bg_out: list[StreamOutcome] = []
+        bg = threading.Thread(target=lambda: bg_out.extend(
+            fleet.drive_load(n=n_bg, max_tokens=16, concurrency=2,
+                             timeout=60.0)))
+        bg.start()
+        time.sleep(0.2)  # let some streams land on the victim first
+        victim.proc.send_signal(signal.SIGTERM)
+        drained_line = victim.wait_for_line("WORKER_DRAINED", 40)
+        bg.join(90)
+        outcomes.extend(bg_out)
+        victim.proc.wait(10)
+
+        # Turn 2: the retired worker is gone — each session's next turn
+        # must resume warm on the survivor from the evacuated record.
+        for s in range(n_sessions):
+            sid = f"sess-{s}"
+            o, _ = fleet.complete(turn1[sid] + " and then", f"turn2-{s}",
+                                  session=sid, timeout=60.0)
+            outcomes.append(o)
+        # the survivor's resume counters reach /engine_stats on its next
+        # publish tick — poll briefly instead of racing one snapshot
+        stats: dict = {}
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            stats = fleet.engine_stats()
+            probe = InvariantChecker()
+            probe.check_warm_resume(stats, minimum=n_sessions)
+            if probe.report.passed:
+                break
+            time.sleep(0.25)
+
+        # Retire the survivor too: its retained turn-2 pins evacuate and
+        # release, so the leak check sees a fully quiesced fleet.
+        survivor = fleet.workers[1]
+        survivor.proc.send_signal(signal.SIGTERM)
+        survivor_line = survivor.wait_for_line("WORKER_DRAINED", 40)
+        survivor.proc.wait(10)
+
+        res = _finish("retire_under_load", fleet, outcomes, seed=seed)
+        warm = InvariantChecker()
+        warm.report = res.report
+        warm.check_warm_resume(stats, minimum=n_sessions)
+
+        def parse_drained(line: str) -> dict:
+            try:
+                return json.loads(line.split("WORKER_DRAINED", 1)[1].strip())
+            except Exception:
+                return {}
+
+        report = parse_drained(drained_line)
+        res.report.details["drain_report"] = report
+        # Routers forget retired workers, so exit-time occupancy from the
+        # terminal reports is the leak check for the two drained processes.
+        leaked = [r for r in (report, parse_drained(survivor_line))
+                  if r.get("final_kv_usage", 0) > 1e-9
+                  or r.get("final_num_running", 0)]
+        if leaked:
+            res.report.fail(f"retired worker exited with pinned KV: {leaked}")
+        else:
+            res.report.ok("retired_workers_quiesced")
+        if report.get("state") != "done":
+            res.report.fail(f"drain did not complete: {report}")
+        else:
+            res.report.ok("drain_completed")
+        if report.get("evacuated_sessions", 0) < n_sessions:
+            res.report.fail(
+                f"evacuated {report.get('evacuated_sessions', 0)} of "
+                f"{n_sessions} retained sessions")
+        else:
+            res.report.ok("all_sessions_evacuated")
+        if victim.proc.returncode != 0:
+            res.report.fail(
+                f"retired worker exited rc={victim.proc.returncode} "
+                "(SIGKILL escalation?)")
+        else:
+            res.report.ok("retired_worker_clean_exit")
+        return res
+
+
+def scenario_scale_during_partition(seed: int = 1234) -> ScenarioResult:
+    """Scale-down while the coordinator is PARTITIONED away: the retiring
+    worker cannot delete its membership keys or write its status — the
+    drain must still complete locally within its bounded windows and exit
+    rc 0 (no SIGKILL), and because every registration is lease-bound the
+    dead worker's keys vanish on lease expiry: never a half-deregistered
+    ghost. Traffic mid-partition migrates off the refusing worker."""
+    cfg = FleetConfig(workers=2, lease_ttl_s=3.0, speedup_ratio=50.0,
+                      worker_args=["--drain-deadline", "6"])
+    with MockerFleet(cfg) as fleet:
+        pre = fleet.drive_load(n=4, concurrency=2)
+        # Published snapshots must show idle BEFORE the partition: during
+        # it no publishes flow, so the frontend's last view of the retiring
+        # worker has to be a quiesced one.
+        fleet.wait_drained()
+
+        fleet.coordinator.kill_hard()
+        victim = fleet.workers[1]
+        victim.proc.send_signal(signal.SIGTERM)
+        # Streams the stale frontend still routes at the draining worker
+        # are refused (typed ERR) and migrate to the survivor.
+        mid = fleet.drive_load(n=4, concurrency=2, timeout=60.0)
+        drained_line = victim.wait_for_line("WORKER_DRAINED", 45)
+        victim.proc.wait(15)
+
+        fleet.coordinator = Proc(
+            ["-m", "dynamo_tpu.transports.coordinator", "--host", "127.0.0.1",
+             "--port", str(fleet.coord_port)], name="coordinator2").start()
+        fleet.coordinator.wait_for_line("COORDINATOR_READY", 20)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                if http_json(fleet.base + "/v1/models")["data"]:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        post = fleet.drive_load(n=4, concurrency=2, timeout=60.0)
+
+        res = _finish("scale_during_partition", fleet, pre + mid + post,
+                      seed=seed)
+        try:
+            report = json.loads(
+                drained_line.split("WORKER_DRAINED", 1)[1].strip())
+        except Exception:
+            report = {}
+        res.report.details["drain_report"] = report
+        if report.get("state") not in ("done", "aborted"):
+            res.report.fail(f"drain neither completed nor cleanly "
+                            f"aborted: {report}")
+        else:
+            res.report.ok("drain_bounded_under_partition")
+        if victim.proc.returncode != 0:
+            res.report.fail(
+                f"partitioned drain exited rc={victim.proc.returncode} "
+                "(escalation instead of a bounded local drain)")
+        else:
+            res.report.ok("clean_exit_under_partition")
+        return res
+
+
 SCENARIOS: dict[str, Callable[[int], ScenarioResult]] = {
     "smoke": scenario_smoke,
     "worker_kill": scenario_worker_kill,
@@ -558,6 +768,10 @@ SCENARIOS: dict[str, Callable[[int], ScenarioResult]] = {
     "lease_expiry_storm": scenario_lease_expiry_storm,
     "slow_rank_stall": scenario_slow_rank_stall,
     "aggregator_partition": scenario_aggregator_partition,
+    "retire_under_load": scenario_retire_under_load,
+    "retire_under_load_smoke": lambda seed=1234: scenario_retire_under_load(
+        seed, quick=True),
+    "scale_during_partition": scenario_scale_during_partition,
 }
 
 

@@ -159,6 +159,29 @@ class InvariantChecker:
         if not diverged:
             self.report.ok("spmd_op_streams_identical")
 
+    # -- warm resume -------------------------------------------------------
+    def check_warm_resume(self, engine_stats: Mapping[str, Any],
+                          minimum: int = 1) -> None:
+        """After a drained worker evacuated its retained sessions
+        (runtime/drain.py), surviving workers must have resumed at least
+        ``minimum`` session turns from the remote records — retirement
+        converts would-be full recomputes into pull-to-warm imports.
+        ``engine_stats`` is the frontend /engine_stats JSON."""
+        resumes = hits = 0
+        for stats in engine_stats.values():
+            for m in (stats.get("workers") or {}).values():
+                if isinstance(m, Mapping):
+                    resumes += int(m.get("session_remote_resumes", 0) or 0)
+                    hits += int(m.get("session_hits", 0) or 0)
+        self.report.details["warm_resume"] = {
+            "session_remote_resumes": resumes, "session_hits": hits}
+        if resumes < minimum:
+            self.report.fail(
+                f"no warm resume: {resumes} session turn(s) resumed from "
+                f"evacuated records (needed >= {minimum})")
+        else:
+            self.report.ok("sessions_resumed_warm")
+
     # -- metrics balance ---------------------------------------------------
     def check_metrics_balance(self, metrics_text: str) -> None:
         """shed + completed + failed == admitted + shed, from the frontend's

@@ -53,6 +53,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="process mode: argv tail for prefill workers")
     p.add_argument("--decode-worker-args", default=None,
                    help="process mode: argv tail for decode workers")
+    p.add_argument("--drain-deadline", type=float, default=30.0,
+                   help="process mode: seconds a retiring worker gets to "
+                        "drain before the connector escalates "
+                        "(abort signal, then SIGKILL)")
     return p.parse_args(argv)
 
 
@@ -91,9 +95,21 @@ async def amain(ns: argparse.Namespace) -> None:
     elif ns.mode == "process":
         if ns.decode_worker_args is None:
             raise SystemExit("--mode process requires --decode-worker-args")
+        # A coordinator client upgrades scale-down from plain SIGTERM to
+        # the drain-key handshake (reason + deadline travel with the
+        # decision); without one the signal path still drains gracefully.
+        try:
+            coord = await asyncio.wait_for(
+                CoordinatorClient.connect(ns.coordinator), 3.0)
+        except Exception:
+            log.warning("coordinator unreachable; process connector will "
+                        "retire workers via signals only")
+            coord = None
         connector = ProcessConnector(
             shlex.split(ns.prefill_worker_args) if ns.prefill_worker_args else None,
-            shlex.split(ns.decode_worker_args))
+            shlex.split(ns.decode_worker_args),
+            client=coord, namespace=ns.namespace,
+            drain_deadline=ns.drain_deadline)
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -128,7 +144,7 @@ async def amain(ns: argparse.Namespace) -> None:
                                       decision.decode_replicas, reason)
     finally:
         if isinstance(connector, ProcessConnector):
-            connector.shutdown()
+            await connector.shutdown()
         if coord is not None:
             await coord.close()
 

@@ -88,6 +88,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--health-interval", type=float, default=5.0,
                    help="idle seconds before a health canary replays through "
                         "the handler (reference: health_check.rs); 0 disables")
+    p.add_argument("--drain-deadline", type=float, default=30.0,
+                   help="retirement: seconds in-flight streams get to finish "
+                        "after SIGTERM / a planner drain request before "
+                        "being force-stopped (runtime/drain.py)")
+    p.add_argument("--drain-batch-grace", type=float, default=None,
+                   help="retirement: seconds before batch-class streams are "
+                        "early-stopped during a drain (default: half the "
+                        "deadline)")
     p.add_argument("--wedgeable", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--chaos-plan", default=None,
                    help="enable deterministic fault injection: a ChaosPlan "
@@ -507,6 +515,19 @@ async def amain(ns: argparse.Namespace) -> None:
         def stats_fn():  # noqa: F811
             return {**base_stats(), "ready": monitor.ready}
 
+    # While draining, published stats advertise NotReady so routers with a
+    # stale membership view stop picking this worker even before the
+    # instance-key DELETE propagates (kv_router health gating).
+    drain_state = {"draining": False}
+    inner_stats = stats_fn
+
+    def stats_fn():  # noqa: F811
+        s = dict(inner_stats())
+        if drain_state["draining"]:
+            s["ready"] = False
+            s["draining"] = True
+        return s
+
     ep = rt.namespace(ns.namespace).component(ns.component).endpoint(ns.endpoint)
     await ep.serve(handler)
     if monitor is not None:
@@ -544,22 +565,114 @@ async def amain(ns: argparse.Namespace) -> None:
              ns.engine, name, ns.disagg, rt.instance_id)
     print(f"WORKER_READY instance={rt.instance_id:016x}", flush=True)
 
+    # -- retirement (runtime/drain.py) ---------------------------------
+    # First SIGTERM/SIGINT starts a graceful drain: membership out, bounded
+    # run-down, session-KV evacuation. A SECOND signal aborts the drain
+    # (skip waiting + evacuation, bounded fast exit). A planner drain
+    # request on the coordinator key starts the same protocol with its own
+    # reason/deadline.
+    from dynamo_tpu.runtime.drain import (
+        DrainRequest,
+        WorkerDrainer,
+        drain_key,
+        drain_status_key,
+        install_drain_metrics,
+    )
+
+    install_drain_metrics(rt.metrics)
     stop = asyncio.Event()
+    abort = asyncio.Event()
+    drain_req = DrainRequest(reason="signal")
     loop = asyncio.get_running_loop()
+
+    def on_signal() -> None:
+        if not stop.is_set():
+            stop.set()
+        else:
+            log.warning("second signal: aborting drain, fast exit")
+            abort.set()
+
     for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, stop.set)
+        loop.add_signal_handler(sig, on_signal)
+
+    async def watch_drain_key() -> None:
+        key = drain_key(ns.namespace, rt.instance_id)
+        while True:
+            try:
+                raw = await rt.client.get(key)
+            except Exception:
+                raw = None  # coordinator unreachable; signals still work
+            if raw is not None:
+                try:
+                    req = DrainRequest.from_bytes(raw)
+                except Exception:
+                    req = DrainRequest(reason="planner")
+                drain_req.reason = req.reason or "planner"
+                drain_req.deadline_s = req.deadline_s
+                stop.set()
+                return
+            await asyncio.sleep(0.5)
+
+    watcher = asyncio.create_task(watch_drain_key())
     await stop.wait()
-    log.info("worker draining")
+    watcher.cancel()
+
+    async def deregister() -> None:
+        drain_state["draining"] = True
+        await rt.deregister()
+        if ns.disagg != "prefill":
+            try:
+                await asyncio.wait_for(rt.client.delete(
+                    f"{MODEL_PREFIX}/{name}/{rt.instance_id:016x}"), 3.0)
+            except Exception:
+                log.warning("model card delete failed; lease expiry will")
+
+    drainer = WorkerDrainer(
+        inflight=lambda: rt.inflight_streams,
+        deregister=deregister,
+        evacuate=getattr(engine, "evacuate_sessions", None),
+        abort_batch=(lambda: engine.abort_class("batch"))
+        if hasattr(engine, "abort_class") else None,
+        abort_all=(lambda: engine.abort_class(None))
+        if hasattr(engine, "abort_class") else None,
+        abort_event=abort,
+        deadline_s=ns.drain_deadline,
+        batch_grace_s=ns.drain_batch_grace,
+    )
+    report = await drainer.drain(reason=drain_req.reason,
+                                 deadline_s=drain_req.deadline_s)
     if monitor is not None:
         await monitor.stop()
     if op_channel is not None:
         op_channel.close()  # followers see EOF and drain
+    # Final snapshot: the retired worker's LAST published stats show it
+    # idle/NotReady (aggregate views would otherwise keep its stale busy
+    # numbers forever), then the terminal drain report lands on the
+    # non-lease-bound status key for the planner to read post-exit.
+    await metrics_pub.publish_once()
     await metrics_pub.stop()
+    # The terminal report carries the engine's exit-time occupancy: routers
+    # forget deregistered workers, so this line (and the status key) is the
+    # only place a leak in a RETIRED worker stays observable.
+    terminal = report.to_dict()
+    try:
+        final = dict(stats_fn())
+        terminal["final_kv_usage"] = float(final.get("kv_usage", 0.0) or 0.0)
+        terminal["final_num_running"] = int(final.get("num_running", 0) or 0)
+    except Exception:
+        pass
+    try:
+        await asyncio.wait_for(rt.client.put(
+            drain_status_key(ns.namespace, rt.instance_id),
+            json.dumps(terminal).encode()), 3.0)
+    except Exception:
+        log.warning("drain status publish failed (coordinator unreachable?)")
     if kv_source is not None:
         await kv_source.stop()
     if publisher:
         await publisher.stop()
     await rt.shutdown()
+    print(f"WORKER_DRAINED {json.dumps(terminal)}", flush=True)
 
 
 def main() -> None:

@@ -90,6 +90,9 @@ class EngineMetrics:
     # QoS: requests cancelled because their deadline passed (either while
     # waiting — before any prefill — or mid-decode via the stop check).
     deadline_cancelled: int = 0
+    # Session turns that resumed from a drain-evacuated remote record
+    # (pull-to-warm after another worker retired, runtime/drain.py).
+    session_remote_resumes: int = 0
     # KV-cache footprint (set once at engine construction): total device
     # bytes of the paged cache and whether int8 KV quantization is on —
     # exported as dynamo_engine_kv_cache_bytes / dynamo_engine_kv_quant_enabled.
@@ -113,6 +116,7 @@ class EngineMetrics:
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,
             "deadline_cancelled": self.deadline_cancelled,
+            "session_remote_resumes": self.session_remote_resumes,
         }
 
 
@@ -1037,6 +1041,19 @@ class EngineCore:
             sm.lookups.inc()
             if self.sessions.claim(seq.session_id, self._step_now) is not None:
                 sm.hits.inc()
+            else:
+                # No local turn retained: a drained worker may have parked
+                # the session in the remote store. A record hit means the
+                # kvbm.onboard below pulls the evacuated chain back warm —
+                # count it as a (remote) session hit for the chaos
+                # invariants and the dynamo_session_* family.
+                remote = self._remote_tier()
+                if (remote is not None
+                        and getattr(remote, "get_session", None) is not None
+                        and remote.get_session(seq.session_id)):
+                    sm.hits.inc()
+                    sm.remote_resumes.inc()
+                    self.metrics.session_remote_resumes += 1
         if self.kvbm is not None:
             # Same matchable cap as the scheduler: leave ≥1 prompt token to
             # compute so decode has last-position state. Onboarding is an
@@ -1534,6 +1551,71 @@ class EngineCore:
         self.pool.release(entry.pinned)
         entry.pinned = []
 
+    def _remote_tier(self):
+        """The shared remote tier in the KVBM ladder, or None."""
+        if self.kvbm is None:
+            return None
+        for tier in self.kvbm.tiers:
+            if getattr(tier, "name", "") == "remote":
+                return tier
+        return None
+
+    def evacuate_sessions(self, _args: dict | None = None) -> dict:
+        """Drain step 4 (runtime/drain.py): push every retained session's
+        device chain plus a resumable record to the shared remote store,
+        then release the pins — turn N+1 on a surviving worker pulls the
+        chain back warm instead of recomputing. Engine-core thread only
+        (CORE_OPS "session_evacuate"). Multi-host engines fall back to the
+        tier-ladder demotion: each rank holds only its KV shard, and a
+        shard written to the SHARED store would corrupt cross-worker reads.
+        """
+        out = {"sessions": 0, "blocks": 0, "bytes": 0}
+        if self.sessions is None:
+            return out
+        remote = self._remote_tier()
+        direct = remote is not None and jax.process_count() == 1
+        while True:
+            popped = self.sessions.pop_oldest()
+            if popped is None:
+                break
+            sid, entry = popped
+            try:
+                if direct and entry.pinned:
+                    blocks = self.transfer.extract(
+                        self.runner.cache_k, self.runner.cache_v, entry.pinned)
+                    for h, block in zip(entry.seq_hashes, blocks):
+                        remote.put(h, block)
+                        out["blocks"] += 1
+                        out["bytes"] += int(getattr(block, "nbytes", 0))
+                    if remote.put_session(sid, list(entry.seq_hashes),
+                                          entry.tokens):
+                        out["sessions"] += 1
+                elif (self.kvbm is not None and entry.pinned):
+                    # No direct path: stage down the local ladder so at least
+                    # a restart of THIS worker re-imports instead of
+                    # recomputing. No resumable record — survivors can't
+                    # reach these blocks.
+                    self.kvbm.stage_blocks(
+                        list(zip(entry.pinned, entry.seq_hashes)))
+            except Exception:
+                log.exception("session %s evacuation failed; its blocks fall "
+                              "to the LRU", sid)
+            self.pool.release(entry.pinned)
+            entry.pinned = []
+        return out
+
+    def abort_class(self, priority: str | None = None) -> list[str]:
+        """Abort every live request of one QoS class (None = all) — the
+        drain run-down's early-stop valve (runtime/drain.py abort_batch /
+        abort_all). Returns the aborted request ids so the async wrapper
+        can emit their terminal CANCELLED outputs."""
+        rids = [rid for rid, seq in self._seqs.items()
+                if seq.phase is not Phase.FINISHED
+                and (priority is None or seq.qos_priority == priority)]
+        for rid in rids:
+            self.abort(rid)
+        return rids
+
     def step(self) -> dict[str, LLMEngineOutput]:
         """Run one engine step synchronously; returns per-request deltas."""
         now = time.time()
@@ -2018,6 +2100,11 @@ CORE_OPS: dict[str, Callable[["EngineCore", dict], Any]] = {
     "kv_import_wave": lambda core, a: core.import_remote(
         a["params"], a["start"], a["stop"], a.get("final", False)),
     "kv_pull_abort": lambda core, a: core.close_pull(a["xfer_id"]),
+    # Drain-aware retirement (runtime/drain.py): evacuate retained
+    # sessions to the remote store; early-stop a QoS class's streams.
+    "session_evacuate": lambda core, a: core.evacuate_sessions(a),
+    "qos_abort_class": lambda core, a: core.abort_class(
+        a.get("priority") if a else None),
 }
 
 
@@ -2296,6 +2383,25 @@ class AsyncJaxEngine:
         """Embeddings via the engine-core thread (serialized with steps —
         device state has one owner)."""
         return await self.run_in_core(lambda core: core.embed(token_lists))
+
+    # -- drain-aware retirement (runtime/drain.py) ---------------------
+    async def evacuate_sessions(self) -> dict:
+        """Push retained session KV + resumable records to the remote
+        store (multi-host-safe: rides the op stream)."""
+        return await self.run_op("session_evacuate", {})
+
+    async def abort_class(self, priority: str | None = None) -> int:
+        """Early-stop every live stream of one QoS class (None = all),
+        emitting their terminal CANCELLED outputs. Returns the count."""
+        rids = await self.run_op("qos_abort_class", {"priority": priority})
+        for rid in rids or []:
+            self._post(rid, LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
+        return len(rids or [])
+
+    @property
+    def inflight(self) -> int:
+        """Streams with a live output queue (drain run-down's gauge)."""
+        return len(self._streams)
 
     def stats(self) -> dict:
         out = self.core.metrics.snapshot(self.core.sched, self.core.pool)

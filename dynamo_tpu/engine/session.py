@@ -97,6 +97,10 @@ class SessionMetrics:
             "session_demoted_blocks",
             "Session blocks write-staged down the KVBM tier ladder when "
             "their pins were released")
+        self.remote_resumes = registry.counter(
+            "session_remote_resumes",
+            "Session turns resumed from a drain-evacuated remote record "
+            "(pull-to-warm on a surviving worker, runtime/drain.py)")
 
 
 _metrics: SessionMetrics | None = None

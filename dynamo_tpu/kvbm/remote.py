@@ -302,6 +302,48 @@ class RemoteBlockPool:
 
         return ensure_block_format(block, spec=self.spec)
 
+    # -- session records ---------------------------------------------------
+    # Drain evacuation (runtime/drain.py) parks a retired worker's retained
+    # sessions here: the KV blocks go through the normal put() path, and a
+    # tiny resumable record — the committed hash chain — rides the SAME
+    # generic put/get ops under a derived namespace. A surviving worker
+    # that misses a local session claim consults the record; a hit means
+    # the next turn onboards the evacuated blocks (pull-to-warm) instead
+    # of recomputing. Records never collide with block payloads: the "|s"
+    # namespace suffix partitions them, and they bypass get()'s byte-length
+    # format table entirely.
+
+    @staticmethod
+    def _session_hash(session_id: str) -> int:
+        import hashlib
+
+        digest = hashlib.sha256(session_id.encode()).digest()
+        return int.from_bytes(digest[:8], "big")
+
+    def put_session(self, session_id: str, seq_hashes: list[int],
+                    tokens: int = 0) -> bool:
+        rec = msgpack.packb({"hashes": [int(h) for h in seq_hashes],
+                             "tokens": int(tokens), "ts": time.time()},
+                            use_bin_type=True)
+        resp = self._call({"op": "put", "ns": self._ns + "|s",
+                           "h": self._session_hash(session_id), "data": rec})
+        return bool(resp and resp.get("ok"))
+
+    def get_session(self, session_id: str) -> dict | None:
+        """The evacuated record for ``session_id`` — ``{"hashes": [...],
+        "tokens": n, "ts": ...}`` — or None (no record / store down)."""
+        resp = self._call({"op": "get", "ns": self._ns + "|s",
+                           "h": self._session_hash(session_id)})
+        data = resp.get("data") if resp else None
+        if data is None:
+            return None
+        try:
+            rec = msgpack.unpackb(data, raw=False)
+        except Exception:
+            log.warning("undecodable session record for %r", session_id)
+            return None
+        return rec if isinstance(rec, dict) else None
+
     def __contains__(self, seq_hash: int) -> bool:
         resp = self._call({"op": "has", "ns": self._ns, "h": seq_hash})
         return bool(resp and resp.get("has"))

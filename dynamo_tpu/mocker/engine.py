@@ -113,6 +113,8 @@ class MockEngine:
         self.prefix_lookups = 0
         self.steps = 0
         self.deadline_cancelled = 0
+        self.session_hits = 0
+        self.session_remote_resumes = 0
         # Session retention mirror — the same store the JAX engine wires up.
         self.sessions: SessionStore | None = None
         if self.args.session_ttl > 0 and self.args.enable_prefix_caching:
@@ -299,6 +301,7 @@ class MockEngine:
                     continue
                 hashes = seq.block_seq.sequence_hashes()
                 matchable = max((len(seq.req.token_ids) - 1) // a.block_size, 0)
+                claimed = False
                 if self.sessions is not None and seq.session_id is not None:
                     # Turn N+1: release the retained pins so the chain is
                     # matchable; the match below re-references it (same
@@ -307,9 +310,24 @@ class MockEngine:
                     sm.lookups.inc()
                     if self.sessions.claim(seq.session_id,
                                            time.monotonic()) is not None:
+                        claimed = True
+                        self.session_hits += 1
                         sm.hits.inc()
                 matched = self.pool.match_prefix(hashes[:matchable])
-                matched += self._import_remote(hashes[:matchable], matched)
+                imported = self._import_remote(hashes[:matchable], matched)
+                matched += imported
+                if (not claimed and imported and self.sessions is not None
+                        and seq.session_id is not None
+                        and self.remote is not None
+                        and self.remote.get_session(seq.session_id)):
+                    # The previous holder drained away and parked this
+                    # session in the remote store: the chain just came back
+                    # via the import — a warm resume, not a recompute.
+                    sm = get_session_metrics()
+                    sm.hits.inc()
+                    sm.remote_resumes.inc()
+                    self.session_hits += 1
+                    self.session_remote_resumes += 1
                 need = -(-len(seq.req.token_ids) // a.block_size) - len(matched)
                 try:
                     fresh = self.pool.allocate(max(need, 0))
@@ -434,6 +452,59 @@ class MockEngine:
             seq.block_ids = []
 
     # ------------------------------------------------------------------
+    def abort_class(self, priority: str | None = None) -> int:
+        """Early-stop every stream (waiting + running) of one QoS class
+        (``None`` = all classes) — the drain run-down's QoS valve
+        (runtime/drain.py: batch-class work yields the drain window to
+        interactive streams). Each stream gets a terminal CANCELLED, so
+        nothing is lost — just cut short."""
+        n = 0
+        for seq in [s for s in self.waiting if not s.done
+                    and (priority is None or s.priority == priority)]:
+            self.waiting.remove(seq)
+            seq.done = True
+            self._trace_close(seq, status="cancelled")
+            seq.queue.put_nowait(
+                LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
+            n += 1
+        for seq in [s for s in self.running if not s.done
+                    and (priority is None or s.priority == priority)]:
+            seq.queue.put_nowait(
+                LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
+            self._finish(seq, FinishReason.CANCELLED)
+            n += 1
+        if n:
+            log.info("early-stopped %d %s stream(s)", n, priority or "ALL")
+        return n
+
+    def evacuate_sessions(self) -> dict:
+        """Drain step 4 (runtime/drain.py): push every retained session's
+        committed chain — blocks AND the resumable record — to the shared
+        remote store, then release the pins. The mocker's stand-in payloads
+        carry real hash-keyed accounting, so a surviving mocker's
+        admission-time import finds the evacuated chain exactly like a JAX
+        engine would."""
+        out = {"sessions": 0, "blocks": 0, "bytes": 0}
+        if self.sessions is None:
+            return out
+        while True:
+            popped = self.sessions.pop_oldest()
+            if popped is None:
+                break
+            sid, entry = popped
+            if self.remote is not None and entry.seq_hashes:
+                for h in entry.seq_hashes:
+                    self.remote.put(h, self._payload)
+                    out["blocks"] += 1
+                    out["bytes"] += self._payload.nbytes
+                if self.remote.put_session(sid, list(entry.seq_hashes),
+                                           entry.tokens):
+                    out["sessions"] += 1
+            self.pool.release(entry.pinned)
+            entry.pinned = []
+        return out
+
+    # ------------------------------------------------------------------
     def stats(self) -> dict:
         """ForwardPassMetrics-shaped stats (reference: publisher.rs:686)."""
         return {
@@ -446,7 +517,9 @@ class MockEngine:
             "deadline_cancelled": self.deadline_cancelled,
             "prefix_cache_imported_blocks": self.imported_blocks,
             "prefix_cache_published_blocks": self.published_blocks,
-            **({"session": self.sessions.snapshot()}
+            **({"session": self.sessions.snapshot(),
+                "session_hits": self.session_hits,
+                "session_remote_resumes": self.session_remote_resumes}
                if self.sessions is not None else {}),
         }
 

@@ -107,14 +107,20 @@ class WorkerMetricsPublisher:
 
     async def _loop(self) -> None:
         while not self._stopped:
-            try:
-                stats = dict(self.stats_fn())
-                stats["worker_id"] = self.worker_id
-                await self.client.publish(
-                    self.subject, msgpack.packb(stats, use_bin_type=True))
-            except Exception:
-                log.exception("metrics publish failed")
+            await self.publish_once()
             await asyncio.sleep(self.interval_s)
+
+    async def publish_once(self) -> None:
+        """One immediate publish. The drain path calls this after the
+        engine empties so the retired worker's LAST snapshot in aggregate
+        views (/engine_stats) shows it idle, not frozen mid-load."""
+        try:
+            stats = dict(self.stats_fn())
+            stats["worker_id"] = self.worker_id
+            await self.client.publish(
+                self.subject, msgpack.packb(stats, use_bin_type=True))
+        except Exception:
+            log.exception("metrics publish failed")
 
     async def stop(self) -> None:
         self._stopped = True

@@ -62,6 +62,7 @@ class _WorkerConnection:
         self._streams: dict[int, asyncio.Queue] = {}
         self._reader = asyncio.create_task(self._read_loop())
         self.alive = True
+        self._closing = False
 
     async def _read_loop(self) -> None:
         try:
@@ -85,6 +86,8 @@ class _WorkerConnection:
     async def call(self, endpoint: str, payload: Any, request_id: str,
                    headers: dict | None = None) -> AsyncIterator[Any]:
         await chaos.ainject("runtime.client.call", endpoint=endpoint)
+        if self._closing:
+            raise StreamError("connection closing")
         sid = next(self._ids)
         q: asyncio.Queue = asyncio.Queue()
         self._streams[sid] = q
@@ -109,10 +112,21 @@ class _WorkerConnection:
                     await self.conn.send({"t": Frame.CANCEL, "stream_id": sid})
                 except Exception:
                     pass
+            if self._closing and not self._streams:
+                self.close()
 
     def close(self) -> None:
         self._reader.cancel()
         self.conn.close()
+
+    def close_when_idle(self) -> None:
+        """Refuse new streams and close once in-flight ones end. A model
+        being unregistered (its last worker deregistered to drain) must not
+        cut responses already streaming — the draining worker keeps its
+        lease and data plane alive precisely so they can finish."""
+        self._closing = True
+        if not self._streams:
+            self.close()
 
 
 class EndpointClient:
@@ -218,11 +232,18 @@ class EndpointClient:
                 exc.instance_id = instance_id
             raise
 
-    async def close(self) -> None:
+    async def close(self, graceful: bool = True) -> None:
+        """Stop watching and release connections. Graceful (default) lets
+        each connection's in-flight streams run to completion before it
+        closes; ``graceful=False`` cuts them immediately (poisoning their
+        queues with a connection-lost ERR)."""
         if self._watch_task:
             self._watch_task.cancel()
         for wc in self._conns.values():
-            wc.close()
+            if graceful:
+                wc.close_when_idle()
+            else:
+                wc.close()
 
 
 @dataclass

@@ -124,6 +124,36 @@ class DistributedRuntime:
             await rt.status_server.start()
         return rt
 
+    @property
+    def inflight_streams(self) -> int:
+        """Handler streams currently running (drain run-down watches this)."""
+        return self._streams.active
+
+    async def deregister(self, timeout: float = 3.0) -> None:
+        """Membership out, lease and data plane STAY ALIVE: readiness goes
+        NotReady, new streams are refused (clients re-route via Migration),
+        and the endpoint instance + metrics-target keys are deleted so
+        routers stop picking this worker — while in-flight streams keep
+        their open connections. The drain protocol's step 2
+        (runtime/drain.py); ``shutdown()`` later revokes the lease, which
+        also sweeps these keys if the coordinator was unreachable here."""
+        self._draining = True
+        if self.status_server is not None:
+            self.status_server.ready = False
+        if self.client is None:
+            return
+        keys = [s.endpoint.instance_key(self.instance_id)
+                for s in self._served.values()]
+        keys += list(self._metrics_targets)
+        for key in keys:
+            try:
+                # Bounded per-key: a partitioned coordinator must not eat
+                # the drain window — lease expiry deletes these anyway.
+                await asyncio.wait_for(self.client.delete(key), timeout)
+            except Exception:
+                log.warning("deregister: could not delete %s "
+                            "(lease expiry will)", key)
+
     async def shutdown(self) -> None:
         """Graceful: deregister instances, drain in-flight, drop lease."""
         self._draining = True
@@ -134,8 +164,14 @@ class DistributedRuntime:
             self.status_server.ready = False
         if self.client:
             for served in self._served.values():
-                await self.client.delete(
-                    served.endpoint.instance_key(self.instance_id))
+                try:
+                    await asyncio.wait_for(self.client.delete(
+                        served.endpoint.instance_key(self.instance_id)), 3.0)
+                except Exception:
+                    # Partitioned coordinator: the lease sweep below (or its
+                    # TTL expiry server-side) removes the key regardless.
+                    log.warning("shutdown: instance deregistration skipped "
+                                "(coordinator unreachable)")
         deadline = time.monotonic() + self.config.drain_timeout_s
         while self._streams.active and time.monotonic() < deadline:
             await asyncio.sleep(0.05)
@@ -146,7 +182,14 @@ class DistributedRuntime:
         if self.status_server is not None:
             await self.status_server.stop()
         if self.primary_lease and self.client:
-            await self.primary_lease.revoke(self.client)
+            try:
+                # Partition-safe: an unreachable coordinator must not wedge
+                # process exit — the lease TTL expires server-side instead.
+                await asyncio.wait_for(
+                    self.primary_lease.revoke(self.client), 3.0)
+            except Exception:
+                log.warning("lease revoke skipped (coordinator unreachable);"
+                            " TTL expiry will reclaim it")
         if self._server:
             self._server.close()
         if self.client:

@@ -231,6 +231,22 @@ def test_invariant_block_leaks():
     assert "no_leaked_blocks" not in skip.finish().checks
 
 
+def test_invariant_warm_resume():
+    stats = {"m": {"workers": {
+        "w1": {"session_remote_resumes": 2, "session_hits": 3},
+        "w2": {"session_remote_resumes": 0, "session_hits": 1}}}}
+    warm = InvariantChecker()
+    warm.check_warm_resume(stats, minimum=2)
+    rep = warm.finish()
+    assert rep.passed and "sessions_resumed_warm" in rep.checks
+    assert rep.details["warm_resume"]["session_remote_resumes"] == 2
+
+    cold = InvariantChecker()
+    cold.check_warm_resume(stats, minimum=3)
+    rep = cold.finish()
+    assert not rep.passed and "no warm resume" in rep.failures[0]
+
+
 def test_invariant_op_streams():
     same = InvariantChecker()
     same.check_op_streams({0: ["add", "step"], 1: ["add", "step"]})
@@ -491,4 +507,33 @@ def test_scenario_aggregator_partition(chaos_seed):
     from dynamo_tpu.chaos.harness import run_scenario
 
     res = run_scenario("aggregator_partition", seed=chaos_seed)
+    assert res.report.passed, res.report.failures
+
+
+def test_scenario_retire_under_load_smoke(chaos_seed):
+    """Tier-1 (<30s) retirement scenario: a worker is drained mid-traffic;
+    zero streams lost, zero leaked pins, the retired worker's sessions
+    resume WARM on the survivor, and the drain report says "done"."""
+    from dynamo_tpu.chaos.harness import run_scenario
+
+    res = run_scenario("retire_under_load_smoke", seed=chaos_seed)
+    assert res.report.passed, res.report.failures
+    assert res.report.details["streams"]["lost"] == 0
+    assert res.report.details["warm_resume"]["session_remote_resumes"] >= 2
+
+
+@pytest.mark.slow
+def test_scenario_retire_under_load(chaos_seed):
+    from dynamo_tpu.chaos.harness import run_scenario
+
+    res = run_scenario("retire_under_load", seed=chaos_seed)
+    assert res.report.passed, res.report.failures
+    assert res.report.details["streams"]["lost"] == 0
+
+
+@pytest.mark.slow
+def test_scenario_scale_during_partition(chaos_seed):
+    from dynamo_tpu.chaos.harness import run_scenario
+
+    res = run_scenario("scale_during_partition", seed=chaos_seed)
     assert res.report.passed, res.report.failures

@@ -1,0 +1,389 @@
+"""Drain-aware retirement (runtime/drain.py + planner/connector.py):
+the WorkerDrainer state machine (run-down, batch grace, deadline overrun,
+operator abort), the planner→worker handshake payloads, session-record
+evacuation round-trips through the remote store, mocker evacuate→resume
+across two engines, and ProcessConnector lifecycle against real worker
+processes (spawn-to-ready, drain-before-exit, crash-reap + respawn).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import signal
+import time
+
+import pytest
+
+from dynamo_tpu.engine.cache import KVCacheSpec
+from dynamo_tpu.kvbm.remote import RemoteBlockPool
+from dynamo_tpu.runtime.drain import (
+    DrainRequest,
+    WorkerDrainer,
+    drain_key,
+    drain_status_key,
+    get_drain_metrics,
+)
+
+from tests.test_kvbm_remote import StoreFixture
+
+SPEC = KVCacheSpec(num_blocks=8, block_size=4, num_layers=2, num_kv_heads=2,
+                   head_dim=8, dtype="float32")
+
+
+@pytest.fixture()
+def store():
+    s = StoreFixture()
+    yield s
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# Handshake payloads
+# ---------------------------------------------------------------------------
+
+def test_drain_request_roundtrip_and_keys():
+    req = DrainRequest(reason="scale down", deadline_s=12.5, ts=1.0)
+    assert DrainRequest.from_bytes(req.to_bytes()) == req
+    # a bare payload parses to defaults (tolerant of older planners)
+    assert DrainRequest.from_bytes(b"{}") == DrainRequest()
+    k = drain_key("dynamo", 0xBEEF)
+    assert k == "planner/drain/dynamo/000000000000beef"
+    assert drain_status_key("dynamo", 0xBEEF) == k + "/status"
+
+
+# ---------------------------------------------------------------------------
+# WorkerDrainer state machine (transport-free)
+# ---------------------------------------------------------------------------
+
+async def test_drainer_runs_streams_down_then_evacuates():
+    inflight = {"n": 2}
+    calls: list[str] = []
+
+    async def finisher():
+        await asyncio.sleep(0.15)
+        inflight["n"] = 0
+
+    d = WorkerDrainer(
+        inflight=lambda: inflight["n"],
+        deregister=lambda: calls.append("deregister"),
+        evacuate=lambda: {"sessions": 2, "blocks": 5, "bytes": 640},
+        deadline_s=5.0)
+    task = asyncio.create_task(finisher())
+    rep = await d.drain(reason="scale down")
+    await task
+    assert rep.state == "done" and d.state == "done"
+    assert calls == ["deregister"]          # membership out before run-down
+    assert rep.streams_completed == 2 and rep.streams_aborted == 0
+    assert (rep.evacuated_sessions, rep.evacuated_blocks,
+            rep.evacuated_bytes) == (2, 5, 640)
+    assert rep.reason == "scale down" and rep.duration_s > 0
+
+
+async def test_drainer_batch_grace_early_stops_batch_class():
+    inflight = {"n": 3}
+    stopped: list[str] = []
+
+    def abort_batch():
+        stopped.append("batch")
+        inflight["n"] -= 1
+        return 1
+
+    async def finisher():
+        await asyncio.sleep(0.4)
+        inflight["n"] = 0
+
+    d = WorkerDrainer(
+        inflight=lambda: inflight["n"],
+        deregister=lambda: None,
+        abort_batch=abort_batch,
+        deadline_s=5.0, batch_grace_s=0.1)
+    task = asyncio.create_task(finisher())
+    rep = await d.drain()
+    await task
+    assert stopped == ["batch"]             # fired once, at the grace mark
+    assert rep.streams_aborted == 1 and rep.streams_completed == 2
+    assert rep.state == "done"
+
+
+async def test_drainer_deadline_overrun_is_done_not_aborted():
+    """A worker that blows its window still ran the full protocol: the
+    remaining streams are force-stopped and counted, the state stays
+    "done", and evacuation still happens (bounded)."""
+    inflight = {"n": 1}
+    evacuated: list[int] = []
+
+    def abort_all():
+        inflight["n"] = 0
+        return 1
+
+    base_aborted = get_drain_metrics().aborted.get()
+    d = WorkerDrainer(
+        inflight=lambda: inflight["n"],
+        deregister=lambda: None,
+        evacuate=lambda: evacuated.append(1) or {"sessions": 1, "blocks": 1,
+                                                 "bytes": 8},
+        abort_all=abort_all, deadline_s=0.2)
+    rep = await d.drain()
+    assert rep.state == "done"
+    assert rep.streams_aborted == 1 and rep.streams_completed == 0
+    assert evacuated == [1]
+    assert get_drain_metrics().aborted.get() == base_aborted
+
+
+async def test_drainer_operator_abort_skips_wait_and_evacuation():
+    ev = asyncio.Event()
+    evacuated: list[int] = []
+    inflight = {"n": 1}
+
+    def abort_all():
+        inflight["n"] = 0
+        return 1
+
+    async def second_signal():
+        await asyncio.sleep(0.1)
+        ev.set()
+
+    base_aborted = get_drain_metrics().aborted.get()
+    d = WorkerDrainer(
+        inflight=lambda: inflight["n"],
+        deregister=lambda: None,
+        evacuate=lambda: evacuated.append(1) or {},
+        abort_all=abort_all, abort_event=ev, deadline_s=30.0)
+    task = asyncio.create_task(second_signal())
+    t0 = time.monotonic()
+    rep = await d.drain()
+    await task
+    assert rep.state == "aborted" and d.state == "aborted"
+    assert time.monotonic() - t0 < 5.0      # nowhere near the 30s deadline
+    assert not evacuated                    # abort skips evacuation
+    assert rep.streams_aborted == 1
+    assert get_drain_metrics().aborted.get() == base_aborted + 1
+
+
+async def test_drainer_survives_deregister_failure():
+    """Coordinator unreachable mid-partition: deregistration fails but the
+    drain keeps going — lease expiry removes membership atomically."""
+    def bad_deregister():
+        raise ConnectionError("partition")
+
+    d = WorkerDrainer(inflight=lambda: 0, deregister=bad_deregister,
+                      deadline_s=1.0)
+    rep = await d.drain()
+    assert rep.state == "done"
+
+
+async def test_drainer_async_callbacks():
+    """The JAX worker wires coroutine callbacks (AsyncJaxEngine methods);
+    every hook goes through _maybe_await."""
+    inflight = {"n": 1}
+    calls: list[str] = []
+
+    async def dereg():
+        calls.append("dereg")
+
+    async def abort_all():
+        inflight["n"] = 0
+        return 1
+
+    async def evac():
+        calls.append("evac")
+        return {"sessions": 1, "blocks": 2, "bytes": 16}
+
+    d = WorkerDrainer(inflight=lambda: inflight["n"], deregister=dereg,
+                      evacuate=evac, abort_all=abort_all, deadline_s=0.2)
+    rep = await d.drain()
+    assert calls == ["dereg", "evac"]
+    assert rep.state == "done" and rep.evacuated_blocks == 2
+
+
+# ---------------------------------------------------------------------------
+# Session-record evacuation through the remote store
+# ---------------------------------------------------------------------------
+
+def test_session_record_roundtrip(store):
+    pool = RemoteBlockPool(SPEC, store.addr, fingerprint="m")
+    assert pool.get_session("chat-1") is None
+    assert pool.put_session("chat-1", [3, 5, 8], tokens=48)
+    rec = pool.get_session("chat-1")
+    assert rec["hashes"] == [3, 5, 8] and rec["tokens"] == 48
+    # records are model-namespaced like blocks: no cross-model resume
+    other = RemoteBlockPool(SPEC, store.addr, fingerprint="other")
+    assert other.get_session("chat-1") is None
+
+
+async def test_mocker_evacuate_then_remote_resume(store):
+    """The tentpole data path, mocker mirror: engine A retains a session,
+    evacuates it (blocks + record) on drain, and engine B — sharing only
+    the remote store — resumes the next turn warm, counted in
+    session_remote_resumes."""
+    from dynamo_tpu.engine.session import SESSION_KEY, get_session_metrics
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
+    from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+
+    args = dict(num_blocks=64, block_size=16, enable_prefix_caching=True,
+                session_ttl=60.0, speedup_ratio=1000.0,
+                remote_kv_addr=store.addr)
+
+    async def turn(eng, toks, sid="s1"):
+        out = []
+        async for d in eng.generate(PreprocessedRequest(
+                token_ids=list(toks), annotations={SESSION_KEY: sid},
+                stop_conditions=StopConditions(max_tokens=4,
+                                               ignore_eos=True))):
+            out.extend(d.token_ids)
+        return out
+
+    a = MockEngine(MockEngineArgs(**args))
+    prompt = list(range(1, 65))
+    out1 = await turn(a, prompt)
+    assert a.stats()["session"]["sessions"] == 1
+    evac = a.evacuate_sessions()
+    assert evac["sessions"] == 1 and evac["blocks"] > 0 and evac["bytes"] > 0
+    assert a.stats()["session"]["pinned_blocks"] == 0   # pins released
+    await a.stop()
+
+    b = MockEngine(MockEngineArgs(**args))
+    sm = get_session_metrics()
+    base = sm.remote_resumes.get()
+    await turn(b, prompt + out1 + list(range(100, 132)))
+    assert sm.remote_resumes.get() - base == 1
+    assert b.stats()["session_remote_resumes"] == 1
+    await b.stop()
+
+
+async def test_mocker_abort_class_is_qos_scoped():
+    """abort_class("batch") stops only batch-class streams with a typed
+    CANCELLED; abort_class(None) stops the rest — the drain run-down's
+    QoS valve."""
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
+    from dynamo_tpu.protocols.common import (
+        FinishReason, PreprocessedRequest, StopConditions)
+    from dynamo_tpu.qos.deadline import PRIORITY_KEY
+
+    eng = MockEngine(MockEngineArgs(num_blocks=128, block_size=16,
+                                    speedup_ratio=1.0))
+
+    async def consume(priority, rid):
+        req = PreprocessedRequest(
+            token_ids=list(range(1, 33)),
+            annotations={PRIORITY_KEY: priority},
+            stop_conditions=StopConditions(max_tokens=500, ignore_eos=True))
+        req.request_id = rid
+        fr = None
+        async for d in eng.generate(req):
+            if d.finish_reason is not None:
+                fr = d.finish_reason
+        return fr
+
+    t_batch = asyncio.create_task(consume("batch", "b1"))
+    t_inter = asyncio.create_task(consume("interactive", "i1"))
+    await asyncio.sleep(0.3)
+    assert eng.abort_class("batch") == 1
+    assert await asyncio.wait_for(t_batch, 5) == FinishReason.CANCELLED
+    assert not t_inter.done()               # interactive stream untouched
+    assert eng.abort_class() == 1
+    assert await asyncio.wait_for(t_inter, 5) == FinishReason.CANCELLED
+    await eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# ProcessConnector lifecycle (real worker processes)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def coord():
+    from dynamo_tpu.chaos.harness import Proc, free_port
+
+    port = free_port()
+    p = Proc(["-m", "dynamo_tpu.transports.coordinator", "--host",
+              "127.0.0.1", "--port", str(port)], name="drain-coord").start()
+    p.wait_for_line("COORDINATOR_READY", 20)
+    yield f"tcp://127.0.0.1:{port}"
+    p.stop()
+
+
+def _worker_args(coord_url: str) -> list[str]:
+    return ["--engine", "mocker", "--coordinator", coord_url,
+            "--speedup-ratio", "200", "--drain-deadline", "10"]
+
+
+async def _wait_ready(rep, timeout=45.0):
+    deadline = time.monotonic() + timeout
+    while rep.instance_id is None and time.monotonic() < deadline:
+        if not rep.alive():
+            raise AssertionError(
+                f"worker exited before ready (rc={rep.proc.returncode})")
+        await asyncio.sleep(0.1)
+    assert rep.instance_id is not None, "worker never printed WORKER_READY"
+
+
+async def test_connector_spawn_to_ready(coord):
+    from dynamo_tpu.planner.connector import (
+        ProcessConnector, get_connector_metrics)
+
+    m = get_connector_metrics()
+    base_spawned = m.replicas_spawned.get()
+    conn = ProcessConnector(None, _worker_args(coord))
+    try:
+        await conn.apply(0, 1, "scale up")
+        assert len(conn.decode_procs) == 1
+        rep = conn.decode_procs[0]
+        await _wait_ready(rep)
+        assert m.replicas_spawned.get() == base_spawned + 1
+    finally:
+        await conn.shutdown("test teardown")
+    assert rep.proc.returncode == 0
+
+
+async def test_connector_scale_down_drains_before_exit(coord):
+    """Scale-down goes through the drain-key handshake (a client is
+    wired): the worker exits 0 with no SIGKILL escalation and leaves a
+    terminal drain report on the status key."""
+    from dynamo_tpu.planner.connector import (
+        ProcessConnector, get_connector_metrics)
+    from dynamo_tpu.transports.client import CoordinatorClient
+
+    client = await CoordinatorClient.connect(coord)
+    m = get_connector_metrics()
+    base_kills = m.sigkill_escalations.get()
+    base_retired = m.replicas_retired.get()
+    conn = ProcessConnector(None, _worker_args(coord), client=client,
+                            drain_deadline=10.0)
+    try:
+        await conn.apply(0, 1, "scale up")
+        rep = conn.decode_procs[0]
+        await _wait_ready(rep)
+        iid = rep.instance_id
+        await conn.apply(0, 0, "sla overprovisioned")
+        assert conn.decode_procs == []
+        assert rep.proc.returncode == 0
+        assert m.sigkill_escalations.get() == base_kills
+        assert m.replicas_retired.get() == base_retired + 1
+        raw = await client.get(drain_status_key("dynamo", iid))
+        assert raw is not None, "no drain report on the status key"
+        report = json.loads(raw)
+        assert report["state"] == "done"
+    finally:
+        await conn.shutdown("test teardown")
+        await client.close()
+
+
+async def test_connector_crash_reap_then_respawn_to_target(coord):
+    from dynamo_tpu.planner.connector import ProcessConnector
+
+    conn = ProcessConnector(None, _worker_args(coord))
+    try:
+        await conn.apply(0, 1, "scale up")
+        rep = conn.decode_procs[0]
+        await _wait_ready(rep)
+        rep.proc.send_signal(signal.SIGKILL)
+        rep.proc.wait(10)
+        # next apply reaps the corpse and respawns to target
+        await conn.apply(0, 1, "hold at 1")
+        assert len(conn.decode_procs) == 1
+        fresh = conn.decode_procs[0]
+        assert fresh.proc.pid != rep.proc.pid and fresh.alive()
+        await _wait_ready(fresh)
+    finally:
+        await conn.shutdown("test teardown")

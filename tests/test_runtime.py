@@ -68,6 +68,42 @@ async def test_endpoint_stream_roundtrip():
             await rt.shutdown()
 
 
+async def test_graceful_close_lets_inflight_stream_finish():
+    """client.close() (model removal during a drain) must not cut streams
+    already in flight — the connection lingers until they end, then closes,
+    and new streams are refused while it lingers."""
+    def slow_factory(i):
+        async def handler(payload, ctx):
+            for tok in range(3):
+                await asyncio.sleep(0.1)
+                yield {"tok": tok}
+        return handler
+
+    async with cluster(1, handler_factory=slow_factory) as (_, cfg, _rts):
+        rt, client = await make_client(cfg)
+        try:
+            router = PushRouter(client=client, mode=RouterMode.ROUND_ROBIN)
+            agen = router.generate({"prompt": "x"})
+            first = await agen.__anext__()
+            assert first["tok"] == 0
+            await client.close()          # graceful by default
+            wc = next(iter(client._conns.values()))
+            assert wc.alive               # lingers while the stream runs
+            rest = [x async for x in agen]
+            assert [x["tok"] for x in rest] == [1, 2]
+            # last stream done -> the connection actually closed
+            for _ in range(50):
+                if not wc.alive:
+                    break
+                await asyncio.sleep(0.02)
+            assert not wc.alive
+            with pytest.raises(StreamError):
+                async for _ in wc.call("ns.backend.generate", {}, "rid"):
+                    pass
+        finally:
+            await rt.shutdown()
+
+
 async def test_round_robin_spreads_load():
     async with cluster(3) as (_, cfg, _rts):
         rt, client = await make_client(cfg)

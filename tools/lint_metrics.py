@@ -40,6 +40,7 @@ PROVIDER_METRICS = {
         "num_steps", "prefill_tokens", "decode_tokens",
         "requests_finished", "preemptions", "prefix_hit_rate",
         "spec_proposed", "spec_accepted", "deadline_cancelled",
+        "session_remote_resumes",
     ),
 }
 
@@ -100,6 +101,31 @@ SESSION_METRICS = (
     "session_active",
     "session_expired",
     "session_demoted_blocks",
+    "session_remote_resumes",
+)
+
+# The worker drain family (runtime/drain.py DrainMetrics): run-down
+# progress, evacuation volume, and the operator-abort counter. Same
+# bidirectional drift rule as KV_TRANSFER_METRICS.
+DRAIN_METRICS = (
+    "drain_duration_seconds",
+    "drain_streams_completed",
+    "drain_streams_aborted",
+    "drain_evacuated_blocks",
+    "drain_evacuated_bytes",
+    "drain_evacuated_sessions",
+    "drain_active",
+    "drain_aborted",
+)
+
+# The planner process-connector family (planner/connector.py
+# ConnectorMetrics): replica lifecycle counts plus the drain-to-exit
+# latency histogram. Same bidirectional drift rule as KV_TRANSFER_METRICS.
+CONNECTOR_METRICS = (
+    "connector_replicas_spawned",
+    "connector_replicas_retired",
+    "connector_sigkill_escalations",
+    "connector_drain_seconds",
 )
 
 # The context-parallel ring prefill family (obs/ring_prefill.py
@@ -359,6 +385,40 @@ def _lint_session_metrics(root: Path, problems: list[str]) -> None:
             "does not register it")
 
 
+def _lint_drain_metrics(root: Path, problems: list[str]) -> None:
+    """The worker-drain family must match what runtime/drain.py actually
+    registers — same no-silent-drift rule as KV_TRANSFER_METRICS."""
+    actual = _registered_names(root / "runtime" / "drain.py")
+    if actual is None:
+        return
+    declared = set(DRAIN_METRICS)
+    for key in sorted(actual - declared):
+        problems.append(
+            f"runtime/drain.py registers {key!r} but it is missing from "
+            "tools/lint_metrics.py DRAIN_METRICS")
+    for key in sorted(declared - actual):
+        problems.append(
+            f"DRAIN_METRICS declares {key!r} but runtime/drain.py "
+            "does not register it")
+
+
+def _lint_connector_metrics(root: Path, problems: list[str]) -> None:
+    """The process-connector family must match what planner/connector.py
+    actually registers — same no-silent-drift rule as KV_TRANSFER_METRICS."""
+    actual = _registered_names(root / "planner" / "connector.py")
+    if actual is None:
+        return
+    declared = set(CONNECTOR_METRICS)
+    for key in sorted(actual - declared):
+        problems.append(
+            f"planner/connector.py registers {key!r} but it is missing from "
+            "tools/lint_metrics.py CONNECTOR_METRICS")
+    for key in sorted(declared - actual):
+        problems.append(
+            f"CONNECTOR_METRICS declares {key!r} but planner/connector.py "
+            "does not register it")
+
+
 def _lint_ring_prefill_metrics(root: Path, problems: list[str]) -> None:
     """The ring-prefill family must match what obs/ring_prefill.py actually
     registers — same no-silent-drift rule as KV_TRANSFER_METRICS."""
@@ -414,6 +474,8 @@ def _lint_family_overlap(problems: list[str]) -> None:
         "PERF_METRICS": PERF_METRICS,
         "PREFIX_CACHE_METRICS": PREFIX_CACHE_METRICS,
         "SESSION_METRICS": SESSION_METRICS,
+        "DRAIN_METRICS": DRAIN_METRICS,
+        "CONNECTOR_METRICS": CONNECTOR_METRICS,
         "RING_PREFILL_METRICS": RING_PREFILL_METRICS,
         "FLEET_METRICS": FLEET_METRICS,
         "SLO_METRICS": SLO_METRICS,
@@ -489,6 +551,8 @@ def lint_tree(root: Path | None = None) -> list[str]:
     _lint_perf_metrics(root, problems)
     _lint_perf_labels(root, problems)
     _lint_session_metrics(root, problems)
+    _lint_drain_metrics(root, problems)
+    _lint_connector_metrics(root, problems)
     _lint_ring_prefill_metrics(root, problems)
     _lint_fleet_metrics(root, problems)
     _lint_recovery_metrics(root, problems)
