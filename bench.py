@@ -60,6 +60,12 @@ against a small EngineCore with session retention on, reading the engine's
 prefix hits, not an estimate). On failure lines, or when the deadline left
 no room to measure, the cost model supplies the analytic fraction for the
 same geometry (``source: "costmodel"``) so the trajectory never goes dark.
+
+Every line also carries a ``compile`` stamp from the XLA compile ledger
+(obs/compile_ledger.py): warmup mode + coverage, total/serve-path compile
+seconds, and per-bucket compile counts and wall seconds — so a compile-time
+regression or a warmup-coverage hole lands on the same dashboard row as the
+throughput it taxes.
 """
 
 from __future__ import annotations
@@ -240,6 +246,32 @@ def _session_metric() -> dict | None:
         return None
 
 
+def _compile_stamp() -> dict | None:
+    """Compile-ledger stamp (obs/compile_ledger.py) attached to EVERY
+    emitted line — success, cpu_probe fallback, and failure alike: warmup
+    mode + coverage plus per-bucket compile counts and wall seconds, so a
+    regression in compile time or warmup coverage shows up on the same
+    dashboard row as the throughput it taxes. Best-effort by the usual
+    rule — an observability read must never cost the metric line. In the
+    parent process (no engine ever constructed) the ledger is empty; the
+    child's line carries the populated stamp and is forwarded as-is."""
+    try:
+        from dynamo_tpu.obs.compile_ledger import get_compile_ledger
+
+        led = get_compile_ledger()
+        stamp = led.snapshot()
+        stamp["per_bucket_seconds"] = {
+            f"{sig.kind}:b{sig.b}:t{sig.t}:n{sig.nblk}"
+            + (":g" if sig.greedy else ""): {
+                "count": n, "seconds": round(secs, 3)}
+            for sig, (n, secs) in sorted(
+                led.by_bucket().items(), key=lambda kv: str(kv[0]))
+        }
+        return stamp
+    except Exception:  # noqa: BLE001 — same best-effort rule as predicted
+        return None
+
+
 def _measure_session_turn2(deadline_at: float) -> dict | None:
     """Measured arm of the ``session`` entry: a real two-turn conversation
     against a fresh small EngineCore with prefix caching + session retention
@@ -338,6 +370,9 @@ def fail(stage: str, error: str, probe_log: str = "") -> None:
     session = _session_metric()
     if session is not None:
         out["session"] = session
+    comp = _compile_stamp()
+    if comp is not None:
+        out["compile"] = comp
     if probe_log.strip():
         out["probe_log"] = probe_log.strip()[-2000:]
     print(json.dumps(out))
@@ -475,6 +510,10 @@ def _cpu_fallback(probe_error: str, probe_log: str) -> None:
         session = _session_metric()
         if session is not None:
             out["session"] = session
+    if out.get("compile") is None:
+        # Child lines stamp their own (populated) ledger; this parent-side
+        # stamp only covers a child that died before emitting one.
+        out["compile"] = _compile_stamp()
     if probe_log.strip():
         out["probe_log"] = probe_log.strip()[-2000:]
     print(json.dumps(out))
@@ -617,6 +656,9 @@ def run_bench(deadline_at: float) -> dict:
         "perf": perf,
         "longctx": _longctx_metric(),
         "session": session,
+        # Per-bucket compile seconds + warmup coverage for THIS run — the
+        # ledger that just watched every jit entry point compile above.
+        "compile": _compile_stamp(),
     }
 
 
@@ -719,6 +761,8 @@ def main() -> None:
                  stderr_text)
             return
         parsed.setdefault("fallback", None)
+        if parsed.get("compile") is None:
+            parsed["compile"] = _compile_stamp()
         print(json.dumps(parsed))
         sys.exit(proc.returncode)
     _cpu_fallback(

@@ -24,6 +24,16 @@ of K turns (``--sessions``, ``--turns``, ``--think-time``), each turn
 re-sending the full history under a stable ``x-session-id`` so an engine
 with session KV retention prefills only the new suffix; the summary splits
 TTFT by turn and folds ``dynamo_session_*`` across the scraped workers.
+
+``--mode coldstart`` quantifies the XLA compile tax on a FRESH worker: two
+identical mixed-geometry bursts (prompt lengths spanning several prefill
+buckets) back to back, scraping ``dynamo_xla_compile_*`` around each. The
+first burst lands on cold jit caches (under ``--warmup-mode lazy`` every
+new bucket signature stalls its victim for the trace+compile wall); the
+second re-sends the same geometry mix against the now-warm caches. The
+summary reports the cold-vs-warm TTFT ratio plus per-burst serve-path
+compile counts and stall seconds — under ``--warmup-mode full`` both bursts
+should look identical (ratio ≈ 1, zero serve compiles).
 """
 
 from __future__ import annotations
@@ -233,6 +243,36 @@ async def scrape_metrics(urls: list[str],
 
 async def scrape_prefix_cache(urls: list[str]) -> "dict[str, float] | None":
     return await scrape_metrics(urls, "dynamo_prefix_cache_")
+
+
+async def scrape_compile(urls: list[str]) -> "dict | None":
+    """One snapshot of the compile-ledger series (obs/compile_ledger.py)
+    across the scraped /metrics endpoints. Serve-path and warmup-path
+    compile counts are kept apart — warmup compiles are a healthy startup
+    burst, serve compiles are the stalls coldstart mode measures. Coverage
+    is the MINIMUM across workers (the worst worker is the one a router
+    feels). None when nothing was reachable."""
+    out = {"events_serve": 0.0, "events_warmup": 0.0, "stall_seconds": 0.0,
+           "cache_entries": 0.0, "coverage_min": None}
+    seen = False
+    for u in urls:
+        try:
+            sample = await fetch_metrics(u, timeout_s=5)
+        except Exception:
+            continue
+        seen = True
+        out["events_serve"] += metric_sum(
+            sample, "dynamo_xla_compile_events_total", source="serve")
+        out["events_warmup"] += metric_sum(
+            sample, "dynamo_xla_compile_events_total", source="warmup")
+        out["stall_seconds"] += metric_sum(
+            sample, "dynamo_xla_compile_stall_seconds_total")
+        out["cache_entries"] += metric_sum(
+            sample, "dynamo_xla_compile_cache_entries")
+        cov = metric_sum(sample, "dynamo_xla_compile_warmup_coverage")
+        out["coverage_min"] = (cov if out["coverage_min"] is None
+                               else min(out["coverage_min"], cov))
+    return out if seen else None
 
 
 def fleet_slo_summary(sample: "dict[tuple[str, frozenset], float]") -> dict:
@@ -461,6 +501,137 @@ async def run_sessions(url: str, model: str, sessions: int, turns: int,
     }
 
 
+async def run_coldstart(url: str, model: str, concurrency: int,
+                        num_requests: int, isl: int, osl: int,
+                        metrics_urls: "list[str] | None" = None) -> dict:
+    """Cold-start mode: two identical mixed-geometry bursts against a FRESH
+    worker, ``dynamo_xla_compile_*`` scraped around each. Prompt lengths
+    cycle through a ladder (isl/4, isl/2, isl) so the burst touches several
+    prefill buckets; batch geometry varies naturally as requests overlap.
+    Burst 1 pays every cold bucket's trace+compile wall (lazy mode); burst 2
+    re-sends the same mix warm. The cold-vs-warm TTFT ratio is the
+    user-visible cost of serving without AOT warmup. TTFT comes from the
+    FRONTEND's own dynamo_frontend_time_to_first_token_seconds histogram
+    (observed at the first token_ids, before detokenization), scraped around
+    each burst — client-side first-content timing breaks on mocker workers,
+    whose token ids detokenize to empty text so no content delta is ever
+    streamed; the client percentiles ride along for real engines. Caveat:
+    the one-request calibration probe itself warms a single small bucket —
+    the mixed burst still lands on plenty of cold ones."""
+    geoms = sorted({max(isl // 4, 8), max(isl // 2, 8), max(isl, 8)})
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=30)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        cpt = await calibrate(session, url, model)
+        scrape_urls = metrics_urls or [url]
+        counter = iter(range(10 ** 9))
+
+        async def burst() -> list[RequestResult]:
+            results: list[RequestResult] = []
+            pending: set[asyncio.Task] = set()
+            issued = 0
+            while issued < num_requests or pending:
+                while issued < num_requests and len(pending) < concurrency:
+                    seed = next(counter)
+                    pending.add(asyncio.create_task(one_request(
+                        session, url, model, geoms[issued % len(geoms)],
+                        osl, seed, cpt)))
+                    issued += 1
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                results.extend(t.result() for t in done)
+            return results
+
+        ttft_name = "dynamo_frontend_time_to_first_token_seconds"
+
+        async def scrape_ttft() -> "dict[str, float] | None":
+            return await scrape_metrics([url], ttft_name)
+
+        before = await scrape_compile(scrape_urls)
+        ttft0 = await scrape_ttft()
+        t0 = time.perf_counter()
+        cold = await burst()
+        cold_wall = time.perf_counter() - t0
+        mid = await scrape_compile(scrape_urls)
+        ttft1 = await scrape_ttft()
+        t0 = time.perf_counter()
+        warm = await burst()
+        warm_wall = time.perf_counter() - t0
+        after = await scrape_compile(scrape_urls)
+        ttft2 = await scrape_ttft()
+
+    def server_ttft_avg(a: "dict | None", b: "dict | None") -> "float | None":
+        """Mean TTFT the frontend observed between two histogram scrapes."""
+        if a is None or b is None:
+            return None
+        count = b.get(f"{ttft_name}_count", 0.0) - a.get(f"{ttft_name}_count", 0.0)
+        total = b.get(f"{ttft_name}_sum", 0.0) - a.get(f"{ttft_name}_sum", 0.0)
+        return total / count if count > 0 else None
+
+    def ttft_block(results: list[RequestResult], wall: float,
+                   server_avg: "float | None") -> dict:
+        good = [r for r in results if r.ok]
+        ttfts = [r.ttft_s for r in good]
+        return {
+            "requests": len(results),
+            "failed": len(results) - len(good),
+            "wall_s": round(wall, 3),
+            "server_ttft_avg_s": (round(server_avg, 4)
+                                  if server_avg is not None else None),
+            "ttft_p50_s": round(percentile(ttfts, 50), 4),
+            "ttft_p95_s": round(percentile(ttfts, 95), 4),
+            "ttft_avg_s": round(sum(ttfts) / len(ttfts), 4) if ttfts else 0.0,
+            "ttft_max_s": round(max(ttfts), 4) if ttfts else 0.0,
+        }
+
+    cold_avg = server_ttft_avg(ttft0, ttft1)
+    warm_avg = server_ttft_avg(ttft1, ttft2)
+    cold_blk = ttft_block(cold, cold_wall, cold_avg)
+    warm_blk = ttft_block(warm, warm_wall, warm_avg)
+    compile_summary: dict = {"scraped": False}
+    if before is not None and mid is not None and after is not None:
+        compile_summary = {
+            "scraped": True,
+            # Serve-path compiles per burst: the acceptance number. Full
+            # warmup → 0 in BOTH bursts; lazy → burst 1 carries them all.
+            "serve_compiles_cold_burst": int(
+                mid["events_serve"] - before["events_serve"]),
+            "serve_compiles_warm_burst": int(
+                after["events_serve"] - mid["events_serve"]),
+            "stall_seconds_cold_burst": round(
+                mid["stall_seconds"] - before["stall_seconds"], 3),
+            "stall_seconds_warm_burst": round(
+                after["stall_seconds"] - mid["stall_seconds"], 3),
+            "warmup_compiles_total": int(after["events_warmup"]),
+            "cache_entries": int(after["cache_entries"]),
+            "warmup_coverage": (round(after["coverage_min"], 4)
+                                if after["coverage_min"] is not None else None),
+        }
+    # Headline ratio: the frontend's token-level TTFT averages when both
+    # scrapes landed, the client-side p50s otherwise.
+    if cold_avg is not None and warm_avg is not None and warm_avg > 0:
+        ratio = round(cold_avg / warm_avg, 3)
+    elif warm_blk["ttft_p50_s"]:
+        ratio = round(cold_blk["ttft_p50_s"] / warm_blk["ttft_p50_s"], 3)
+    else:
+        ratio = None
+    errors = sorted({r.error for r in [*cold, *warm] if not r.ok})[:5]
+    return {
+        "mode": "coldstart",
+        "requests": len(cold) + len(warm),
+        "failed": cold_blk["failed"] + warm_blk["failed"],
+        "errors": errors,
+        "concurrency": concurrency,
+        "isl_mix": geoms,
+        "osl": osl,
+        "cold": cold_blk,
+        "warm": warm_blk,
+        # > 1 means the first burst's users paid a visible compile tax;
+        # ≈ 1 under --warmup-mode full is the AOT acceptance check.
+        "cold_over_warm_ttft": ratio,
+        "compile": compile_summary,
+    }
+
+
 def _parse_mix(spec: str) -> list[tuple[str, float]]:
     """"interactive=0.2,standard=0.3,batch=0.5" → cumulative class mix."""
     mix = []
@@ -584,12 +755,17 @@ def main(argv: list[str] | None = None) -> dict:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", default="http://127.0.0.1:8000")
     ap.add_argument("--model", default="tiny-llama")
-    ap.add_argument("--mode", choices=["closed", "overload", "session"],
+    ap.add_argument("--mode",
+                    choices=["closed", "overload", "session", "coldstart"],
                     default="closed",
                     help="closed: fixed-concurrency loop; overload: open-loop "
                          "Poisson arrivals past capacity (QoS shedding demo); "
                          "session: multi-turn chatbot conversations with "
-                         "stable x-session-id (session KV retention demo)")
+                         "stable x-session-id (session KV retention demo); "
+                         "coldstart: two identical mixed-geometry bursts "
+                         "against a fresh worker, scraping "
+                         "dynamo_xla_compile_* to report the cold-vs-warm "
+                         "TTFT ratio (XLA compile tax / AOT warmup demo)")
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--isl", type=int, default=128)
@@ -661,6 +837,23 @@ def main(argv: list[str] | None = None) -> dict:
             ns.url, ns.model, ns.sessions, ns.turns, ns.isl, ns.osl,
             ns.think_time, ns.concurrency, metrics_urls=ns.metrics_url))
         result["chips"] = ns.chips
+        _record_kv_dtype(result, ns.url, ns.kv_dtype)
+        attach_fleet_slo(result)
+        print(json.dumps(result))
+        if ns.out:
+            with open(ns.out, "w") as f:
+                json.dump(result, f, indent=2)
+        if ns.trace_out:
+            asyncio.run(fetch_traces(ns.url, ns.trace_out))
+        if result["failed"]:
+            print(f"loadgen: {result['failed']} failed requests: "
+                  f"{result['errors']}", file=sys.stderr)
+        return result
+
+    if ns.mode == "coldstart":
+        result = asyncio.run(run_coldstart(
+            ns.url, ns.model, ns.concurrency, ns.requests, ns.isl, ns.osl,
+            metrics_urls=ns.metrics_url))
         _record_kv_dtype(result, ns.url, ns.kv_dtype)
         attach_fleet_slo(result)
         print(json.dumps(result))

@@ -82,6 +82,17 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--ring-prefill-threshold", type=int, default=0,
                    help="sp>1 only: min prompt tokens for ring prefill "
                         "(0 = cost-model break-even, -1 = never)")
+    p.add_argument("--warmup-mode", choices=["off", "lazy", "full"],
+                   default="lazy",
+                   help="XLA compile ledger / AOT bucket warmup: off = no "
+                        "ledger, lazy = record organic compiles against the "
+                        "enumerated lattice, full = precompile the reachable "
+                        "bucket lattice before the endpoint serves "
+                        "(readiness waits for it)")
+    p.add_argument("--warmup-deadline", type=float, default=120.0,
+                   help="full-mode warmup wall-seconds budget; lattice "
+                        "entries past the deadline stay cold and show as "
+                        "warmup coverage < 1.0 (0 = unbounded)")
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--speedup-ratio", type=float, default=10.0, help="mocker only")
     p.add_argument("--no-kv-events", action="store_true")
@@ -284,6 +295,13 @@ async def amain(ns: argparse.Namespace) -> None:
 
         # Ring-vs-chunked arbitration feeds dynamo_ring_prefill_*.
         install_ring_prefill_metrics(rt.metrics)
+    if ns.warmup_mode != "off":
+        from dynamo_tpu.obs.compile_ledger import install_compile_metrics
+
+        # Compile ledger feeds dynamo_xla_compile_* (obs/compile_ledger.py).
+        # Installed for BOTH engine kinds — the mocker mirrors the ledger
+        # device-free so fleet rollups see identical series either way.
+        install_compile_metrics(rt.metrics)
 
     follower_shards: list[dict] = []
     if ns.engine == "mocker":
@@ -298,6 +316,7 @@ async def amain(ns: argparse.Namespace) -> None:
             remote_kv_addr=remote_kv,
             global_prefix_cache=ns.global_prefix_cache,
             session_ttl=ns.session_ttl,
+            warmup_mode=ns.warmup_mode,
         ), event_sink=sink)
         stats_fn = engine.stats
     else:
@@ -336,6 +355,8 @@ async def amain(ns: argparse.Namespace) -> None:
             session_ttl=ns.session_ttl,
             session_tiers=not ns.no_session_tiers,
             ring_prefill_threshold=ns.ring_prefill_threshold,
+            warmup_mode=ns.warmup_mode,
+            warmup_deadline=ns.warmup_deadline,
         ), event_sink=sink,
             op_sink=op_channel.broadcast if op_channel is not None else None))
         stats_fn = engine.stats
@@ -358,6 +379,23 @@ async def amain(ns: argparse.Namespace) -> None:
             follower_shards = [
                 {"addr": i["shard_addr"], "box": i["shard_box"]}
                 for i in infos if "shard_addr" in i]
+
+    if ns.warmup_mode != "off":
+        # AOT bucket warmup (obs/compile_ledger.py). Runs BEFORE ep.serve,
+        # so readiness (flipped only after serve) already implies the
+        # lattice is warm and routers never route onto a cold-bucket
+        # worker. In lazy mode this is a no-op beyond publishing the plan;
+        # in full mode it blocks for up to --warmup-deadline seconds. On a
+        # multi-host engine this sits after wait_ready, so followers are
+        # already replaying the op stream when warmup dispatches land.
+        core = getattr(engine, "core", None)
+        if core is not None and hasattr(core, "warmup"):
+            warm = await asyncio.get_running_loop().run_in_executor(
+                None, core.warmup)
+        else:
+            warm = engine.warmup() if hasattr(engine, "warmup") else None
+        if warm:
+            log.info("bucket warmup: %s", warm)
 
     if ns.disagg != "none" and ns.engine != "jax":
         raise SystemExit("--disagg requires --engine jax (KV handoff needs a real cache)")

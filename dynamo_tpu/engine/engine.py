@@ -45,6 +45,12 @@ from dynamo_tpu.engine.scheduler import Phase, PrefillWork, Scheduler, Seq, Step
 from dynamo_tpu.engine.session import SessionStore, get_session_metrics
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.config import ModelConfig, resolve_model_config
+from dynamo_tpu.obs.compile_ledger import (
+    WARMUP_MODES,
+    BucketSig,
+    enumerate_buckets,
+    get_compile_ledger,
+)
 from dynamo_tpu.obs.profiler import StepPerfProfiler, phase as _perf_phase
 from dynamo_tpu.obs.tracer import get_tracer, trace_context_of
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
@@ -220,6 +226,11 @@ class ModelRunner:
         self.slot_toks = self._place(jnp.zeros((maxb + 1,), jnp.int32))
         self._step_fns: dict[tuple[int, int, int], Callable] = {}
         self.max_nblk = -(-engine_cfg.max_model_len // engine_cfg.block_size)
+        # Compile ledger (obs/compile_ledger.py): every cache miss below is
+        # a trace+compile that blocks the engine-core thread; the ledger
+        # times it, attributes the victim request, and feeds warmup
+        # coverage. Disabled (warmup_mode=off) the gate is one bool read.
+        self._ledger = get_compile_ledger()
         from dynamo_tpu.ops.paged_attention import select_attn_impl
 
         self.attn_impl = select_attn_impl(engine_cfg.attn_impl)
@@ -599,12 +610,22 @@ class ModelRunner:
             for i, m in enumerate(masks):
                 if m is not None:
                     logit_mask[i, ~m] = -1e30
+        led = self._ledger
+        cold = led.enabled and (
+            (b, t, nblk, sp_prefill, window, fast_greedy, mm, masked)
+            not in self._step_fns)
         fn = self.step_fn(b, t, nblk, sp_prefill, window, fast_greedy, mm,
                           masked)
         place = self._place
         extra = ((place(emb_override), place(emb_mask)) if mm else ())
         if masked:
             extra = (*extra, place(logit_mask))
+        if cold:
+            # jit compiles lazily: the cache miss pays its trace+compile
+            # wall INSIDE the fn(...) call below (only execution stays
+            # async), so timing the call measures the engine-thread stall.
+            led.mark_inflight(True)
+            t_compile = time.perf_counter()
         (self.cache_k, self.cache_v, self.counts, self.keys, self.slot_toks,
          toks, lps) = fn(
             self.params, self.cache_k, self.cache_v, self.counts, self.keys,
@@ -615,6 +636,17 @@ class ModelRunner:
             place(pp), place(rp), place(do_sample),
             place(from_slot), *extra,
         )
+        if cold:
+            dt = time.perf_counter() - t_compile
+            led.mark_inflight(False)
+            kind = ("window" if window > 1
+                    else "decode" if t == 1 else "prefill")
+            led.record(
+                BucketSig(kind, b, t, nblk, fast_greedy,
+                          ec.kv_dtype or "bfloat16"),
+                dt,
+                trace_ctx=next((s.trace_ctx for s, _, _ in rows
+                                if s.trace_ctx is not None), None))
         return toks, lps
 
     def run(
@@ -697,14 +729,28 @@ class ModelRunner:
             bt[i, : len(ids)] = ids
 
         key = ("verify", b, t, nblk)
+        led = self._ledger
+        cold = led.enabled and key not in self._step_fns
         if key not in self._step_fns:
             log.info("compiling verify fn B=%d T=%d NBLK=%d", b, t, nblk)
             self._step_fns[key] = self._build_verify_fn(b, t, nblk)
         fn = self._step_fns[key]
         place = self._place
+        if cold:
+            led.mark_inflight(True)
+            t_compile = time.perf_counter()
         self.cache_k, self.cache_v, toks, lps = fn(
             self.params, self.cache_k, self.cache_v,
             place(tokens), place(q_start), place(q_len), place(bt))
+        if cold:
+            dt = time.perf_counter() - t_compile
+            led.mark_inflight(False)
+            led.record(
+                BucketSig("verify", b, t, nblk, True,
+                          ec.kv_dtype or "bfloat16"),
+                dt,
+                trace_ctx=next((s.trace_ctx for s, _, _ in rows
+                                if s.trace_ctx is not None), None))
         return toks, lps
 
     # -- embeddings ----------------------------------------------------
@@ -752,6 +798,8 @@ class ModelRunner:
         # compile-cache entries (each compile blocks the engine-core thread).
         b = _bucket(len(token_lists), (1, 2, 4, 8, 16, 32, 64))
         key = ("embed", b, t, 0, 0)
+        led = self._ledger
+        cold = led.enabled and key not in self._step_fns
         if key not in self._step_fns:
             log.info("compiling embed fn B=%d T=%d", b, t)
             self._step_fns[key] = self._build_embed_fn(b, t)
@@ -761,9 +809,104 @@ class ModelRunner:
         for i, ts in enumerate(token_lists):
             tokens[i, : len(ts)] = ts
             q_len[i] = len(ts)
+        if cold:
+            led.mark_inflight(True)
+            t_compile = time.perf_counter()
         hidden = np.asarray(fn(self.params, self._place(tokens), self._place(q_len)))
+        if cold:
+            led.mark_inflight(False)
+            led.record(
+                BucketSig("embed", b, t, 0, True,
+                          self.engine_cfg.kv_dtype or "bfloat16"),
+                time.perf_counter() - t_compile)
         out[:] = hidden[: len(token_lists)]
         return out
+
+    # -- AOT bucket warmup ---------------------------------------------
+    def warmup(self, sigs: list[BucketSig], deadline_s: float = 0.0) -> dict:
+        """Precompile the enumerated bucket lattice (obs/compile_ledger.py)
+        by executing each program once with padding inputs: q_len=0 rows
+        compute nothing meaningful, do_sample=False routes sampling-state
+        writes to the trash row, and KV writes land in pool block 0 —
+        which every real prefill rewrites before anything reads it. jit
+        caches executables per call signature, so this mints exactly the
+        cache entries serving dispatches would otherwise compile lazily
+        (and the ledger's inventory ends equal to the enumeration).
+        ``deadline_s`` bounds the total wall (0 = unbounded); lattice
+        entries past the deadline stay cold and count against coverage."""
+        led = self._ledger
+        t0 = time.perf_counter()
+        compiled = cached = failed = skipped = 0
+        for sig in sigs:
+            if deadline_s > 0 and time.perf_counter() - t0 >= deadline_s:
+                skipped += 1
+                continue
+            try:
+                hit = self._warm_one(sig)
+            except Exception:
+                log.warning("warmup compile failed for %s", sig,
+                            exc_info=True)
+                failed += 1
+                continue
+            cached += 1 if hit else 0
+            compiled += 0 if hit else 1
+        summary = {"compiled": compiled, "cached": cached, "failed": failed,
+                   "deadline_skipped": skipped,
+                   "seconds": round(time.perf_counter() - t0, 3),
+                   "coverage": round(led.coverage(), 4)}
+        log.info("bucket warmup: %s", summary)
+        return summary
+
+    def _warm_one(self, sig: BucketSig) -> bool:
+        """Compile+execute one bucket signature with padding inputs.
+        Returns True when the program was already cached (no compile)."""
+        b, t, nblk = sig.b, sig.t, sig.nblk
+        place = self._place
+        t0 = time.perf_counter()
+        if sig.kind == "embed":
+            key = ("embed", b, t, 0, 0)
+            if key in self._step_fns:
+                return True
+            self._step_fns[key] = self._build_embed_fn(b, t)
+            np.asarray(self._step_fns[key](
+                self.params, place(np.zeros((b, t), np.int32)),
+                place(np.zeros((b,), np.int32))))
+        elif sig.kind == "verify":
+            key = ("verify", b, t, nblk)
+            if key in self._step_fns:
+                return True
+            self._step_fns[key] = self._build_verify_fn(b, t, nblk)
+            self.cache_k, self.cache_v, toks, _lps = self._step_fns[key](
+                self.params, self.cache_k, self.cache_v,
+                place(np.zeros((b, t), np.int32)),
+                place(np.zeros((b,), np.int32)),
+                place(np.zeros((b,), np.int32)),
+                place(np.zeros((b, nblk), np.int32)))
+            np.asarray(toks)
+        else:
+            window = (self.engine_cfg.decode_window
+                      if sig.kind == "window" else 1)
+            key = (b, t, nblk, False, window, sig.greedy, False, False)
+            if key in self._step_fns:
+                return True
+            fn = self.step_fn(b, t, nblk, False, window, sig.greedy,
+                              False, False)
+            zi = np.zeros((b,), np.int32)
+            zf = np.zeros((b,), np.float32)
+            ones = np.ones((b,), np.float32)
+            zb = np.zeros((b,), bool)
+            (self.cache_k, self.cache_v, self.counts, self.keys,
+             self.slot_toks, toks, _lps) = fn(
+                self.params, self.cache_k, self.cache_v, self.counts,
+                self.keys, self.slot_toks,
+                place(np.zeros((b, t), np.int32)), place(zi), place(zi),
+                place(np.zeros((b, nblk), np.int32)), place(zi),
+                place(zf), place(zi), place(ones),
+                place(zf), place(zf), place(ones),
+                place(zb), place(zb))
+            np.asarray(toks)
+        self._ledger.record(sig, time.perf_counter() - t0, source="warmup")
+        return False
 
 
 class EngineCore:
@@ -826,6 +969,20 @@ class EngineCore:
             raise ValueError(
                 f"attn_num_splits must be >= 0 (0 = auto), "
                 f"got {engine_cfg.attn_num_splits}")
+        if engine_cfg.warmup_mode not in WARMUP_MODES:
+            raise ValueError(
+                f"unknown warmup_mode {engine_cfg.warmup_mode!r} "
+                f"(supported: {', '.join(WARMUP_MODES)})")
+        if engine_cfg.warmup_deadline < 0:
+            raise ValueError(
+                f"warmup_deadline must be >= 0 (0 = unbounded), "
+                f"got {engine_cfg.warmup_deadline}")
+        # Compile ledger gate (obs/compile_ledger.py): configured before
+        # the runner exists so every compile this engine ever mints is
+        # governed by the same mode; the enumerated lattice doubles as the
+        # coverage denominator in lazy mode (grows organically) and the
+        # precompile worklist in full mode (EngineCore.warmup).
+        get_compile_ledger().configure(engine_cfg.warmup_mode)
         self.model_cfg = resolve_model_config(engine_cfg.model)
         if engine_cfg.kv_dtype == "int4" and self.model_cfg.head_dim % 2:
             raise ValueError(
@@ -838,6 +995,11 @@ class EngineCore:
                                         ep=engine_cfg.ep))
         self.runner = ModelRunner(self.model_cfg, engine_cfg, mesh=mesh, params=params,
                                   rng_seed=engine_cfg.seed)
+        if engine_cfg.warmup_mode != "off":
+            # Publish the reachable lattice so coverage is meaningful even
+            # before (or without) a full warmup — a lazy engine's coverage
+            # gauge climbs as traffic mints buckets.
+            get_compile_ledger().set_plan(enumerate_buckets(engine_cfg))
         self.pool = PrefixPool(
             self.runner.spec.num_blocks,
             engine_cfg.block_size,
@@ -961,6 +1123,27 @@ class EngineCore:
             eos = getattr(tok, "eos_id", None)
             self._guided_vocab = (pieces, [eos] if eos is not None else [])
         return self._guided_vocab
+
+    # ------------------------------------------------------------------
+    def warmup(self) -> dict:
+        """AOT bucket warmup (obs/compile_ledger.py). Runs BEFORE the
+        engine serves (the worker calls it between construction and
+        readiness, on the thread that will become the engine-core owner's
+        predecessor — no step loop is running yet, so device state has one
+        owner throughout). ``off``/``lazy`` return immediately; ``full``
+        precompiles the enumerated lattice under ``warmup_deadline``."""
+        ec = self.engine_cfg
+        led = get_compile_ledger()
+        out: dict = {"mode": ec.warmup_mode,
+                     "coverage": round(led.coverage(), 4)}
+        if ec.warmup_mode != "off" and led.plan is not None:
+            out["buckets"] = len(led.plan)
+        if ec.warmup_mode == "full":
+            out.update(self.runner.warmup(
+                sorted(led.plan or enumerate_buckets(ec),
+                       key=lambda s: (s.kind, s.b, s.t, s.nblk, s.greedy)),
+                deadline_s=ec.warmup_deadline))
+        return out
 
     # ------------------------------------------------------------------
     def add_request(self, req: PreprocessedRequest,
@@ -2409,6 +2592,11 @@ class AsyncJaxEngine:
             out["kvbm"] = self.core.kvbm.snapshot()
         if self.core.sessions is not None:
             out["session"] = self.core.sessions.snapshot()
+        led = get_compile_ledger()
+        if led.enabled:
+            # Warmup coverage + compile stalls ride the published stats so
+            # the planner and /debug/fleet can see cold-bucket workers.
+            out["compile"] = led.snapshot()
         return out
 
 

@@ -186,6 +186,11 @@ async def run_http(ns: argparse.Namespace) -> None:
 
         # Ring-vs-chunked arbitration feeds dynamo_ring_prefill_*.
         install_ring_prefill_metrics(svc.metrics)
+    if cfg.warmup_mode != "off":
+        from dynamo_tpu.obs.compile_ledger import install_compile_metrics
+
+        # Compile ledger feeds dynamo_xla_compile_* (obs/compile_ledger.py).
+        install_compile_metrics(svc.metrics)
     await svc.start(ns.host, ns.port)
     log.info("serving %s on http://%s:%d/v1", ns.model, ns.host, svc.port)
     try:

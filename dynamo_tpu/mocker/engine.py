@@ -22,6 +22,11 @@ from dynamo_tpu import chaos
 from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
 from dynamo_tpu.engine.session import SessionStore, get_session_metrics, session_id_of
+from dynamo_tpu.obs.compile_ledger import (
+    enumerate_buckets,
+    get_compile_ledger,
+    sig_for_rows,
+)
 from dynamo_tpu.obs.tracer import get_tracer, trace_context_of
 from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.qos.config import class_rank
@@ -61,6 +66,17 @@ class MockEngineArgs:
     # suffix. 0 = off. Same SessionStore the JAX engine uses — block
     # accounting and the dynamo_session_* metrics are real.
     session_ttl: float = 0.0
+    # Compile-ledger mirror (obs/compile_ledger.py): each simulated
+    # dispatch derives the bucket signature the JAX engine WOULD compile
+    # (same _bucket/_pow2_bucket math, device-free) and a first-touch
+    # bucket files a real ledger event — span, metrics — plus a simulated
+    # step-loop stall, so coldstart benchmarks measure a cold-vs-warm TTFT
+    # gap without a TPU. "off" disables the ledger; "full" pre-files the
+    # whole lattice in warmup() so no serving stall is ever injected.
+    warmup_mode: str = "lazy"
+    # Simulated wall seconds one cold-bucket compile stalls the step loop
+    # (divided by speedup_ratio like every other simulated time).
+    compile_s: float = 0.5
 
 
 @dataclass
@@ -146,6 +162,20 @@ class MockEngine:
                 (2, 1, self.args.block_size, 1, 2), dtype=np.float32)
             if self.args.global_prefix_cache:
                 self.pool.commit_hook = self._on_commit
+        # Compile-ledger mirror: signatures come from a synthetic
+        # EngineConfig carrying the mocker's geometry (everything else at
+        # engine defaults — the lattice math reads geometry only).
+        from dynamo_tpu.utils.config import EngineConfig
+
+        self._lattice_cfg = EngineConfig(
+            block_size=self.args.block_size,
+            max_batch_size=self.args.max_batch_size,
+            max_model_len=self.args.max_model_len,
+            warmup_mode=self.args.warmup_mode)
+        self._ledger = get_compile_ledger()
+        self._ledger.configure(self.args.warmup_mode)
+        if self.args.warmup_mode != "off":
+            self._ledger.set_plan(enumerate_buckets(self._lattice_cfg))
 
     def start(self) -> None:
         if self._task is None:
@@ -154,6 +184,43 @@ class MockEngine:
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()
+
+    def warmup(self) -> dict:
+        """Full-mode mirror of EngineCore.warmup: file a warmup-source
+        ledger event for every lattice entry (no real compiles, no sleeps)
+        so a freshly started mocker reports coverage 1.0 and the step loop
+        never injects simulated compile stalls."""
+        led = self._ledger
+        if not led.enabled:
+            return {"mode": self.args.warmup_mode, "coverage": led.coverage()}
+        plan = sorted(led.plan or (),
+                      key=lambda s: (s.kind, s.b, s.t, s.nblk, s.greedy))
+        compiled = 0
+        if self.args.warmup_mode == "full":
+            for sig in plan:
+                if sig not in led.inventory:
+                    led.record(sig,
+                               self.args.compile_s / self.args.speedup_ratio,
+                               source="warmup")
+                    compiled += 1
+        return {"mode": self.args.warmup_mode, "buckets": len(plan),
+                "compiled": compiled, "coverage": led.coverage()}
+
+    def _mock_compile(self, kind: str, n_rows: int, t_max: int,
+                      nblk_need: int, victim=None) -> float:
+        """Cold-bucket mirror: derive the signature the JAX dispatch would
+        hit (sig_for_rows) and, on first touch, file a serve-source ledger
+        event — engine.compile span under the victim's trace and all — and
+        return the simulated stall the caller must sleep."""
+        led = self._ledger
+        if not led.enabled:
+            return 0.0
+        sig = sig_for_rows(kind, n_rows, t_max, nblk_need, self._lattice_cfg)
+        if sig in led.inventory:
+            return 0.0
+        stall = self.args.compile_s / self.args.speedup_ratio
+        led.record(sig, stall, trace_ctx=victim, source="serve")
+        return stall
 
     # ------------------------------------------------------------------
     def _trace_phase(self, seq: _MockSeq, name: str, **attrs) -> None:
@@ -365,7 +432,11 @@ class MockEngine:
             if prefills:
                 seq = prefills[0]
                 new_tokens = len(seq.req.token_ids) - seq.cached_blocks * a.block_size
+                stall = self._mock_compile(
+                    "prefill", 1, new_tokens, len(seq.block_ids),
+                    victim=seq.trace_ctx)
                 await asyncio.sleep(
+                    stall +
                     new_tokens * a.prefill_us_per_token / 1e6 / a.speedup_ratio)
                 seq.prefilled = True
                 self._trace_phase(seq, "engine.decode",
@@ -376,7 +447,13 @@ class MockEngine:
 
             decodes = [s for s in self.running if s.prefilled and not s.done]
             if decodes:
-                await asyncio.sleep(a.decode_itl_ms / 1e3 / a.speedup_ratio)
+                stall = self._mock_compile(
+                    "decode", len(decodes), 1,
+                    max(len(s.block_ids) for s in decodes),
+                    victim=next((s.trace_ctx for s in decodes
+                                 if s.trace_ctx is not None), None))
+                await asyncio.sleep(
+                    stall + a.decode_itl_ms / 1e3 / a.speedup_ratio)
                 for seq in decodes:
                     # grow blocks as generated tokens fill them
                     total = len(seq.req.token_ids) + seq.generated + 1
@@ -521,6 +598,8 @@ class MockEngine:
                 "session_hits": self.session_hits,
                 "session_remote_resumes": self.session_remote_resumes}
                if self.sessions is not None else {}),
+            **({"compile": self._ledger.snapshot()}
+               if self._ledger.enabled else {}),
         }
 
     async def clear_kv(self) -> None:

@@ -1,0 +1,438 @@
+"""XLA compile ledger: per-bucket compile events, warmup lattice, metrics.
+
+Every hot-path program the engine runs is a bucketed ``jax.jit`` compile —
+decode/prefill step, the fused decode window, spec verify, embed — and each
+compile blocks the engine-core thread for its full trace+compile wall. This
+module makes those stalls observable and schedulable:
+
+* ``CompileLedger`` — process-global record of every compile event keyed by
+  bucket signature ``(kind, b, t, nblk, greedy, kv_dtype)``: wall seconds,
+  trigger timestamp, the victim request's trace id, and the live
+  compile-cache inventory. Serve-path events additionally emit
+  ``engine.compile`` spans into the Tracer/FlightRecorder so
+  ``/debug/traces`` attributes a TTFT spike to the exact cold bucket that
+  caused it.
+* ``CompileMetrics`` — the ``dynamo_xla_compile_*`` Prometheus family
+  (lint-checked by tools/lint_metrics.py COMPILE_METRICS), re-homeable into
+  a worker's runtime registry via ``install_compile_metrics`` exactly like
+  the perf/ring-prefill families.
+* ``enumerate_buckets(EngineConfig)`` — the reachable bucket lattice,
+  computed with the SAME ``_bucket``/``_pow2_bucket`` math the dispatch
+  paths use (engine/engine.py), so AOT warmup precompiles exactly what
+  serving would mint lazily. Embed buckets are deliberately excluded from
+  the warmup plan: embeddings are off the generate hot path and their
+  ``b × t`` lattice would dominate the budget (their compiles are still
+  ledgered when they happen).
+
+Disabled mode (``--warmup-mode off``) flips ``CompileLedger.enabled``; the
+engine's dispatch paths gate on that flag BEFORE touching timestamps or
+bucket signatures, so a disabled ledger adds zero per-dispatch work.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from dynamo_tpu.utils.metrics import MetricsRegistry
+
+#: Warmup modes: ``off`` disables the ledger entirely; ``lazy`` records
+#: organic compiles against the enumerated lattice (coverage grows as
+#: traffic mints buckets); ``full`` precompiles the lattice at startup.
+WARMUP_MODES = ("off", "lazy", "full")
+
+#: Compile walls span sub-second CPU tracing to multi-minute TPU prefill
+#: programs. (MetricsRegistry appends the +Inf bucket.)
+_COMPILE_SECONDS_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                            60.0, 120.0)
+
+# Mirrors of engine/engine.py's bucket helpers. Kept textually tiny and
+# import-free so the mocker and tests can compute signatures device-free;
+# tests/test_compile_obs.py pins these against hand-computed dispatch
+# geometry so they cannot drift from the engine silently.
+
+
+def _bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return n
+
+
+def _pow2_bucket(n: int, lo: int, hi: int) -> int:
+    b = lo
+    while b < n and b < hi:
+        b *= 2
+    return b
+
+
+@dataclass(frozen=True)
+class BucketSig:
+    """One compiled program's bucket signature. ``kind`` is one of
+    decode | window | prefill | verify | embed; ``greedy`` is the
+    argmax-only fast path variant (always True for verify/embed)."""
+
+    kind: str
+    b: int
+    t: int
+    nblk: int
+    greedy: bool
+    kv_dtype: str
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "b": self.b, "t": self.t,
+                "nblk": self.nblk, "greedy": self.greedy,
+                "kv_dtype": self.kv_dtype}
+
+
+@dataclass
+class CompileEvent:
+    """One observed (or warmup-forced) XLA compile."""
+
+    sig: BucketSig
+    seconds: float
+    ts: float                     # trigger timestamp (epoch)
+    trace_id: str | None = None   # victim request's trace, if any
+    source: str = "serve"         # "serve" | "warmup"
+
+    def to_dict(self) -> dict:
+        d = {**self.sig.to_dict(), "seconds": self.seconds, "ts": self.ts,
+             "source": self.source}
+        if self.trace_id:
+            d["trace_id"] = self.trace_id
+        return d
+
+
+# ---------------------------------------------------------------------------
+# Prometheus family
+# ---------------------------------------------------------------------------
+
+class CompileMetrics:
+    """The dynamo_xla_compile_* family (names cross-checked by
+    tools/lint_metrics.py COMPILE_METRICS)."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.bind(registry or MetricsRegistry())
+
+    def bind(self, registry: MetricsRegistry) -> None:
+        self.registry = registry
+        self.events = registry.counter(
+            "xla_compile_events_total",
+            "XLA compiles observed by the ledger, by kind (decode|window|"
+            "prefill|verify|embed) and source (serve|warmup)")
+        self.seconds = registry.histogram(
+            "xla_compile_seconds",
+            "Wall seconds one XLA trace+compile blocked the engine-core "
+            "thread (or the warmup loop)",
+            buckets=_COMPILE_SECONDS_BUCKETS)
+        self.cache_entries = registry.gauge(
+            "xla_compile_cache_entries",
+            "Live compiled-program cache inventory (distinct bucket "
+            "signatures the ledger has seen compile)")
+        self.inflight = registry.gauge(
+            "xla_compile_inflight",
+            "Compiles currently blocking a dispatch (0 or 1 per engine — "
+            "compiles serialize on the engine-core thread)")
+        self.stall_seconds = registry.counter(
+            "xla_compile_stall_seconds_total",
+            "Cumulative wall seconds SERVING dispatches were stalled by "
+            "compiles (warmup compiles excluded: they burn startup, not "
+            "requests)")
+        self.warmup_coverage = registry.gauge(
+            "xla_compile_warmup_coverage",
+            "Fraction of the enumerated warmup bucket lattice already "
+            "compiled (1.0 = no serving request can hit a cold bucket)")
+        self.warmup_buckets = registry.gauge(
+            "xla_compile_warmup_buckets",
+            "Size of the enumerated warmup bucket lattice for this "
+            "engine's config")
+
+
+_metrics: CompileMetrics | None = None
+
+
+def get_compile_metrics() -> CompileMetrics:
+    global _metrics
+    if _metrics is None:
+        _metrics = CompileMetrics()
+    return _metrics
+
+
+def install_compile_metrics(registry: MetricsRegistry) -> CompileMetrics:
+    """Re-home the singleton's metrics into ``registry`` (the worker's
+    runtime registry) so the family is exposed on /metrics. Gauges are
+    republished from the live ledger so an install that lands AFTER the
+    engine was built (single-process launch) still exposes the plan size
+    and coverage; counters stay monotonic and are not replayed."""
+    m = get_compile_metrics()
+    m.bind(registry)
+    led = get_compile_ledger()
+    with led._lock:
+        m.warmup_buckets.set(float(len(led.plan or ())))
+        m.cache_entries.set(float(len(led.inventory)))
+    m.warmup_coverage.set(led.coverage())
+    return m
+
+
+# ---------------------------------------------------------------------------
+# The ledger
+# ---------------------------------------------------------------------------
+
+class CompileLedger:
+    """Process-global compile event record + warmup coverage accounting.
+
+    Thread-safe: the engine-core thread records serve compiles while the
+    asyncio side reads snapshots for stats/bench. Events are bounded
+    (``cap``) — the inventory and counters stay exact past the cap; only
+    the per-event detail rolls."""
+
+    def __init__(self, cap: int = 2048):
+        self._lock = threading.Lock()
+        self.cap = cap
+        self.enabled = True
+        self.mode = "lazy"
+        self.events: list[CompileEvent] = []
+        self.inventory: set[BucketSig] = set()
+        self._dropped = 0
+        # Warmup plan: the enumerated lattice; None until an engine
+        # configures warmup (coverage reads 0 with an empty plan).
+        self.plan: set[BucketSig] | None = None
+
+    # -- configuration --------------------------------------------------
+    def configure(self, mode: str) -> None:
+        """Engine-startup hook: sets the mode and the enabled gate."""
+        if mode not in WARMUP_MODES:
+            raise ValueError(
+                f"warmup_mode must be one of {WARMUP_MODES}, got {mode!r}")
+        with self._lock:
+            self.mode = mode
+            self.enabled = mode != "off"
+
+    def set_plan(self, sigs: list[BucketSig] | set[BucketSig]) -> None:
+        with self._lock:
+            self.plan = set(sigs)
+            get_compile_metrics().warmup_buckets.set(float(len(self.plan)))
+        self._publish_coverage()
+
+    def reset(self) -> None:
+        """Test hook: drop all events/inventory/plan (metrics counters are
+        monotonic and keep their totals)."""
+        with self._lock:
+            self.events.clear()
+            self.inventory.clear()
+            self.plan = None
+            self._dropped = 0
+
+    # -- recording ------------------------------------------------------
+    def record(self, sig: BucketSig, seconds: float, *,
+               trace_ctx=None, source: str = "serve",
+               ts: float | None = None) -> CompileEvent | None:
+        """File one compile event; returns it (None when disabled).
+
+        Serve-path events with a traced victim emit an ``engine.compile``
+        span under the victim's trace; untraced serve events still land on
+        the process timeline. Warmup events skip spans entirely — they
+        stall startup, not a request."""
+        if not self.enabled:
+            return None
+        end = ts if ts is not None else time.time()
+        trace_id = getattr(trace_ctx, "trace_id", None)
+        ev = CompileEvent(sig=sig, seconds=seconds, ts=end - seconds,
+                          trace_id=trace_id, source=source)
+        with self._lock:
+            if len(self.events) < self.cap:
+                self.events.append(ev)
+            else:
+                self._dropped += 1
+            self.inventory.add(sig)
+            n_inv = len(self.inventory)
+        m = get_compile_metrics()
+        m.events.inc(kind=sig.kind, source=source)
+        m.seconds.observe(seconds, kind=sig.kind)
+        m.cache_entries.set(float(n_inv))
+        if source == "serve":
+            m.stall_seconds.inc(seconds)
+            from dynamo_tpu.obs.tracer import get_tracer
+
+            tr = get_tracer()
+            span = tr.start_span(
+                "engine.compile", ctx=trace_ctx, start=ev.ts,
+                kind=sig.kind, b=sig.b, t=sig.t, nblk=sig.nblk,
+                greedy=sig.greedy, kv_dtype=sig.kv_dtype)
+            tr.end_span(span, end=end, seconds=round(seconds, 6))
+        self._publish_coverage()
+        return ev
+
+    def mark_inflight(self, on: bool) -> None:
+        if self.enabled:
+            get_compile_metrics().inflight.set(1.0 if on else 0.0)
+
+    # -- accounting -----------------------------------------------------
+    def coverage(self) -> float:
+        """Fraction of the warmup plan already compiled. 0.0 with no plan
+        (nothing enumerated yet — the conservative answer for routers)."""
+        with self._lock:
+            if not self.plan:
+                return 0.0
+            return len(self.plan & self.inventory) / len(self.plan)
+
+    def _publish_coverage(self) -> None:
+        get_compile_metrics().warmup_coverage.set(self.coverage())
+
+    def total_seconds(self) -> float:
+        with self._lock:
+            return sum(e.seconds for e in self.events)
+
+    def by_bucket(self) -> dict[BucketSig, tuple[int, float]]:
+        """{sig: (event count, total seconds)} over recorded events."""
+        out: dict[BucketSig, tuple[int, float]] = {}
+        with self._lock:
+            events = list(self.events)
+        for e in events:
+            n, s = out.get(e.sig, (0, 0.0))
+            out[e.sig] = (n + 1, s + e.seconds)
+        return out
+
+    def snapshot(self, events: bool = False) -> dict:
+        """Compact dict for stats publishing / bench artifacts."""
+        with self._lock:
+            out = {
+                "mode": self.mode,
+                "enabled": self.enabled,
+                "cache_entries": len(self.inventory),
+                "events_total": len(self.events) + self._dropped,
+                "compile_seconds_total": sum(e.seconds for e in self.events),
+                "serve_stall_seconds": sum(
+                    e.seconds for e in self.events if e.source == "serve"),
+                "warmup_buckets": len(self.plan) if self.plan else 0,
+            }
+            if events:
+                out["events"] = [e.to_dict() for e in self.events]
+        out["warmup_coverage"] = round(self.coverage(), 4)
+        return out
+
+
+_ledger: CompileLedger | None = None
+_ledger_lock = threading.Lock()
+
+
+def get_compile_ledger() -> CompileLedger:
+    global _ledger
+    with _ledger_lock:
+        if _ledger is None:
+            _ledger = CompileLedger()
+        return _ledger
+
+
+# ---------------------------------------------------------------------------
+# Bucket lattice enumeration — the SAME math as engine/engine.py dispatch.
+# ---------------------------------------------------------------------------
+
+def _nblk_ladder(max_nblk: int) -> list[int]:
+    """Reachable block-table widths: dispatch computes
+    ``min(_pow2_bucket(need, 4, max_nblk), max_nblk)`` — the pow2 ladder
+    from 4, clamped to (and always including) max_nblk."""
+    out: list[int] = []
+    b = 4
+    while b < max_nblk:
+        out.append(b)
+        b *= 2
+    out.append(max_nblk)
+    return sorted({min(n, max_nblk) for n in out})
+
+
+def _reachable_batch_buckets(maxn: int, buckets: tuple[int, ...]) -> list[int]:
+    """Batch sizes ``_bucket(n, buckets)`` can return for n in 1..maxn.
+    Past the ladder, _bucket returns n itself; only ``maxn`` (the cap) is
+    enumerated for that open tail — intermediate fallthrough sizes are
+    organic-compile territory, not warmup's."""
+    out: list[int] = []
+    for x in buckets:
+        out.append(x)
+        if x >= maxn:
+            break
+    else:
+        out.append(maxn)
+    return sorted(set(out))
+
+
+def _prefill_t_ladder(ec) -> list[int]:
+    """Reachable prefill chunk buckets: ``_pow2_bucket(t, 16, prefill_chunk)``
+    over t in 1..min(prefill_chunk, max_model_len, max_tokens_per_step)."""
+    cap = min(ec.prefill_chunk, ec.max_model_len, ec.max_tokens_per_step)
+    out = [16]
+    t = 16
+    while t < cap:
+        t *= 2
+        out.append(t)
+    return out
+
+
+def _verify_t_ladder(spec_k: int) -> list[int]:
+    """Reachable verify chunk buckets: ``min(_pow2_bucket(t, 2, k+1), k+1)``
+    over t in 1..spec_k+1 (chunk = current token + up to k proposals)."""
+    k1 = spec_k + 1
+    return sorted({min(_pow2_bucket(t, 2, k1), k1) for t in range(1, k1 + 1)})
+
+
+def embed_bucket_ladders(ec) -> tuple[list[int], list[int]]:
+    """Embed's (b, t) ladders — exported for tests/tools; embed buckets are
+    NOT part of the warmup plan (off the generate hot path)."""
+    bs = [x for x in (1, 2, 4, 8, 16, 32, 64)]
+    ts = [16]
+    t = 16
+    while t < ec.max_model_len:
+        t *= 2
+        ts.append(t)
+    return bs, ts
+
+
+def enumerate_buckets(ec) -> list[BucketSig]:
+    """The reachable generate-path bucket lattice for one EngineConfig —
+    what ``--warmup-mode full`` precompiles and what coverage is measured
+    against. Excludes: embed (off-path), sp-prefill/multimodal/guided
+    variants (workload-dependent; organic compiles, still ledgered)."""
+    kv = ec.kv_dtype or "bfloat16"
+    max_nblk = -(-ec.max_model_len // ec.block_size)
+    nblks = _nblk_ladder(max_nblk)
+    out: list[BucketSig] = []
+    dec_bs = _reachable_batch_buckets(ec.max_batch_size, ec.decode_bucket)
+    greedy_variants = (True, False)
+    for b in dec_bs:
+        for nblk in nblks:
+            for g in greedy_variants:
+                out.append(BucketSig("decode", b, 1, nblk, g, kv))
+                if ec.decode_window > 1:
+                    out.append(BucketSig("window", b, 1, nblk, g, kv))
+    pf_bs = [x for x in (1, 2, 4, 8) if x <= max(ec.max_batch_size, 1)]
+    for b in pf_bs:
+        for t in _prefill_t_ladder(ec):
+            for nblk in nblks:
+                for g in greedy_variants:
+                    out.append(BucketSig("prefill", b, t, nblk, g, kv))
+    if ec.spec_ngram > 0:
+        for b in dec_bs:
+            for t in _verify_t_ladder(ec.spec_k):
+                for nblk in nblks:
+                    out.append(BucketSig("verify", b, t, nblk, True, kv))
+    return out
+
+
+def sig_for_rows(kind: str, n_rows: int, t_max: int, nblk_need: int,
+                 ec, greedy: bool = True) -> BucketSig:
+    """Bucket signature for a dispatched batch — the device-free mirror of
+    dispatch()'s geometry math, used by the mocker and tests."""
+    kv = ec.kv_dtype if getattr(ec, "kv_dtype", None) else "bfloat16"
+    max_nblk = -(-ec.max_model_len // ec.block_size)
+    nblk = min(_pow2_bucket(max(nblk_need, 1), 4, max_nblk), max_nblk)
+    if kind in ("decode", "window"):
+        return BucketSig(kind, _bucket(n_rows, ec.decode_bucket), 1, nblk,
+                         greedy, kv)
+    if kind == "verify":
+        t = min(_pow2_bucket(t_max, 2, ec.spec_k + 1), ec.spec_k + 1)
+        return BucketSig(kind, _bucket(n_rows, ec.decode_bucket), t, nblk,
+                         True, kv)
+    t = _pow2_bucket(t_max, 16, ec.prefill_chunk)
+    return BucketSig("prefill", _bucket(n_rows, (1, 2, 4, 8)), t, nblk,
+                     greedy, kv)

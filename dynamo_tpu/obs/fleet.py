@@ -58,6 +58,14 @@ _GENERATE_ROUTES = ("chat", "completions")
 # Perf-gauge families watched by the EWMA anomaly detector.
 ANOMALY_PREFIXES = ("dynamo_engine_perf_",)
 
+# Compile-storm detection (obs/compile_ledger.py feeds the series): this
+# many SERVE-path XLA compiles from one instance inside the trailing
+# window means its bucket lattice is churning — every one of them stalled
+# a real request's dispatch. Warmup-source compiles are excluded: a fresh
+# worker precompiling its lattice is healthy, not a storm.
+COMPILE_STORM_WINDOW_S = 60.0
+COMPILE_STORM_THRESHOLD = 8
+
 
 # ---------------------------------------------------------------------------
 # SLO specs
@@ -316,7 +324,9 @@ class FleetAggregator:
                  staleness_ttl_s: float = 10.0,
                  specs: Iterable[SloSpec] = DEFAULT_SLO_SPECS,
                  registry: MetricsRegistry | None = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 compile_storm_threshold: int = COMPILE_STORM_THRESHOLD,
+                 compile_storm_window_s: float = COMPILE_STORM_WINDOW_S):
         self.client = client
         self.namespace = namespace
         self.scrape_interval_s = scrape_interval_s
@@ -328,6 +338,12 @@ class FleetAggregator:
         self.engine = SloEngine(specs, registry=self.registry, clock=clock)
         self.anomaly = EwmaAnomaly()
         self._anomalies: list[dict] = []
+        self.compile_storm_threshold = compile_storm_threshold
+        self.compile_storm_window_s = compile_storm_window_s
+        # per-instance ring of (t, cumulative serve-compile count)
+        self._compile_series: dict[str, list[tuple[float, float]]] = {}
+        self._storming: set[str] = set()
+        self._compile_storms: list[dict] = []
         self.c_scrapes = self.registry.counter(
             "fleet_scrapes_total", "scrape attempts against fleet targets")
         self.c_scrape_errors = self.registry.counter(
@@ -337,6 +353,10 @@ class FleetAggregator:
             "fleet_targets", "discovered targets by freshness state")
         self.h_scrape_seconds = self.registry.histogram(
             "fleet_scrape_seconds", "wall time of one full scrape sweep")
+        self.g_compile_storm = self.registry.gauge(
+            "fleet_compile_storm",
+            "serve-path XLA compiles per instance over the trailing "
+            "compile-storm window (>= threshold flags a storm)")
 
     # -- discovery ---------------------------------------------------------
     @property
@@ -413,6 +433,7 @@ class FleetAggregator:
             self.engine.observe(spec.name, good, total)
         self.engine.evaluate()
         self._detect_anomalies()
+        self._detect_compile_storms()
         self.h_scrape_seconds.observe(max(self.clock() - t0, 0.0))
 
     async def run(self) -> None:
@@ -487,6 +508,50 @@ class FleetAggregator:
                                   **rec})
         self._anomalies = flags[:32]
 
+    def _detect_compile_storms(self) -> None:
+        """Per-instance serve-compile rate over the trailing window. A
+        storm (>= threshold compiles in the window) flags the instance in
+        ``/debug/fleet`` and pages through the SloEngine violations
+        counter — the same rising-edge machinery burn-rate alerts use."""
+        now = self.clock()
+        horizon = now - self.compile_storm_window_s
+        storms: list[dict] = []
+        for st in self.targets.values():
+            if st.sample is None or not self.is_fresh(st):
+                continue
+            inst = st.target.instance
+            cum = sum(v for (name, labels), v in st.sample.items()
+                      if name == "dynamo_xla_compile_events_total"
+                      and dict(labels).get("source") == "serve")
+            series = self._compile_series.setdefault(inst, [])
+            series.append((now, cum))
+            while len(series) > 2 and series[1][0] <= horizon:
+                series.pop(0)
+            base = series[0]
+            for snap in series:
+                if snap[0] <= horizon:
+                    base = snap  # newest snapshot at/older than window start
+                else:
+                    break
+            delta = max(cum - base[1], 0.0)
+            self.g_compile_storm.set(delta, instance=inst)
+            if delta >= self.compile_storm_threshold:
+                storms.append({"instance": inst, "role": st.target.role,
+                               "compiles": delta,
+                               "window_s": self.compile_storm_window_s})
+                if inst not in self._storming:
+                    self.engine.c_violations.inc(
+                        slo="compile_storm", severity="page")
+                self._storming.add(inst)
+            else:
+                self._storming.discard(inst)
+        gone = set(self._compile_series) - {
+            st.target.instance for st in self.targets.values()}
+        for inst in gone:
+            del self._compile_series[inst]
+            self._storming.discard(inst)
+        self._compile_storms = storms
+
     # -- serving -----------------------------------------------------------
     def expose(self) -> str:
         """The fleet /metrics exposition: the aggregator's own registry
@@ -558,4 +623,5 @@ class FleetAggregator:
             ],
             "slos": slos,
             "anomalies": self._anomalies,
+            "compile_storms": self._compile_storms,
         }

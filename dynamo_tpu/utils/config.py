@@ -175,6 +175,17 @@ class EngineConfig:
     # turn can re-import them even after device eviction. False drops the
     # pins to plain LRU without the write-through.
     session_tiers: bool = True
+    # AOT bucket warmup / compile ledger (obs/compile_ledger.py):
+    # "off" disables the XLA compile ledger entirely (zero per-dispatch
+    # overhead), "lazy" records organic compiles against the enumerated
+    # bucket lattice (the default — full observability, no precompiles),
+    # "full" precompiles the reachable lattice at startup so no serving
+    # request ever pays a cold-bucket trace+compile stall (worker
+    # readiness waits for it).
+    warmup_mode: str = "lazy"
+    # Wall-seconds budget for full-mode warmup; lattice entries past the
+    # deadline stay cold and show up as coverage < 1.0. 0 = unbounded.
+    warmup_deadline: float = 120.0
     # Context-parallel ring prefill (sp>1 meshes, ops/ring_attention.py):
     # minimum prompt tokens before a fresh prompt prefills as ONE
     # seq-sharded ring chunk instead of the chunked sequential path.

@@ -351,6 +351,71 @@ async def test_aggregator_survives_fetch_chaos(monkeypatch):
     assert agg.debug_info()["targets"][0]["fresh"] is False
 
 
+async def test_compile_storm_flags_and_pages_on_rising_edge(monkeypatch):
+    """N serve-path XLA compiles from one instance inside the 1m window
+    flag a storm in /debug/fleet and page ONCE via the SloEngine
+    violations counter; warmup-source compiles never count (a fresh
+    worker precompiling its lattice is healthy), and the storm clears —
+    then re-pages — as the window slides."""
+    clock_box = [100.0]
+    client, agg = make_agg(clock_box)
+    assert agg.compile_storm_threshold == 8
+    serve = {"n": 0}
+
+    def worker_text():
+        return WORKER_TEXT + (
+            f'dynamo_xla_compile_events_total{{kind="prefill",'
+            f'source="serve"}} {serve["n"]}\n'
+            'dynamo_xla_compile_events_total{kind="decode",'
+            'source="warmup"} 400\n')
+
+    async def fake_fetch(url, timeout_s=10.0):
+        return parse_prometheus(
+            FRONTEND_TEXT if "8080" in url else worker_text())
+
+    monkeypatch.setattr("dynamo_tpu.obs.fleet.fetch_metrics", fake_fetch)
+    await agg.scrape_once()  # first sight = the baseline, delta 0
+    assert agg.debug_info()["compile_storms"] == []
+    # 400 warmup compiles did NOT trip the detector
+    pages = lambda: agg.engine.c_violations.get(  # noqa: E731
+        slo="compile_storm", severity="page")
+    assert pages() == 0.0
+
+    serve["n"] = 3  # +3 inside the window: below threshold
+    clock_box[0] += 10.0
+    await agg.scrape_once()
+    assert agg.debug_info()["compile_storms"] == []
+    assert agg.g_compile_storm.get(instance="10.0.0.2:9001") == 3.0
+
+    serve["n"] = 12  # +12 inside 60s: storm on both workers
+    clock_box[0] += 10.0
+    await agg.scrape_once()
+    storms = agg.debug_info()["compile_storms"]
+    assert {s["instance"] for s in storms} == \
+        {"10.0.0.2:9001", "10.0.0.3:9002"}
+    assert all(s["compiles"] >= 8 for s in storms)
+    assert pages() == 2.0  # one rising edge per storming instance
+
+    clock_box[0] += 10.0  # sustained storm: no second edge
+    await agg.scrape_once()
+    assert agg.debug_info()["compile_storms"]
+    assert pages() == 2.0
+
+    clock_box[0] += 70.0  # window slides past the burst: storm clears
+    await agg.scrape_once()
+    assert agg.debug_info()["compile_storms"] == []
+
+    serve["n"] = 25  # fresh burst after recovery: new rising edges
+    clock_box[0] += 10.0
+    await agg.scrape_once()
+    assert pages() == 4.0
+
+    # the family rides the normal rollup: instance="_fleet" sums workers
+    sample = parse_prometheus(agg.expose())
+    assert metric_sum(sample, "dynamo_xla_compile_events_total",
+                      instance="_fleet", source="serve") == 50.0
+
+
 # -- AggregatorScraper: planner feed ----------------------------------------
 
 FLEET_TEXT_T0 = """

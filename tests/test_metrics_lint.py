@@ -77,6 +77,23 @@ def test_ring_prefill_drift_detected(tmp_path: Path):
                for p in problems)
 
 
+def test_compile_drift_detected(tmp_path: Path):
+    """Bidirectional drift on the compile-ledger family: a registration the
+    COMPILE_METRICS declaration doesn't know about AND every
+    declared-but-unregistered name must each produce a violation."""
+    (tmp_path / "obs").mkdir()
+    (tmp_path / "obs" / "compile_ledger.py").write_text(textwrap.dedent("""
+        def bind(reg):
+            reg.counter("xla_compile_events_total", "compiles observed")
+            reg.counter("xla_compile_surprise", "undeclared registration")
+    """))
+    problems = lint_tree(tmp_path)
+    assert any("xla_compile_surprise" in p and "COMPILE_METRICS" in p
+               for p in problems)
+    assert any("xla_compile_warmup_coverage" in p and "does not register" in p
+               for p in problems)
+
+
 def test_prefix_cache_drift_detected(tmp_path: Path):
     """Bidirectional drift on the prefix-cache family: a registration the
     declaration doesn't know about AND every declared-but-unregistered name

@@ -385,8 +385,12 @@ def test_engine_phase_spans_full_lifecycle(engine_core):
             break
         engine_core.step()
     spans = get_tracer().recorder.spans_for(ctx.trace_id)
+    # The compile ledger (lazy mode) attributes any cold XLA compile this
+    # traced request triggered as an engine.compile victim span — present
+    # only when the jit cache was cold, so tolerated rather than required.
+    phase_spans = [s for s in spans if s.name != "engine.compile"]
     by_name = {}
-    for s in spans:
+    for s in phase_spans:
         by_name.setdefault(s.name, []).append(s)
     assert set(by_name) == {"engine.queue", "engine.prefill", "engine.decode"}
     assert all(s.ended for s in spans)
@@ -400,7 +404,7 @@ def test_engine_phase_spans_full_lifecycle(engine_core):
     assert final.status == "ok" and final.attrs["output_tokens"] == 8
     # all spans share the request's trace and carry the request id
     assert {s.trace_id for s in spans} == {ctx.trace_id}
-    assert {s.attrs["request_id"] for s in spans} == {"obs-full"}
+    assert {s.attrs["request_id"] for s in phase_spans} == {"obs-full"}
 
 
 def test_engine_abort_closes_span_cancelled(engine_core):

@@ -138,6 +138,20 @@ RING_PREFILL_METRICS = (
     "ring_prefill_threshold_tokens",
 )
 
+# The XLA compile-ledger family (obs/compile_ledger.py CompileMetrics):
+# compile events/walls, live compiled-program inventory, serve-path stall
+# accounting, and warmup lattice coverage. Same bidirectional drift rule
+# as KV_TRANSFER_METRICS.
+COMPILE_METRICS = (
+    "xla_compile_events_total",
+    "xla_compile_seconds",
+    "xla_compile_cache_entries",
+    "xla_compile_inflight",
+    "xla_compile_stall_seconds_total",
+    "xla_compile_warmup_coverage",
+    "xla_compile_warmup_buckets",
+)
+
 # The fleet-aggregation family (obs/fleet.py FleetAggregator): scrape
 # attempts/failures, target freshness, and sweep latency. Same
 # bidirectional drift rule as KV_TRANSFER_METRICS.
@@ -146,6 +160,7 @@ FLEET_METRICS = (
     "fleet_scrape_errors_total",
     "fleet_targets",
     "fleet_scrape_seconds",
+    "fleet_compile_storm",
 )
 
 # The SLO burn-rate family (obs/fleet.py SloEngine): error-budget gauges
@@ -436,6 +451,23 @@ def _lint_ring_prefill_metrics(root: Path, problems: list[str]) -> None:
             "does not register it")
 
 
+def _lint_compile_metrics(root: Path, problems: list[str]) -> None:
+    """The compile-ledger family must match what obs/compile_ledger.py
+    actually registers — same no-silent-drift rule as KV_TRANSFER_METRICS."""
+    actual = _registered_names(root / "obs" / "compile_ledger.py")
+    if actual is None:
+        return
+    declared = set(COMPILE_METRICS)
+    for key in sorted(actual - declared):
+        problems.append(
+            f"obs/compile_ledger.py registers {key!r} but it is missing "
+            "from tools/lint_metrics.py COMPILE_METRICS")
+    for key in sorted(declared - actual):
+        problems.append(
+            f"COMPILE_METRICS declares {key!r} but obs/compile_ledger.py "
+            "does not register it")
+
+
 def _lint_fleet_metrics(root: Path, problems: list[str]) -> None:
     """FLEET_METRICS + SLO_METRICS together must match what obs/fleet.py
     actually registers — same no-silent-drift rule as KV_TRANSFER_METRICS.
@@ -477,6 +509,7 @@ def _lint_family_overlap(problems: list[str]) -> None:
         "DRAIN_METRICS": DRAIN_METRICS,
         "CONNECTOR_METRICS": CONNECTOR_METRICS,
         "RING_PREFILL_METRICS": RING_PREFILL_METRICS,
+        "COMPILE_METRICS": COMPILE_METRICS,
         "FLEET_METRICS": FLEET_METRICS,
         "SLO_METRICS": SLO_METRICS,
         **{f"RECOVERY_METRICS[{'/'.join(parts)}]": names
@@ -554,6 +587,7 @@ def lint_tree(root: Path | None = None) -> list[str]:
     _lint_drain_metrics(root, problems)
     _lint_connector_metrics(root, problems)
     _lint_ring_prefill_metrics(root, problems)
+    _lint_compile_metrics(root, problems)
     _lint_fleet_metrics(root, problems)
     _lint_recovery_metrics(root, problems)
     _lint_family_overlap(problems)

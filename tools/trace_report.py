@@ -11,9 +11,13 @@ default ``/debug/traces`` format) — and prints:
   pipeline; legacy spans fall back to their ``direction`` attr);
 * a streamed-handoff wave summary (waves, bytes, per-transfer tail
   pulls) when any wave-phase spans are present;
+* an XLA compile table (``engine.compile`` spans from the compile
+  ledger, obs/compile_ledger.py) grouped by bucket signature — which
+  cold buckets stalled serving, for how long, how many victim traces;
 * the slowest ``request`` spans with their per-phase breakdown so a
   tail-latency outlier can be attributed to queueing vs prefill vs
-  decode vs KV transfer at a glance.
+  decode vs KV transfer at a glance — rows whose critical path contains
+  an ``engine.compile`` span are flagged as cold-start victims.
 
 Dependency-free; pairs with ``benchmarks/loadgen.py --trace-out``.
 
@@ -150,6 +154,39 @@ def kv_wave_summary(spans: list[dict]) -> str:
     return "\n".join(out)
 
 
+def compile_summary(spans: list[dict]) -> str:
+    """Per-bucket totals of ``engine.compile`` spans — the compile
+    ledger's trace-side view: each row is one cold bucket signature with
+    how often it compiled and how long it stalled serving."""
+    compiles = [s for s in spans if s.get("name") == "engine.compile"]
+    if not compiles:
+        return ""
+    by_sig: dict[tuple, list[float]] = defaultdict(list)
+    for s in compiles:
+        a = s.get("attrs", {})
+        sig = (str(a.get("kind", "?")), str(a.get("b", "?")),
+               str(a.get("t", "?")), str(a.get("nblk", "?")),
+               str(a.get("greedy", "?")))
+        dur = max(float(s.get("end", 0)) - float(s.get("start", 0)), 0.0)
+        by_sig[sig].append(dur * 1e3)
+    rows = [("kind", "b", "t", "nblk", "greedy", "count", "total ms",
+             "max ms")]
+    for sig in sorted(by_sig):
+        durs = by_sig[sig]
+        rows.append((*sig, str(len(durs)), f"{sum(durs):.2f}",
+                     f"{max(durs):.2f}"))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    victims = {s.get("trace_id") for s in compiles if s.get("trace_id")}
+    lines = [f"xla compiles: {len(compiles)} span(s), "
+             f"{len(victims)} victim trace(s)"]
+    for i, r in enumerate(rows):
+        lines.append("  ".join(c.ljust(widths[j]) if j == 0 else
+                               c.rjust(widths[j]) for j, c in enumerate(r)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
 def slowest_requests(spans: list[dict], top: int) -> str:
     by_trace: dict[str, list[dict]] = defaultdict(list)
     for s in spans:
@@ -162,13 +199,20 @@ def slowest_requests(spans: list[dict], top: int) -> str:
         dur = (float(root.get("end", 0)) - float(root.get("start", 0))) * 1e3
         attrs = root.get("attrs", {})
         rid = attrs.get("request_id", root.get("trace_id", "?")[:16])
-        out.append(f"request {rid}  {dur:.2f} ms  status={root.get('status')}"
-                   f"  model={attrs.get('model', '?')}"
-                   f"  in={attrs.get('input_tokens', '?')}"
-                   f"  out={attrs.get('output_tokens', '?')}")
         children = [s for s in by_trace.get(root.get("trace_id", ""), [])
                     if s is not root]
         children.sort(key=lambda s: float(s.get("start", 0)))
+        # Cold-start attribution: an engine.compile span on the critical
+        # path means this request paid a cold bucket's trace+compile wall.
+        cold_ms = sum(
+            max(float(c.get("end", 0)) - float(c.get("start", 0)), 0.0)
+            for c in children if c.get("name") == "engine.compile") * 1e3
+        flag = f"  COLD-START VICTIM ({cold_ms:.2f} ms compiling)" \
+            if cold_ms > 0 else ""
+        out.append(f"request {rid}  {dur:.2f} ms  status={root.get('status')}"
+                   f"  model={attrs.get('model', '?')}"
+                   f"  in={attrs.get('input_tokens', '?')}"
+                   f"  out={attrs.get('output_tokens', '?')}{flag}")
         t0 = float(root.get("start", 0))
         for c in children:
             cdur = (float(c.get("end", 0)) - float(c.get("start", 0))) * 1e3
@@ -199,6 +243,9 @@ def main(argv: list[str] | None = None) -> int:
     waves = kv_wave_summary(spans)
     if waves:
         print(f"\n{waves}")
+    compiles = compile_summary(spans)
+    if compiles:
+        print(f"\n{compiles}")
     print(f"\nslowest requests (top {args.top}):")
     print(slowest_requests(spans, args.top))
     return 0
