@@ -34,6 +34,14 @@ second re-sends the same geometry mix against the now-warm caches. The
 summary reports the cold-vs-warm TTFT ratio plus per-burst serve-path
 compile counts and stall seconds — under ``--warmup-mode full`` both bursts
 should look identical (ratio ≈ 1, zero serve compiles).
+
+``--mode failover`` measures crash recovery: a closed loop with one worker
+SIGKILLed mid-run (``--kill-pid``/``--kill-after``). The summary reports
+resumed-vs-reprompted-vs-lost stream counts (before/after deltas of
+``dynamo_migration_attempts_total{outcome=...}`` and the
+``dynamo_stream_ckpt_*`` family) and the disrupted cohort's TTFT/ITL cost
+against undisturbed streams — with ``--stream-ckpt-blocks`` on, disrupted
+streams should resume warm, recomputing at most one checkpoint interval.
 """
 
 from __future__ import annotations
@@ -41,7 +49,9 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import random
+import signal
 import sys
 import time
 
@@ -632,6 +642,152 @@ async def run_coldstart(url: str, model: str, concurrency: int,
     }
 
 
+async def scrape_migration(urls: list[str]) -> "dict[str, float] | None":
+    """Per-outcome fold of ``dynamo_migration_attempts_total`` across the
+    given /metrics endpoints (the frontend owns this counter). None when
+    nothing was reachable."""
+    out: dict[str, float] = {}
+    seen = False
+    for u in urls:
+        try:
+            sample = await fetch_metrics(u, timeout_s=5)
+        except Exception:
+            continue
+        seen = True
+        for (name, labels), value in sample.items():
+            if name != "dynamo_migration_attempts_total":
+                continue
+            outcome = dict(labels).get("outcome", "")
+            out[outcome] = out.get(outcome, 0.0) + value
+    return out if seen else None
+
+
+async def run_failover(url: str, model: str, concurrency: int,
+                       num_requests: int, isl: int, osl: int,
+                       kill_pid: int, kill_after_s: float,
+                       metrics_urls: "list[str] | None" = None) -> dict:
+    """Failover mode: closed-loop load with one worker SIGKILLed mid-run
+    (``--kill-pid`` names the victim; the operator reads it from the fleet
+    launcher). The question this mode answers is the ISSUE's headline: with
+    ``--stream-ckpt-blocks`` on, a crash costs at most one checkpoint
+    interval of recompute — so streams that were in flight at the kill
+    instant should RESUME (warm, from the last checkpoint) rather than
+    REPROMPT (cold, full replay) or get LOST.
+
+    Counts come from the authoritative server-side counters, scraped as
+    before/after deltas: ``dynamo_migration_attempts_total{outcome=...}``
+    on the frontend splits resumed vs reprompted ("retried") vs exhausted,
+    and ``dynamo_stream_ckpt_*`` across the worker status servers gives
+    checkpoint writes/resumes/recomputed-token totals. Client-side, requests
+    whose lifetime spans the kill instant form the DISRUPTED cohort; their
+    TTFT/ITL against the undisturbed cohort is the user-visible failover
+    cost (the max inter-chunk gap is the migration stall itself).
+
+    Caveat: the killed worker's counters die with it — stream_ckpt deltas
+    only fold the survivors' /metrics, so write counts can dip."""
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=30)
+    kill_at: list[float] = []
+
+    async def killer() -> None:
+        await asyncio.sleep(kill_after_s)
+        kill_at.append(time.perf_counter())
+        if kill_pid > 0:
+            try:
+                os.kill(kill_pid, signal.SIGKILL)
+                print(f"loadgen: SIGKILLed worker pid {kill_pid} at "
+                      f"t+{kill_after_s:.1f}s", file=sys.stderr)
+            except OSError as exc:
+                print(f"loadgen: kill {kill_pid} failed: {exc}",
+                      file=sys.stderr)
+
+    timed: list[tuple[float, RequestResult]] = []
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        cpt = await calibrate(session, url, model)
+        scrape_urls = metrics_urls or [url]
+        ckpt_before = await scrape_metrics(scrape_urls, "dynamo_stream_ckpt_")
+        mig_before = await scrape_migration([url])
+
+        async def one_timed(seed: int) -> None:
+            t0 = time.perf_counter()
+            res = await one_request(session, url, model, isl, osl, seed, cpt)
+            timed.append((t0, res))
+
+        counter = iter(range(10 ** 9))
+        t_start = time.perf_counter()
+        kill_task = asyncio.create_task(killer())
+        pending: set[asyncio.Task] = set()
+        issued = 0
+        while issued < num_requests or pending:
+            while issued < num_requests and len(pending) < concurrency:
+                pending.add(asyncio.create_task(one_timed(next(counter))))
+                issued += 1
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED)
+            for t in done:
+                t.result()  # surface unexpected exceptions
+        wall = time.perf_counter() - t_start
+        kill_task.cancel()
+
+        ckpt_after = await scrape_metrics(scrape_urls, "dynamo_stream_ckpt_")
+        mig_after = await scrape_migration([url])
+
+    good = [(t0, r) for t0, r in timed if r.ok]
+    bad = [r for _, r in timed if not r.ok]
+    killed_at = kill_at[0] if kill_at else None
+    disrupted = [r for t0, r in good
+                 if killed_at is not None and t0 <= killed_at <= t0 + r.latency_s]
+    steady = [r for t0, r in good
+              if killed_at is None or not (t0 <= killed_at <= t0 + r.latency_s)]
+
+    def cohort(rs: list[RequestResult]) -> dict:
+        ttfts = [r.ttft_s for r in rs]
+        itls = [x for r in rs for x in r.itl_s]
+        stalls = [max(r.itl_s) for r in rs if r.itl_s]
+        return {
+            "streams": len(rs),
+            "ttft_p50_s": round(percentile(ttfts, 50), 4),
+            "itl_p50_s": round(percentile(itls, 50), 5),
+            # worst single inter-chunk gap: for disrupted streams this IS
+            # the quarantine + re-dispatch + recompute stall
+            "itl_max_p99_s": round(percentile(stalls, 99), 4),
+        }
+
+    mig_delta: dict[str, int] = {}
+    if mig_before is not None and mig_after is not None:
+        for k in set(mig_before) | set(mig_after):
+            mig_delta[k] = int(mig_after.get(k, 0.0) - mig_before.get(k, 0.0))
+    ckpt_delta: dict[str, float] = {}
+    if ckpt_before is not None and ckpt_after is not None:
+        for k in set(ckpt_before) | set(ckpt_after):
+            short = k.removeprefix("dynamo_stream_ckpt_")
+            ckpt_delta[short] = round(
+                ckpt_after.get(k, 0.0) - ckpt_before.get(k, 0.0), 2)
+
+    dis, st = cohort(disrupted), cohort(steady)
+    return {
+        "mode": "failover",
+        "requests": len(timed),
+        "kill_pid": kill_pid,
+        "kill_after_s": kill_after_s,
+        "wall_s": round(wall, 3),
+        # server-side truth: resumed = warm ckpt resume; reprompted = cold
+        # retry (no checkpoint found); lost = client streams that ended
+        # without a finish reason plus server-side exhausted retries
+        "resumed": mig_delta.get("resumed", 0),
+        "reprompted": mig_delta.get("retried", 0),
+        "lost": len(bad) + mig_delta.get("exhausted", 0),
+        "errors": sorted({r.error for r in bad})[:5],
+        "migration_attempts": mig_delta,
+        "stream_ckpt": ckpt_delta,
+        "disrupted": dis,
+        "steady": st,
+        # the failover tax users actually feel: how much worse the cohort
+        # that crossed the crash did vs the one that didn't
+        "disrupted_itl_max_minus_steady_s": round(
+            dis["itl_max_p99_s"] - st["itl_max_p99_s"], 4),
+    }
+
+
 def _parse_mix(spec: str) -> list[tuple[str, float]]:
     """"interactive=0.2,standard=0.3,batch=0.5" → cumulative class mix."""
     mix = []
@@ -756,7 +912,8 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--url", default="http://127.0.0.1:8000")
     ap.add_argument("--model", default="tiny-llama")
     ap.add_argument("--mode",
-                    choices=["closed", "overload", "session", "coldstart"],
+                    choices=["closed", "overload", "session", "coldstart",
+                             "failover"],
                     default="closed",
                     help="closed: fixed-concurrency loop; overload: open-loop "
                          "Poisson arrivals past capacity (QoS shedding demo); "
@@ -765,7 +922,12 @@ def main(argv: list[str] | None = None) -> dict:
                          "coldstart: two identical mixed-geometry bursts "
                          "against a fresh worker, scraping "
                          "dynamo_xla_compile_* to report the cold-vs-warm "
-                         "TTFT ratio (XLA compile tax / AOT warmup demo)")
+                         "TTFT ratio (XLA compile tax / AOT warmup demo); "
+                         "failover: SIGKILL --kill-pid mid-run and report "
+                         "resumed/reprompted/lost stream counts plus the "
+                         "disrupted cohort's TTFT/ITL cost from "
+                         "dynamo_stream_ckpt_* and migration metrics "
+                         "(stream-checkpoint crash recovery demo)")
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--isl", type=int, default=128)
@@ -802,6 +964,13 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--expired-frac", type=float, default=0.05,
                     help="overload mode: fraction sent with an already-expired "
                          "deadline (must never reach prefill)")
+    ap.add_argument("--kill-pid", type=int, default=0,
+                    help="failover mode: worker pid to SIGKILL mid-run (0 = "
+                         "no kill; the before/after metric deltas still "
+                         "report)")
+    ap.add_argument("--kill-after", type=float, default=3.0,
+                    help="failover mode: seconds into the measured run to "
+                         "fire the kill")
     ap.add_argument("--chips", type=int, default=1,
                     help="chips serving the endpoint (for tok/s/chip)")
     ap.add_argument("--kv-dtype", choices=["bfloat16", "int8", "int4"],
@@ -864,6 +1033,22 @@ def main(argv: list[str] | None = None) -> dict:
             asyncio.run(fetch_traces(ns.url, ns.trace_out))
         if result["failed"]:
             print(f"loadgen: {result['failed']} failed requests: "
+                  f"{result['errors']}", file=sys.stderr)
+        return result
+
+    if ns.mode == "failover":
+        result = asyncio.run(run_failover(
+            ns.url, ns.model, ns.concurrency, ns.requests, ns.isl, ns.osl,
+            ns.kill_pid, ns.kill_after, metrics_urls=ns.metrics_url))
+        attach_fleet_slo(result)
+        print(json.dumps(result))
+        if ns.out:
+            with open(ns.out, "w") as f:
+                json.dump(result, f, indent=2)
+        if ns.trace_out:
+            asyncio.run(fetch_traces(ns.url, ns.trace_out))
+        if result["lost"]:
+            print(f"loadgen: {result['lost']} lost streams: "
                   f"{result['errors']}", file=sys.stderr)
         return result
 

@@ -211,7 +211,11 @@ class MockerFleet:
         if self.cfg.kv_store:
             self.kv_store = Proc(
                 ["-m", "dynamo_tpu.components.kv_store", "--host", "127.0.0.1",
-                 "--port", str(self.kv_port)],
+                 "--port", str(self.kv_port),
+                 # register lease-bound so the frontend's stream-checkpoint
+                 # lookup can discover the store (workers get the address
+                 # explicitly via --remote-kv-addr)
+                 "--coordinator", self.coord_url],
                 name="kv_store", env=self._common_env()).start()
             self.kv_store.wait_for_line("KV_STORE_READY", 20)
         self.workers = [self.start_worker(i) for i in range(self.cfg.workers)]
@@ -700,6 +704,114 @@ def scenario_retire_under_load(seed: int = 1234,
         return res
 
 
+def scenario_worker_kill_mid_decode(seed: int = 1234,
+                                    quick: bool = False) -> ScenarioResult:
+    """Crash-consistent stream checkpoints end to end (kvbm/stream_ckpt.py):
+    a worker is SIGKILLed at a seeded decode step while a stream is
+    mid-generation. The stream must NOT be lost: Migration finds the
+    checkpoint record in the G4 store and resumes on a fresh replica,
+    token-identical to an unkilled run (the mocker's md5 token stream
+    depends only on (request_id, index), so re-running the same request id
+    unkilled is an exact control), recomputing at most one checkpoint
+    interval. ``quick=True`` is the sub-30s tier-1 smoke shape."""
+    kill_after = 8 if quick else 12
+    ckpt_blocks = 1           # --stream-ckpt-blocks (base cadence)
+    interval_blocks = ckpt_blocks * 2   # standard-priority QoS degradation
+    plan = ChaosPlan.from_dict({"seed": seed, "rules": [
+        # SIGKILL the victim at a seeded decode step: hit 1 is the
+        # admission+prefill iteration, every later hit decodes one token.
+        {"point": "mocker.step", "kind": "kill", "rate": 1.0,
+         "count": 1, "after": kill_after},
+    ]})
+    cfg = FleetConfig(workers=1, kv_store=True, lease_ttl_s=3.0,
+                      speedup_ratio=50.0, chaos_plan=plan, chaos_seed=seed,
+                      worker_args=["--stream-ckpt-blocks", str(ckpt_blocks),
+                                   # keep token ids byte-decodable so the
+                                   # resumed-vs-control text check is non-vacuous
+                                   "--vocab-size", "260"])
+    with MockerFleet(cfg) as fleet:
+        victim = fleet.workers[0]
+        prompt = "ckpt victim stream context " * 3
+        max_tokens = 24
+        got: list[tuple[StreamOutcome, str]] = []
+        t = threading.Thread(target=lambda: got.append(
+            fleet.complete(prompt, "ckpt-victim", max_tokens=max_tokens,
+                           timeout=90.0)))
+        t.start()
+        victim.proc.wait(30)  # the seeded SIGKILL mid-decode
+
+        # Fresh replica WITHOUT the kill plan: the resume target.
+        fleet.cfg.chaos_plan = None
+        fleet.workers.append(fleet.start_worker(1))
+        fleet.workers[1].wait_for_line("WORKER_READY", 30)
+        bg: list[StreamOutcome] = []
+        if not quick:
+            bg = fleet.drive_load(n=6, max_tokens=8, concurrency=2,
+                                  timeout=60.0)
+        t.join(90)
+        outcomes = ([got[0][0]] if got
+                    else [StreamOutcome("ckpt-victim", "lost", "no response")])
+        resumed_text = got[0][1] if got else ""
+        # Control: the SAME request id, unkilled. Identical output proves
+        # the resumed stream was token-exact, not merely completed.
+        ctrl_o, ctrl_text = fleet.complete(prompt, "ckpt-victim",
+                                           max_tokens=max_tokens,
+                                           timeout=60.0)
+        outcomes.append(ctrl_o)
+        outcomes.extend(bg)
+
+        # The survivor's resume counters reach /engine_stats on its next
+        # publish tick — poll briefly instead of racing one snapshot.
+        stats: dict = {}
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            stats = fleet.engine_stats()
+            probe = InvariantChecker()
+            probe.check_ckpt_resume(stats, minimum=1)
+            if probe.report.passed:
+                break
+            time.sleep(0.25)
+        frontend_logs = fleet.frontend.logs()
+
+        res = _finish("worker_kill_mid_decode", fleet, outcomes, seed=seed)
+        ck = InvariantChecker()
+        ck.report = res.report
+        ck.check_ckpt_resume(stats, minimum=1)
+        res.report.details["ckpt"] = {
+            "resumed_text": resumed_text, "control_text": ctrl_text,
+            "kill_after": kill_after, "interval_blocks": interval_blocks}
+        if not resumed_text or resumed_text != ctrl_text:
+            res.report.fail(
+                "resumed stream output differs from the unkilled control "
+                f"run: {resumed_text!r} vs {ctrl_text!r}")
+        else:
+            res.report.ok("resumed_output_identical")
+        recomputed = sum(
+            int(m.get("stream_ckpt_resume_recomputed", 0) or 0)
+            for s in stats.values()
+            for m in (s.get("workers") or {}).values()
+            if isinstance(m, dict))
+        # One interval of recompute, plus the partial trailing block that
+        # by construction can never be checkpointed (only FULL committed
+        # blocks flush).
+        bound = (interval_blocks + 1) * cfg.block_size
+        res.report.details["ckpt"]["recomputed_tokens"] = recomputed
+        # bg streams run unkilled (resume count 1), so the whole recompute
+        # budget belongs to the victim stream.
+        if recomputed > bound:
+            res.report.fail(
+                f"checkpoint resume recomputed {recomputed} tokens, more "
+                f"than one interval (bound {bound})")
+        else:
+            res.report.ok("recompute_bounded_by_interval")
+        if "quarantined" in frontend_logs:
+            res.report.ok("killed_instance_quarantined")
+        else:
+            res.report.fail(
+                "frontend never quarantined the killed instance")
+        return res
+
+
 def scenario_scale_during_partition(seed: int = 1234) -> ScenarioResult:
     """Scale-down while the coordinator is PARTITIONED away: the retiring
     worker cannot delete its membership keys or write its status — the
@@ -771,6 +883,9 @@ SCENARIOS: dict[str, Callable[[int], ScenarioResult]] = {
     "retire_under_load": scenario_retire_under_load,
     "retire_under_load_smoke": lambda seed=1234: scenario_retire_under_load(
         seed, quick=True),
+    "worker_kill_mid_decode": scenario_worker_kill_mid_decode,
+    "worker_kill_mid_decode_smoke": lambda seed=1234:
+        scenario_worker_kill_mid_decode(seed, quick=True),
     "scale_during_partition": scenario_scale_during_partition,
 }
 

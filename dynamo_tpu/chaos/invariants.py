@@ -182,6 +182,29 @@ class InvariantChecker:
         else:
             self.report.ok("sessions_resumed_warm")
 
+    # -- checkpoint resume -------------------------------------------------
+    def check_ckpt_resume(self, engine_stats: Mapping[str, Any],
+                          minimum: int = 1) -> None:
+        """After an unplanned worker kill, surviving workers must have
+        warm-resumed at least ``minimum`` checkpointed streams
+        (kvbm/stream_ckpt.py): resumes >= kills for checkpointed streams —
+        the crash cost recompute, never the stream. ``engine_stats`` is the
+        frontend /engine_stats JSON."""
+        resumes = writes = 0
+        for stats in engine_stats.values():
+            for m in (stats.get("workers") or {}).values():
+                if isinstance(m, Mapping):
+                    resumes += int(m.get("stream_ckpt_resumes", 0) or 0)
+                    writes += int(m.get("stream_ckpt_writes", 0) or 0)
+        self.report.details["ckpt_resume"] = {
+            "stream_ckpt_resumes": resumes, "stream_ckpt_writes": writes}
+        if resumes < minimum:
+            self.report.fail(
+                f"no checkpoint resume: {resumes} stream(s) warm-resumed "
+                f"from checkpoints (needed >= {minimum})")
+        else:
+            self.report.ok("streams_resumed_from_ckpt")
+
     # -- metrics balance ---------------------------------------------------
     def check_metrics_balance(self, metrics_text: str) -> None:
         """shed + completed + failed == admitted + shed, from the frontend's

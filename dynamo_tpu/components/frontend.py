@@ -32,6 +32,36 @@ from dynamo_tpu.utils.logging import configure_logging, get_logger
 log = get_logger("frontend.main")
 
 
+def make_ckpt_lookup(rt: DistributedRuntime):
+    """Async stream-checkpoint lookup for the Migration operator.
+
+    Discovers the G4 remote store lazily on first use (the store may
+    advertise after the frontend starts) and runs the blocking record
+    fetch off-loop. Any failure degrades to None — Migration then falls
+    back to the plain reprompt path, never blocking recovery on the
+    checkpoint plane."""
+    state: dict = {"pool": None}
+
+    async def lookup(request_id: str) -> dict | None:
+        from dynamo_tpu.kvbm.remote import ckpt_client, discover_store
+
+        try:
+            if state["pool"] is None:
+                addr = await discover_store(rt.client)
+                if addr is None:
+                    return None
+                state["pool"] = ckpt_client(addr)
+            pool = state["pool"]
+            return await asyncio.get_running_loop().run_in_executor(
+                None, pool.get_stream_ckpt, request_id)
+        except Exception:  # noqa: BLE001 - store down ≠ recovery down
+            state["pool"] = None  # re-discover next time
+            log.exception("stream-checkpoint store lookup failed")
+            return None
+
+    return lookup
+
+
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser("dynamo-frontend")
     p.add_argument("--host", default="0.0.0.0")
@@ -101,6 +131,7 @@ class ModelWatcher:
         self.models = models
         self.args = ns
         self.image_encoder = None  # set by amain when --encoder-endpoint
+        self.lookup_ckpt = None    # set by amain (stream-ckpt warm resume)
         self._instances: dict[str, set[str]] = {}   # model -> instance keys
         self._pipelines: dict[str, tuple] = {}       # model -> (client, router)
         self._task: asyncio.Task | None = None
@@ -189,7 +220,14 @@ class ModelWatcher:
             router = push
 
             async def routed(req):
-                async for item in push.generate(req.to_dict(), req.request_id):
+                # Resolve the instance BEFORE streaming so a silently
+                # truncated stream (no ERR frame) can still be attributed
+                # to — and quarantine — the serving worker (Migration reads
+                # ``last_instance_id`` off the request).
+                iid = push.pick()
+                req.last_instance_id = iid
+                async for item in push.generate(req.to_dict(), req.request_id,
+                                                instance_id=iid):
                     yield item
 
         # The routed model pipeline as a typed operator chain (reference:
@@ -201,7 +239,8 @@ class ModelWatcher:
             MapOutput(LLMEngineOutput.from_dict),
             Migration(migration_limit=self.args.migration_limit,
                       wait_ready=client.wait_for_instances,
-                      on_instance_error=client.quarantine),
+                      on_instance_error=client.quarantine,
+                      lookup_ckpt=self.lookup_ckpt),
             sink=routed,
         )
         generate = pipeline.generate
@@ -286,13 +325,20 @@ async def amain(ns: argparse.Namespace) -> None:
             raise RuntimeError("encoder returned no response")
 
         watcher.image_encoder = image_encoder
+    # Crash recovery: broken streams first try an exact warm resume from
+    # the shared stream-checkpoint store (kvbm/stream_ckpt.py).
+    watcher.lookup_ckpt = make_ckpt_lookup(rt)
     await watcher.start()
     svc = HttpService(models, qos=qos_config_from_args(ns))
     # Recovery counters live next to the request counters they balance
     # against (InvariantChecker reads both from one /metrics scrape).
     from dynamo_tpu.frontend.migration import install_migration_metrics
+    from dynamo_tpu.kvbm.stream_ckpt import install_stream_ckpt_metrics
 
     install_migration_metrics(svc.metrics)
+    # Frontend-side stream-ckpt counters (TTL-expired records surface on
+    # the lookup path, next to the resume outcomes they explain).
+    install_stream_ckpt_metrics(svc.metrics)
     from dynamo_tpu import chaos
 
     if chaos.enabled():

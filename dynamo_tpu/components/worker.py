@@ -82,6 +82,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--ring-prefill-threshold", type=int, default=0,
                    help="sp>1 only: min prompt tokens for ring prefill "
                         "(0 = cost-model break-even, -1 = never)")
+    p.add_argument("--stream-ckpt-blocks", type=int, default=0,
+                   help="crash-consistent stream checkpoints: every N "
+                        "committed decode blocks (and once at prefill "
+                        "completion) flush the stream's KV + a resumable "
+                        "record to the G4 remote store so a worker kill "
+                        "costs at most one interval of recompute; cadence "
+                        "QoS-degrades (interactive 1x, standard 2x, batch "
+                        "4x). 0 = off; needs --remote-kv-addr")
     p.add_argument("--warmup-mode", choices=["off", "lazy", "full"],
                    default="lazy",
                    help="XLA compile ledger / AOT bucket warmup: off = no "
@@ -95,6 +103,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "warmup coverage < 1.0 (0 = unbounded)")
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--speedup-ratio", type=float, default=10.0, help="mocker only")
+    p.add_argument("--vocab-size", type=int, default=32000,
+                   help="mocker only: bound on synthesized token ids; values "
+                        "<= 260 keep every id inside the ByteTokenizer's "
+                        "byte range so completion text round-trips")
     p.add_argument("--no-kv-events", action="store_true")
     p.add_argument("--health-interval", type=float, default=5.0,
                    help="idle seconds before a health canary replays through "
@@ -290,6 +302,11 @@ async def amain(ns: argparse.Namespace) -> None:
 
         # Session retention feeds dynamo_session_* (engine/session.py).
         install_session_metrics(rt.metrics)
+    if ns.stream_ckpt_blocks > 0:
+        from dynamo_tpu.kvbm.stream_ckpt import install_stream_ckpt_metrics
+
+        # Crash checkpoints feed dynamo_stream_ckpt_* (kvbm/stream_ckpt.py).
+        install_stream_ckpt_metrics(rt.metrics)
     if ns.sp > 1:
         from dynamo_tpu.obs.ring_prefill import install_ring_prefill_metrics
 
@@ -313,9 +330,11 @@ async def amain(ns: argparse.Namespace) -> None:
             max_batch_size=ns.max_batch_size,
             max_model_len=ns.max_model_len,
             speedup_ratio=ns.speedup_ratio,
+            vocab_size=ns.vocab_size,
             remote_kv_addr=remote_kv,
             global_prefix_cache=ns.global_prefix_cache,
             session_ttl=ns.session_ttl,
+            stream_ckpt_blocks=ns.stream_ckpt_blocks,
             warmup_mode=ns.warmup_mode,
         ), event_sink=sink)
         stats_fn = engine.stats
@@ -355,6 +374,7 @@ async def amain(ns: argparse.Namespace) -> None:
             session_ttl=ns.session_ttl,
             session_tiers=not ns.no_session_tiers,
             ring_prefill_threshold=ns.ring_prefill_threshold,
+            stream_ckpt_blocks=ns.stream_ckpt_blocks,
             warmup_mode=ns.warmup_mode,
             warmup_deadline=ns.warmup_deadline,
         ), event_sink=sink,

@@ -43,6 +43,12 @@ from dynamo_tpu.engine.sampling import (
 )
 from dynamo_tpu.engine.scheduler import Phase, PrefillWork, Scheduler, Seq, StepPlan
 from dynamo_tpu.engine.session import SessionStore, get_session_metrics
+from dynamo_tpu.kvbm.stream_ckpt import (
+    CKPT_DRAWS_KEY,
+    CKPT_GENERATED_KEY,
+    build_ckpt_record,
+    get_stream_ckpt_metrics,
+)
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.config import ModelConfig, resolve_model_config
 from dynamo_tpu.obs.compile_ledger import (
@@ -76,6 +82,30 @@ def _pow2_bucket(n: int, lo: int, hi: int) -> int:
     return b
 
 
+@jax.jit
+def _advance_key_data(data: jax.Array, n: jax.Array) -> jax.Array:
+    """Key data after ``n`` sampler draws from ``data`` — replays
+    sampling.sample()'s per-draw chain (``new_key = split(key)[0]``) in one
+    fori_loop, so checkpoint resume restores a mid-stream PRNG state with a
+    single tiny dispatch (``n`` is a traced operand: one compile serves
+    every resume depth)."""
+    key = jax.random.wrap_key_data(data)
+    key = lax.fori_loop(0, n, lambda _, k: jax.random.split(k)[0], key)
+    return jax.random.key_data(key)
+
+
+def _derived_seed(request_id: str) -> int:
+    """Stable per-request sampler seed for requests that set none. Making
+    every stream's key a pure function of (seed, draws) is what lets a
+    checkpoint resume restore sampler state exactly — including for
+    unseeded requests, whose resume re-derives this same value from the
+    (unchanged) request id."""
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.sha256(request_id.encode()).digest()[:4], "big")
+
+
 @dataclass
 class EngineMetrics:
     """Engine-side stats published to the router/planner
@@ -99,6 +129,10 @@ class EngineMetrics:
     # Session turns that resumed from a drain-evacuated remote record
     # (pull-to-warm after another worker retired, runtime/drain.py).
     session_remote_resumes: int = 0
+    # Streams resumed warm from a crash checkpoint (kvbm/stream_ckpt.py):
+    # the migration operator replays the stream on a survivor with the
+    # stream_ckpt.* annotations stamped.
+    stream_ckpt_resumes: int = 0
     # KV-cache footprint (set once at engine construction): total device
     # bytes of the paged cache and whether int8 KV quantization is on —
     # exported as dynamo_engine_kv_cache_bytes / dynamo_engine_kv_quant_enabled.
@@ -123,6 +157,7 @@ class EngineMetrics:
             "spec_accepted": self.spec_accepted,
             "deadline_cancelled": self.deadline_cancelled,
             "session_remote_resumes": self.session_remote_resumes,
+            "stream_ckpt_resumes": self.stream_ckpt_resumes,
         }
 
 
@@ -468,10 +503,22 @@ class ModelRunner:
         return any(not isinstance(k[0], str) and k[5]
                    for k in self._step_fns)
 
-    def reset_slot(self, slot: int, seed: int | None) -> None:
+    def reset_slot(self, slot: int, seed: int | None, *, advance: int = 0,
+                   resume_tokens: "list[int] | None" = None) -> None:
+        """Initialize a seq's persistent sampling state. ``advance`` replays
+        that many sampler draws on the fresh key (sample()'s split chain is
+        a pure function of (seed, draws), so a checkpoint-resumed stream's
+        n+1'th draw is bit-identical to the unkilled run's at
+        decode_window=1); ``resume_tokens`` rebuilds the penalty counts
+        from the already-generated ledger riding the resume prompt."""
         self.counts = self.counts.at[slot].set(0)
+        if resume_tokens:
+            toks = jnp.asarray(resume_tokens, jnp.int32)
+            self.counts = self.counts.at[slot, toks].add(1)
         if seed is not None:
             k = jax.random.key_data(jax.random.key(seed)).astype(jnp.uint32)
+            if advance > 0:
+                k = _advance_key_data(k, jnp.int32(advance)).astype(jnp.uint32)
             self.keys = self.keys.at[slot].set(k)
 
     def dispatch(
@@ -1112,7 +1159,16 @@ class EngineCore:
                 # Fleet-wide prefix cache: committed blocks publish to the
                 # shared G4 store as they form, not only on eviction.
                 publish_tier=(remote if engine_cfg.global_prefix_cache
-                              else None))
+                              else None),
+                # Stream checkpoints park in the same shared store. Direct
+                # remote writes are single-host only (same rule as
+                # evacuate_sessions: a rank's KV shard in the SHARED store
+                # would corrupt cross-worker reads); multi-host ranks all
+                # see ckpt_tier=None, so enqueue stays rank-identical.
+                ckpt_tier=(remote
+                           if (engine_cfg.stream_ckpt_blocks > 0
+                               and jax.process_count() == 1)
+                           else None))
 
     def _guided_pieces(self) -> tuple[list[str], list[int]]:
         if self._guided_vocab is None:
@@ -1254,6 +1310,7 @@ class EngineCore:
         seq = self._seqs.get(request_id)
         if seq is None or seq.phase is Phase.FINISHED:
             return
+        self._reap_stream_ckpt(seq)
         self._trace_finish(seq, FinishReason.CANCELLED)
         self.sched.finish(seq, FinishReason.CANCELLED)
 
@@ -1282,6 +1339,95 @@ class EngineCore:
         if len(seq.tokens) >= self.engine_cfg.max_model_len:
             return FinishReason.LENGTH
         return None
+
+    # -- crash-consistent stream checkpoints (kvbm/stream_ckpt.py) -------
+    def _init_slot(self, seq: Seq) -> None:
+        """Reset a seq's sampling slot — restoring mid-stream PRNG state
+        and penalty counts when the request carries stream_ckpt.* resume
+        annotations. Every stream gets a concrete seed (explicit or
+        request-derived), so the key after n draws is a pure function of
+        the request — the invariant that makes sampled resume bit-identical
+        at decode_window=1."""
+        so = seq.req.sampling_options
+        seed = so.seed if so.seed is not None else _derived_seed(
+            seq.request_id)
+        ann = getattr(seq.req, "annotations", None) or {}
+        gen = int(ann.get(CKPT_GENERATED_KEY) or 0)
+        if gen <= 0:
+            self.runner.reset_slot(seq.slot, seed)
+            return
+        gen = min(gen, seq.prompt_len)
+        self.runner.reset_slot(
+            seq.slot, seed,
+            advance=int(ann.get(CKPT_DRAWS_KEY) or gen),
+            # The resume prompt's trailing ledger: rebuild the penalty
+            # counts the crashed worker had accumulated.
+            resume_tokens=seq.tokens[seq.prompt_len - gen:seq.prompt_len])
+
+    def _ckpt_interval(self, seq: Seq) -> int:
+        """Committed-block cadence for this seq, QoS-degraded from the
+        --stream-ckpt-blocks base: interactive streams checkpoint at the
+        configured interval, standard at 2x, batch at 4x — crash exposure
+        is a latency-SLO product, and batch recompute is cheap relative to
+        the store traffic it saves. 0 = checkpointing off."""
+        base = self.engine_cfg.stream_ckpt_blocks
+        if base <= 0:
+            return 0
+        if seq.qos_priority == "interactive":
+            return base
+        return base * (4 if seq.qos_priority == "batch" else 2)
+
+    def _maybe_stream_ckpt(self, seq: Seq) -> None:
+        """Enqueue a StreamCheckpoint when due: once at prefill completion
+        (the first emit's commit), then every interval committed blocks.
+        The decision reads only the commit stream + config, so multi-host
+        ranks stay in lockstep (the enqueue itself no-ops there —
+        ckpt_tier is single-host, see EngineCore.__init__)."""
+        k = self._ckpt_interval(seq)
+        if (k <= 0 or self.kvbm is None or self.kvbm.ckpt_tier is None
+                or seq.committed_blocks <= 0):
+            return
+        if 0 <= seq.ckpt_blocks and seq.committed_blocks - seq.ckpt_blocks < k:
+            return
+        start = max(seq.ckpt_blocks, 0)
+        hashes = seq.block_seq.sequence_hashes()[: seq.committed_blocks]
+        pairs = list(zip(seq.block_ids[start:seq.committed_blocks],
+                         hashes[start:]))
+        generated = seq.tokens[seq.prompt_len:]
+        so = seq.req.sampling_options
+        seed = so.seed if so.seed is not None else _derived_seed(
+            seq.request_id)
+        # Threefry key data is just the seed's two 32-bit words — the
+        # record carries the full PRNG state (key + draw counter) without
+        # touching the device.
+        record = build_ckpt_record(
+            seq.request_id, generated, hashes,
+            key_data=[(seed >> 32) & 0xFFFFFFFF, seed & 0xFFFFFFFF],
+            draws=len(generated), seed=seed, prompt_tokens=seq.prompt_len)
+        span = None
+        if seq.trace_ctx is not None:
+            span = get_tracer().start_span(
+                "engine.ckpt", ctx=seq.trace_ctx, request_id=seq.request_id,
+                blocks=len(pairs), generated=len(generated))
+        self.kvbm.enqueue_stream_ckpt(seq.request_id, record, pairs)
+        if span is not None:
+            get_tracer().end_span(span)
+        seq.ckpt_blocks = seq.committed_blocks
+
+    def _reap_stream_ckpt(self, seq: Seq) -> None:
+        """Finish-time reap: a finished stream (any reason) must not be
+        resumable. Only streams that ever checkpointed pay the store
+        round-trip."""
+        if self.kvbm is not None and seq.ckpt_blocks >= 0:
+            self.kvbm.delete_stream_ckpt(seq.request_id)
+
+    def ckpt_lag_blocks(self) -> int:
+        """Committed blocks of live streams not yet covered by a
+        checkpoint — the fleet's crash exposure, exported as
+        dynamo_stream_ckpt_lag_blocks."""
+        return sum(max(s.committed_blocks - max(s.ckpt_blocks, 0), 0)
+                   for s in list(self._seqs.values())
+                   if s.phase is not Phase.FINISHED)
 
     def step_begin(self) -> "PendingStep | None":
         """Plan one engine step and DISPATCH it to the device without
@@ -1323,10 +1469,27 @@ class EngineCore:
                     if seq.prefix_hit_blocks:
                         get_session_metrics().avoided_tokens.inc(
                             seq.prefix_hit_blocks * seq.block_size)
+        # Checkpoint-resume accounting mirrors the session pattern: the
+        # recompute a crash actually cost is the resume prompt MINUS what
+        # the admission onboard pulled back warm — measured once, on the
+        # first planned chunk.
+        for w in plan.prefill:
+            seq = w.seq
+            if seq.ckpt_counted:
+                continue
+            seq.ckpt_counted = True
+            ann = getattr(seq.req, "annotations", None) or {}
+            if int(ann.get(CKPT_GENERATED_KEY) or 0) > 0:
+                self.metrics.stream_ckpt_resumes += 1
+                sm = get_stream_ckpt_metrics()
+                sm.resumes.inc(1)
+                sm.resume_recomputed_tokens.inc(max(
+                    seq.prefill_target()
+                    - seq.prefix_hit_blocks * seq.block_size, 0))
 
         for seq in [w.seq for w in plan.prefill] + plan.decode:
             if not seq.slot_initialized and seq.slot >= 0:
-                self.runner.reset_slot(seq.slot, seq.req.sampling_options.seed)
+                self._init_slot(seq)
                 seq.slot_initialized = True
 
         # Decode and prefill run as two bucketed programs in the same step
@@ -1546,6 +1709,11 @@ class EngineCore:
         if count_decode:
             self.metrics.num_decode_tokens += len(emitted)
         self.sched.commit_computed_blocks(seq)
+        if reason is None:
+            # Checkpoint cadence rides the commit stream: first at prefill
+            # completion (this seq's first emit), then every interval
+            # committed blocks. Finishing streams skip straight to the reap.
+            self._maybe_stream_ckpt(seq)
         if seq.prefix_hit_blocks:
             self.metrics.prefix_hit_blocks += seq.prefix_hit_blocks
             seq.prefix_hit_blocks = 0
@@ -1557,6 +1725,7 @@ class EngineCore:
         )
         if reason is not None:
             out.finish_reason = reason
+            self._reap_stream_ckpt(seq)
             if (self.sessions is not None and seq.session_id is not None
                     and reason in (FinishReason.STOP, FinishReason.LENGTH)):
                 # Retain BEFORE sched.finish releases the seq's refs: the
@@ -2590,6 +2759,11 @@ class AsyncJaxEngine:
         out = self.core.metrics.snapshot(self.core.sched, self.core.pool)
         if self.core.kvbm is not None:
             out["kvbm"] = self.core.kvbm.snapshot()
+            if self.core.kvbm.ckpt_tier is not None:
+                # Crash exposure refreshes on the stats poll cadence — a
+                # gauge read between polls shows the last sweep's value.
+                get_stream_ckpt_metrics().lag_blocks.set(
+                    float(self.core.ckpt_lag_blocks()))
         if self.core.sessions is not None:
             out["session"] = self.core.sessions.snapshot()
         led = get_compile_ledger()

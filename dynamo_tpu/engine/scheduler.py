@@ -84,6 +84,13 @@ class Seq:
     # double-count the re-admission match).
     session_id: str | None = None
     session_counted: bool = False
+    # Crash-consistent stream checkpoints (kvbm/stream_ckpt.py): committed
+    # blocks covered by the last enqueued checkpoint (-1 = none yet; the
+    # first fires at prefill completion), and whether this seq's
+    # warm-resume metrics were counted (once, on its first planned chunk —
+    # preemption must not double-count).
+    ckpt_blocks: int = -1
+    ckpt_counted: bool = False
     # Tracing (obs/tracer.py): the wire TraceContext parsed off the
     # request annotations, the one currently-open phase span
     # (engine.queue → engine.prefill → engine.decode), and the token

@@ -20,8 +20,15 @@ Recovery discipline on each retry:
 
 from __future__ import annotations
 
+import asyncio
 from typing import AsyncIterator, Awaitable, Callable
 
+from dynamo_tpu.kvbm.stream_ckpt import (
+    CKPT_DRAWS_KEY,
+    CKPT_GENERATED_KEY,
+    CKPT_KEY_DATA_KEY,
+    CKPT_KEY_DRAWS_KEY,
+)
 from dynamo_tpu.protocols.common import FinishReason, PreprocessedRequest
 from dynamo_tpu.qos.deadline import deadline_of, expired
 from dynamo_tpu.runtime.client import NoInstancesError, StreamError
@@ -33,6 +40,9 @@ log = get_logger("migration")
 
 # A routed generate: request -> stream of LLMEngineOutput dicts.
 RoutedGenerate = Callable[[PreprocessedRequest], AsyncIterator[dict]]
+
+# Async checkpoint lookup: request_id -> StreamCheckpoint record (or None).
+CkptLookup = Callable[[str], Awaitable[dict | None]]
 
 MIGRATION_ATTEMPT_KEY = "migration.attempt"
 
@@ -49,7 +59,9 @@ class MigrationMetrics:
         self.attempts = registry.counter(
             "migration_attempts_total",
             "Request re-dispatch attempts after a broken worker stream, "
-            "by outcome (retried|exhausted|deadline)")
+            "by outcome (resumed|retried|exhausted|deadline) — resumed "
+            "means a stream checkpoint was found and the re-dispatch is a "
+            "warm, token-exact continuation")
 
 
 _metrics: MigrationMetrics | None = None
@@ -77,13 +89,20 @@ class Migration(Operator):
     def __init__(self, inner: RoutedGenerate | None = None,
                  migration_limit: int = 3,
                  wait_ready: Callable[[float], Awaitable[None]] | None = None,
-                 on_instance_error: Callable[[int], None] | None = None):
+                 on_instance_error: Callable[[int], None] | None = None,
+                 lookup_ckpt: CkptLookup | None = None):
         self.inner = inner
         self.migration_limit = migration_limit
         self.wait_ready = wait_ready  # e.g. EndpointClient.wait_for_instances
         # e.g. EndpointClient.quarantine: sideline the failing worker NOW
         # rather than waiting out its lease TTL.
         self.on_instance_error = on_instance_error
+        # Stream-checkpoint lookup against the shared G4 store. When it
+        # yields a record, the re-dispatch is stamped with stream_ckpt.*
+        # annotations: the engine restores the sampler PRNG to the exact
+        # post-suffix position and the committed blocks onboard warm, so
+        # the resumed stream is token-identical to the unbroken one.
+        self.lookup_ckpt = lookup_ckpt
 
     async def generate(self, req: PreprocessedRequest,
                        next: NextFn | None = None) -> AsyncIterator[dict]:
@@ -103,8 +122,14 @@ class Migration(Operator):
                     yield out
                 if finished:
                     return
-                # stream ended without finish_reason → treat as broken
-                raise StreamError("stream ended without finish reason")
+                # stream ended without finish_reason → treat as broken. The
+                # truncation itself carries no ERR frame, so attribute it to
+                # the worker the router last dispatched to (stamped as
+                # ``last_instance_id`` by the routing layer) — otherwise the
+                # quarantine below never fires for silent truncations.
+                raise StreamError(
+                    "stream ended without finish reason",
+                    instance_id=getattr(current, "last_instance_id", None))
             except (StreamError, NoInstancesError, ConnectionError, OSError) as exc:
                 if finished:
                     # The final chunk (finish_reason set) already reached the
@@ -135,15 +160,27 @@ class Migration(Operator):
                            "finish_reason": str(FinishReason.CANCELLED),
                            "error": "deadline exceeded during migration"}
                     return
-                get_migration_metrics().attempts.inc(outcome="retried")
-                log.info("migrating request %s (attempt %d/%d): %s",
-                         req.request_id, attempts, self.migration_limit, exc)
+                # Prefer an exact warm resume: if the dead worker left a
+                # stream checkpoint in the shared store, the re-dispatch
+                # continues bit-identically (greedy bitwise; sampled via the
+                # restored PRNG position) and recomputes at most one
+                # checkpoint interval. No record → today's reprompt path.
+                record = None
+                if self.lookup_ckpt is not None:
+                    try:
+                        record = await self.lookup_ckpt(req.request_id)
+                    except Exception:  # noqa: BLE001 - lookup is best-effort
+                        log.exception("stream-checkpoint lookup failed")
+                get_migration_metrics().attempts.inc(
+                    outcome="resumed" if record is not None else "retried")
+                log.info("migrating request %s (attempt %d/%d, %s): %s",
+                         req.request_id, attempts, self.migration_limit,
+                         "ckpt resume" if record is not None else "reprompt",
+                         exc)
                 # Back off so retries span the lease-expiry window — dead
                 # instances need a few seconds to vanish from discovery and
                 # replacements to appear (reference: RetryManager re-resolves
                 # instances between attempts).
-                import asyncio
-
                 await asyncio.sleep(min(1.0 * attempts, 2.5))
                 if self.wait_ready is not None:
                     try:
@@ -160,6 +197,18 @@ class Migration(Operator):
                 # trace; the attempt number marks them as a migration leg.
                 new_req.annotations = dict(req.annotations or {})
                 new_req.annotations[MIGRATION_ATTEMPT_KEY] = attempts
+                if record is not None:
+                    # Our own accumulated ledger is the COMPLETE suffix (we
+                    # saw every streamed token); the record's may lag by up
+                    # to one interval. The engine advances the per-stream
+                    # PRNG by the draw count and re-pins the checkpointed
+                    # blocks through the normal admission-time onboard.
+                    new_req.annotations[CKPT_GENERATED_KEY] = len(generated)
+                    new_req.annotations[CKPT_DRAWS_KEY] = len(generated)
+                    if record.get("key") is not None:
+                        new_req.annotations[CKPT_KEY_DATA_KEY] = list(record["key"])
+                        new_req.annotations[CKPT_KEY_DRAWS_KEY] = int(
+                            record.get("draws") or 0)
                 orig_max = req.stop_conditions.max_tokens
                 if orig_max is not None:
                     new_req.stop_conditions.max_tokens = max(orig_max - len(generated), 1)

@@ -28,6 +28,7 @@ from dynamo_tpu import chaos
 from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
 from dynamo_tpu.kvbm.metrics import get_prefix_cache_metrics
+from dynamo_tpu.kvbm.stream_ckpt import get_stream_ckpt_metrics
 from dynamo_tpu.kvbm.transfer import BlockTransferEngine
 from dynamo_tpu.utils.logging import get_logger
 
@@ -117,9 +118,13 @@ class OffloadManager:
     #: remembered published hashes (dedup window) — bounds memory, and a
     #: redundant re-publish past the window is an idempotent put.
     PUBLISH_MEMORY = 1 << 16
+    #: device-extract budget for stream-checkpoint blocks per flush — the
+    #: crash-recovery path shares the step's transfer bucket, so it gets
+    #: the same bounded slice as publish-on-commit.
+    CKPT_PER_FLUSH = 8
 
     def __init__(self, runner, pool: PrefixPool, tiers: list, transfer=None,
-                 vote_plans: bool = False, publish_tier=None):
+                 vote_plans: bool = False, publish_tier=None, ckpt_tier=None):
         assert tiers, "OffloadManager needs at least one tier"
         self.runner = runner
         self.pool = pool
@@ -143,10 +148,19 @@ class OffloadManager:
         # bounded memory (never on shared-tier lookups), so multi-host
         # ranks queue identical batches — no plan vote needed.
         self.publish_tier = publish_tier
+        # ckpt_tier: the shared G4 store stream checkpoints park in
+        # (kvbm/stream_ckpt.py). Blocks ride the normal tier namespace (so
+        # a survivor's admission onboard finds them); the record is written
+        # only AFTER every block it covers has flushed — crash-consistent
+        # ordering: a record in the store always points at reachable KV.
+        self.ckpt_tier = ckpt_tier
         self.stats = OffloadStats()
         self._pending: list[tuple[int, int]] = []  # (block_id, seq_hash)
         self._publish_pending: list[tuple[int, int]] = []
         self._published: OrderedDict[int, None] = OrderedDict()
+        self._ckpt_pending: list[tuple[int, int]] = []
+        # (request_id, record, seq_hashes still awaiting flush)
+        self._ckpt_records: list[tuple[str, dict, set[int]]] = []
         self._onboarding = False
         pool.evict_hook = self._on_evict
         if publish_tier is not None:
@@ -173,6 +187,17 @@ class OffloadManager:
         if self._publish_pending:
             self._publish_pending = [
                 (b, h) for b, h in self._publish_pending if b != block_id]
+        # Same staleness rule for queued checkpoint blocks: drop the pair
+        # AND release any record waiting on its hash — the record still
+        # writes (covering what did reach the store); a resume's onboard
+        # walk simply stops at the first unreachable hash.
+        if self._ckpt_pending:
+            dropped = {h for b, h in self._ckpt_pending if b == block_id}
+            if dropped:
+                self._ckpt_pending = [
+                    (b, h) for b, h in self._ckpt_pending if b != block_id]
+                for _, _, waiting in self._ckpt_records:
+                    waiting -= dropped
         if not getattr(top, "shared", False) and seq_hash in top:
             return
         self._pending.append((block_id, seq_hash))
@@ -198,7 +223,10 @@ class OffloadManager:
         EngineCore.step, inject_and_commit."""
         publish = self._publish_pending[: self.PUBLISH_PER_FLUSH]
         self._publish_pending = self._publish_pending[self.PUBLISH_PER_FLUSH:]
-        if not self._pending and not publish:
+        ckpt = self._ckpt_pending[: self.CKPT_PER_FLUSH]
+        self._ckpt_pending = self._ckpt_pending[self.CKPT_PER_FLUSH:]
+        if not self._pending and not publish and not ckpt:
+            self._flush_ckpt_records(frozenset())
             return 0
         # Chaos: an error here propagates into the engine step — the
         # offload cascade failing is engine-fatal, not silently droppable.
@@ -207,6 +235,7 @@ class OffloadManager:
         blocks = self.transfer.extract(
             self.runner.cache_k, self.runner.cache_v,
             [b for b, _ in pending] + [b for b, _ in publish]
+            + [b for b, _ in ckpt]
         )
         top = self.tiers[0]
         for (_, seq_hash), block in zip(pending, blocks):
@@ -215,11 +244,66 @@ class OffloadManager:
             # RemoteBlockPool.put degrades to a drop when the store is
             # unreachable — publish is strictly best-effort.
             self.publish_tier.put(seq_hash, block)
+        if ckpt:
+            sm = get_stream_ckpt_metrics()
+            off = len(pending) + len(publish)
+            for (_, seq_hash), block in zip(ckpt, blocks[off:]):
+                self.ckpt_tier.put(seq_hash, block)
+                sm.bytes.inc(int(getattr(block, "nbytes", 0)))
+        self._flush_ckpt_records({h for _, h in ckpt})
         if publish:
             self.stats.published_blocks += len(publish)
             get_prefix_cache_metrics().published_blocks.inc(len(publish))
         self.stats.offloaded_blocks += len(pending)
         return len(pending)
+
+    # -- stream checkpoints -------------------------------------------------
+    def enqueue_stream_ckpt(self, request_id: str, record: dict,
+                            pairs: "list[tuple[int, int]]") -> None:
+        """Queue a stream's newly committed ``(block_id, seq_hash)`` pairs
+        plus its StreamCheckpoint record. Blocks flush through the normal
+        budgeted path; the record is held back until every hash it waits on
+        has flushed, then written via ``ckpt_tier.put_stream_ckpt`` — so a
+        stored record never references KV the store hasn't seen. The
+        enqueue decision is a pure function of the commit stream + config,
+        so multi-host ranks queue identically (no plan vote)."""
+        if self.ckpt_tier is None:
+            return
+        queued = {h for _, h in self._ckpt_pending}
+        self._ckpt_pending.extend(
+            (b, h) for b, h in pairs if h not in queued)
+        self._ckpt_records.append(
+            (request_id, record, {h for _, h in pairs}))
+
+    def _flush_ckpt_records(self, flushed: "frozenset[int] | set[int]") -> None:
+        """Write every record whose block set is fully flushed (including
+        records enqueued with no new blocks). Best-effort: a failed put is
+        dropped — resume degrades to the previous checkpoint or reprompt."""
+        if not self._ckpt_records:
+            return
+        import msgpack
+
+        sm = get_stream_ckpt_metrics()
+        still: list[tuple[str, dict, set[int]]] = []
+        for rid, record, waiting in self._ckpt_records:
+            waiting -= flushed
+            if waiting:
+                still.append((rid, record, waiting))
+                continue
+            if self.ckpt_tier.put_stream_ckpt(rid, record):
+                sm.writes.inc(1)
+                sm.bytes.inc(len(msgpack.packb(record, use_bin_type=True)))
+        self._ckpt_records = still
+
+    def delete_stream_ckpt(self, request_id: str) -> None:
+        """Clean-finish reap: drop any queued record and delete the stored
+        one — a finished stream must not be resumable."""
+        if self.ckpt_tier is None:
+            return
+        self._ckpt_records = [
+            (rid, rec, w) for rid, rec, w in self._ckpt_records
+            if rid != request_id]
+        self.ckpt_tier.del_stream_ckpt(request_id)
 
     def stage_blocks(self, pairs: "list[tuple[int, int]]") -> int:
         """Write-through ``(block_id, seq_hash)`` pairs into the tier cascade
@@ -248,10 +332,12 @@ class OffloadManager:
 
     def drain_publish(self) -> int:
         """Flush the whole publish-on-commit queue (budgeted slices until
-        empty). Called when the engine goes idle — the final finalize's
-        commits would otherwise sit queued until the next step_begin."""
+        empty), plus any queued stream-checkpoint blocks/records. Called
+        when the engine goes idle — the final finalize's commits would
+        otherwise sit queued until the next step_begin."""
         total = 0
-        while self._publish_pending:
+        while (self._publish_pending or self._ckpt_pending
+               or self._ckpt_records):
             before = len(self._publish_pending)
             self.flush_pending()
             total += before - len(self._publish_pending)

@@ -59,6 +59,7 @@ class RemoteBlockServer:
     Ops: ``{"op": "put", "ns": str, "h": int, "data": bytes}`` → ``{"ok": True}``
          ``{"op": "get", "ns": str, "h": int}`` → ``{"ok": True, "data": bytes | None}``
          ``{"op": "has", "ns": str, "h": int}`` → ``{"ok": True, "has": bool}``
+         ``{"op": "del", "ns": str, "h": int}`` → ``{"ok": True, "deleted": bool}``
          ``{"op": "stats"}`` → ``{"ok": True, ...counters}``
     """
 
@@ -115,6 +116,11 @@ class RemoteBlockServer:
                 elif op == "has":
                     resp = {"ok": True,
                             "has": (msg["ns"], msg["h"]) in self._store}
+                elif op == "del":
+                    victim = self._store.pop((msg["ns"], msg["h"]), None)
+                    if victim is not None:
+                        self._bytes -= len(victim)
+                    resp = {"ok": True, "deleted": victim is not None}
                 elif op == "stats":
                     resp = {"ok": True, "blocks": len(self._store),
                             "bytes": self._bytes, **self.stats.to_dict()}
@@ -344,6 +350,56 @@ class RemoteBlockPool:
             return None
         return rec if isinstance(rec, dict) else None
 
+    # -- stream checkpoints -------------------------------------------------
+    # Crash recovery (kvbm/stream_ckpt.py): the engine parks an in-flight
+    # stream's StreamCheckpoint record here every K committed decode blocks
+    # (the blocks themselves ride put() under the normal tier namespace).
+    # Records live in a FIXED spec-independent namespace: the frontend's
+    # migration operator — which has no KVCacheSpec — must be able to look
+    # one up with nothing but the request id. TTL is enforced lazily on
+    # get: a crashed worker never deletes its records, so a stale one must
+    # read as a miss (and be reaped) rather than resurrect an ancient
+    # stream. Clean finishes delete the record eagerly.
+
+    CKPT_NAMESPACE = "stream|ckpt"
+
+    def put_stream_ckpt(self, request_id: str, record: dict) -> bool:
+        data = msgpack.packb(record, use_bin_type=True)
+        resp = self._call({"op": "put", "ns": self.CKPT_NAMESPACE,
+                           "h": self._session_hash(request_id), "data": data})
+        return bool(resp and resp.get("ok"))
+
+    def get_stream_ckpt(self, request_id: str,
+                        ttl: float | None = None) -> dict | None:
+        """The live checkpoint record for ``request_id``, or None (no
+        record / expired / store down). Expired records are deleted and
+        counted on the dynamo_stream_ckpt_expired counter."""
+        from dynamo_tpu.kvbm.stream_ckpt import (
+            DEFAULT_CKPT_TTL_S, get_stream_ckpt_metrics, parse_ckpt_record)
+
+        resp = self._call({"op": "get", "ns": self.CKPT_NAMESPACE,
+                           "h": self._session_hash(request_id)})
+        data = resp.get("data") if resp else None
+        if data is None:
+            return None
+        try:
+            rec = parse_ckpt_record(msgpack.unpackb(data, raw=False))
+        except Exception:
+            rec = None
+        if rec is None:
+            log.warning("undecodable stream checkpoint for %r", request_id)
+            return None
+        ttl = DEFAULT_CKPT_TTL_S if ttl is None else ttl
+        if ttl > 0 and rec["ts"] and time.time() - rec["ts"] > ttl:
+            get_stream_ckpt_metrics().expired.inc(1)
+            self.del_stream_ckpt(request_id)
+            return None
+        return rec
+
+    def del_stream_ckpt(self, request_id: str) -> None:
+        self._call({"op": "del", "ns": self.CKPT_NAMESPACE,
+                    "h": self._session_hash(request_id)})
+
     def __contains__(self, seq_hash: int) -> bool:
         resp = self._call({"op": "has", "ns": self._ns, "h": seq_hash})
         return bool(resp and resp.get("has"))
@@ -384,3 +440,13 @@ async def discover_store(client) -> str | None:
     for _, v in sorted(got.items()):
         return v.decode()
     return None
+
+
+def ckpt_client(addr: str, timeout: float = 1.0) -> RemoteBlockPool:
+    """A record-only client for processes with no KVCacheSpec (the
+    frontend's migration operator). Stream-checkpoint records live in the
+    fixed spec-independent namespace, so the stand-in geometry here is
+    never consulted — only the record ops are valid on this client."""
+    spec = KVCacheSpec(num_blocks=1, block_size=1, num_layers=1,
+                      num_kv_heads=1, head_dim=2)
+    return RemoteBlockPool(spec, addr, timeout=timeout)

@@ -22,6 +22,11 @@ from dynamo_tpu import chaos
 from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
 from dynamo_tpu.engine.session import SessionStore, get_session_metrics, session_id_of
+from dynamo_tpu.kvbm.stream_ckpt import (
+    CKPT_GENERATED_KEY,
+    build_ckpt_record,
+    get_stream_ckpt_metrics,
+)
 from dynamo_tpu.obs.compile_ledger import (
     enumerate_buckets,
     get_compile_ledger,
@@ -77,6 +82,14 @@ class MockEngineArgs:
     # Simulated wall seconds one cold-bucket compile stalls the step loop
     # (divided by speedup_ratio like every other simulated time).
     compile_s: float = 0.5
+    # Crash-consistent stream checkpoints mirror (kvbm/stream_ckpt.py):
+    # every this-many committed decode blocks (QoS-degraded like the JAX
+    # engine: interactive 1x, standard 2x, batch 4x) the stream's newly
+    # committed blocks (stand-in payloads) plus a resumable record flush
+    # to the shared store; a resume request carrying stream_ckpt.*
+    # annotations continues the md5 token sequence exactly where the
+    # killed stream stopped. 0 = off. Requires remote_kv_addr.
+    stream_ckpt_blocks: int = 0
 
 
 @dataclass
@@ -98,6 +111,13 @@ class _MockSeq:
     trace_ctx: object | None = None
     trace_span: object | None = None
     trace_tokens: int = 0
+    # Stream-checkpoint mirror: committed-block watermark of the last
+    # checkpoint (-1 = none yet), emitted-token ledger, and the resume
+    # offset (generated tokens already in the resume prompt, so the md5
+    # token sequence continues instead of restarting).
+    ckpt_blocks: int = -1
+    out_tokens: list[int] = field(default_factory=list)
+    ckpt_offset: int = 0
 
     def __post_init__(self) -> None:
         ann = getattr(self.req, "annotations", None)
@@ -105,6 +125,10 @@ class _MockSeq:
         self.deadline_ts = deadline_of(ann)
         self.session_id = session_id_of(ann)
         self.trace_ctx = trace_context_of(ann)
+        try:
+            self.ckpt_offset = int((ann or {}).get(CKPT_GENERATED_KEY) or 0)
+        except (TypeError, ValueError):
+            self.ckpt_offset = 0
 
 
 class MockEngine:
@@ -131,6 +155,9 @@ class MockEngine:
         self.deadline_cancelled = 0
         self.session_hits = 0
         self.session_remote_resumes = 0
+        self.stream_ckpt_writes = 0
+        self.stream_ckpt_resumes = 0
+        self.stream_ckpt_resume_recomputed = 0
         # Session retention mirror — the same store the JAX engine wires up.
         self.sessions: SessionStore | None = None
         if self.args.session_ttl > 0 and self.args.enable_prefix_caching:
@@ -417,6 +444,17 @@ class MockEngine:
                 seq.committed = len(matched)
                 self.prefix_lookups += max(len(hashes), 1)
                 self.prefix_hits += len(matched)
+                if seq.ckpt_offset > 0:
+                    # Checkpoint warm resume: the suffix past the imported
+                    # chain is the one-interval recompute the protocol
+                    # bounds — account it for the chaos invariant.
+                    self.stream_ckpt_resumes += 1
+                    sm = get_stream_ckpt_metrics()
+                    sm.resumes.inc(1)
+                    recomputed = max(
+                        len(seq.req.token_ids) - len(matched) * a.block_size, 0)
+                    self.stream_ckpt_resume_recomputed += recomputed
+                    sm.resume_recomputed_tokens.inc(recomputed)
                 if (self.sessions is not None and seq.session_id is not None
                         and matched):
                     get_session_metrics().avoided_tokens.inc(
@@ -479,8 +517,10 @@ class MockEngine:
                 LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
             self._finish(seq, FinishReason.CANCELLED)
             return
-        tok = self._token_for(seq.req.request_id, seq.generated)
+        tok = self._token_for(seq.req.request_id,
+                              seq.ckpt_offset + seq.generated)
         seq.generated += 1
+        seq.out_tokens.append(tok)
         seq.trace_tokens += 1
         if (seq.trace_span is not None and seq.trace_tokens >= self._trace_stride
                 and seq.trace_span.name == "engine.decode"):
@@ -505,9 +545,53 @@ class MockEngine:
             i = seq.committed
             self.pool.commit(seq.block_ids[i], hashes[i], hashes[i - 1] if i else None)
             seq.committed += 1
+        self._maybe_stream_ckpt(seq, hashes)
+
+    def _ckpt_interval(self, seq: _MockSeq) -> int:
+        """QoS-degraded cadence, mirroring EngineCore._ckpt_interval:
+        interactive checkpoints at the base interval, standard at 2x,
+        batch at 4x."""
+        base = self.args.stream_ckpt_blocks
+        if base <= 0 or self.remote is None:
+            return 0
+        if seq.priority == "interactive":
+            return base
+        if seq.priority == "batch":
+            return base * 4
+        return base * 2
+
+    def _maybe_stream_ckpt(self, seq: _MockSeq, hashes: list[int]) -> None:
+        """Mirror of EngineCore._maybe_stream_ckpt, device-free: push the
+        newly committed blocks (stand-in payloads, real hash keys) and the
+        resumable record to the shared store. First checkpoint fires at
+        prefill completion (``ckpt_blocks == -1``), then every interval."""
+        k = self._ckpt_interval(seq)
+        if k <= 0 or seq.committed <= 0:
+            return
+        if 0 <= seq.ckpt_blocks and seq.committed - seq.ckpt_blocks < k:
+            return
+        start = max(seq.ckpt_blocks, 0)
+        for h in hashes[start:seq.committed]:
+            self.remote.put(h, self._payload)
+        rec = build_ckpt_record(
+            seq.req.request_id, list(seq.out_tokens),
+            list(hashes[:seq.committed]),
+            draws=seq.ckpt_offset + seq.generated,
+            prompt_tokens=len(seq.req.token_ids))
+        if self.remote.put_stream_ckpt(seq.req.request_id, rec):
+            self.stream_ckpt_writes += 1
+            sm = get_stream_ckpt_metrics()
+            sm.writes.inc(1)
+            sm.bytes.inc((seq.committed - start) * self._payload.nbytes)
+        seq.ckpt_blocks = seq.committed
 
     def _finish(self, seq: _MockSeq, reason) -> None:
         seq.done = True
+        if self.remote is not None and seq.ckpt_blocks >= 0:
+            # Clean finish (any reason, incl. client walk-away): the stream
+            # no longer needs crash recovery — reap its checkpoint record
+            # so the store holds records for IN-FLIGHT streams only.
+            self.remote.del_stream_ckpt(seq.req.request_id)
         status = "ok"
         if reason is None or reason is FinishReason.CANCELLED:
             status = "cancelled"
@@ -594,6 +678,11 @@ class MockEngine:
             "deadline_cancelled": self.deadline_cancelled,
             "prefix_cache_imported_blocks": self.imported_blocks,
             "prefix_cache_published_blocks": self.published_blocks,
+            **({"stream_ckpt_writes": self.stream_ckpt_writes,
+                "stream_ckpt_resumes": self.stream_ckpt_resumes,
+                "stream_ckpt_resume_recomputed":
+                    self.stream_ckpt_resume_recomputed}
+               if self.args.stream_ckpt_blocks > 0 else {}),
             **({"session": self.sessions.snapshot(),
                 "session_hits": self.session_hits,
                 "session_remote_resumes": self.session_remote_resumes}

@@ -330,6 +330,11 @@ class KvPushRouter:
             req.request_id, req.token_ids, worker_ids,
             session_id=session_id_of(req.annotations))
         req.estimated_prefix_hit_blocks = overlap
+        # Recovery hint: remember which worker served this dispatch so a
+        # stream that ends without a finish reason (no ERR frame to carry
+        # the id) can still be attributed to — and quarantine — the
+        # failing instance (frontend/migration.py).
+        req.last_instance_id = wid
         if rspan is not None:
             get_tracer().end_span(rspan, worker_id=f"{wid:x}",
                                   overlap_blocks=overlap,

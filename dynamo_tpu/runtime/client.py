@@ -264,6 +264,13 @@ class PushRouter:
             return random.choice(ids)
         return ids[next(self._rr) % len(ids)]
 
+    def pick(self) -> int:
+        """Resolve the instance this policy would dispatch to NOW. Callers
+        that need the id *before* streaming (so recovery layers can
+        attribute a silent truncation to the serving worker) pick here and
+        pass it to generate() explicitly."""
+        return self._pick()
+
     async def generate(self, payload: Any, request_id: str | None = None,
                        instance_id: int | None = None) -> AsyncIterator[Any]:
         if instance_id is None:

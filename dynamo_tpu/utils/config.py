@@ -193,6 +193,15 @@ class EngineConfig:
     # N>0 = explicit token threshold, -1 = never (ring path fully off —
     # the engine behaves exactly like an sp=1 chunked engine).
     ring_prefill_threshold: int = 0
+    # Crash-consistent stream checkpoints (kvbm/stream_ckpt.py): every
+    # this-many committed decode blocks (and once at prefill completion)
+    # an in-flight stream's newly committed KV blocks plus a resumable
+    # StreamCheckpoint record flush to the shared G4 remote store, so an
+    # unplanned worker kill costs at most one interval of recompute. The
+    # cadence is QoS-degraded (interactive 1x, standard 2x, batch 4x).
+    # 0 = off. Requires remote_kv_addr; single-host engines only (the
+    # multi-host drain path still covers planned exits).
+    stream_ckpt_blocks: int = 0
 
     def mesh_shape(self) -> dict[str, int]:
         return {"data": self.dp, "pipe": self.pp, "model": self.tp,

@@ -94,6 +94,23 @@ def test_compile_drift_detected(tmp_path: Path):
                for p in problems)
 
 
+def test_stream_ckpt_drift_detected(tmp_path: Path):
+    """Bidirectional drift on the stream-checkpoint family: a registration
+    the declaration doesn't know about AND every declared-but-unregistered
+    name must each produce a violation."""
+    (tmp_path / "kvbm").mkdir()
+    (tmp_path / "kvbm" / "stream_ckpt.py").write_text(textwrap.dedent("""
+        def bind(reg):
+            reg.counter("stream_ckpt_writes", "checkpoint records flushed")
+            reg.counter("stream_ckpt_surprise", "undeclared registration")
+    """))
+    problems = lint_tree(tmp_path)
+    assert any("stream_ckpt_surprise" in p and "STREAM_CKPT_METRICS" in p
+               for p in problems)
+    assert any("stream_ckpt_resumes" in p and "does not register" in p
+               for p in problems)
+
+
 def test_prefix_cache_drift_detected(tmp_path: Path):
     """Bidirectional drift on the prefix-cache family: a registration the
     declaration doesn't know about AND every declared-but-unregistered name

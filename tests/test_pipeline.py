@@ -7,11 +7,23 @@ cancellation propagation, and the Migration operator in a linked chain.
 from __future__ import annotations
 
 import asyncio
+import time
 
 import pytest
 
 from dynamo_tpu.frontend.migration import Migration
-from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.kvbm.stream_ckpt import (
+    CKPT_DRAWS_KEY,
+    CKPT_GENERATED_KEY,
+    CKPT_KEY_DATA_KEY,
+    CKPT_KEY_DRAWS_KEY,
+)
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    StopConditions,
+)
+from dynamo_tpu.qos.deadline import DEADLINE_KEY
 from dynamo_tpu.runtime.client import StreamError
 from dynamo_tpu.runtime.pipeline import (
     FnSink,
@@ -141,3 +153,105 @@ async def test_migration_as_linked_operator():
     assert toks == [7, 8, 9]
     assert attempts[0] == [1, 2, 3]
     assert attempts[1] == [1, 2, 3, 7, 8]  # resumed with generated suffix
+
+
+async def test_migration_finish_then_teardown_no_duplicates():
+    """A failure AFTER the finish chunk (e.g. the END frame was lost) must
+    not re-dispatch: the client already has the terminal chunk, and a retry
+    would replay tokens after it."""
+    calls = {"n": 0}
+
+    async def worker(req):
+        calls["n"] += 1
+        yield {"token_ids": [1, 2], "finish_reason": "stop"}
+        raise StreamError("teardown after finish")
+
+    mig = Migration(inner=worker, migration_limit=3)
+    req = PreprocessedRequest(token_ids=[5])
+    req.request_id = "fin-teardown"
+    items = [x async for x in mig.generate(req)]
+    assert [t for i in items for t in i.get("token_ids", [])] == [1, 2]
+    assert calls["n"] == 1  # the teardown error consumed no retry
+
+
+async def test_migration_deadline_expired_while_broken():
+    """A stream that breaks after its QoS deadline passed is not
+    resurrected: the client gets a typed CANCELLED delta, never a silent
+    truncation or a zombie re-dispatch."""
+    calls = {"n": 0}
+
+    async def worker(req):
+        calls["n"] += 1
+        yield {"token_ids": [1]}
+        raise StreamError("worker died")
+
+    mig = Migration(inner=worker, migration_limit=3)
+    req = PreprocessedRequest(token_ids=[9])
+    req.request_id = "dl-expired"
+    req.annotations[DEADLINE_KEY] = time.time() - 1.0
+    items = [x async for x in mig.generate(req)]
+    assert calls["n"] == 1  # never re-dispatched
+    last = items[-1]
+    assert last["finish_reason"] == str(FinishReason.CANCELLED)
+    assert "deadline" in last["error"]
+    # the pre-break partial output reached the client exactly once
+    assert [t for i in items for t in i.get("token_ids", [])] == [1]
+
+
+async def test_migration_max_tokens_shrinks_from_original(monkeypatch):
+    """Across multiple retries the budget is ORIGINAL minus total generated
+    — not the previous attempt's (already-shrunk) budget minus the last
+    leg, which would double-count."""
+    real_sleep = asyncio.sleep
+    monkeypatch.setattr(asyncio, "sleep", lambda s: real_sleep(0))
+    budgets: list[int | None] = []
+
+    async def worker(req):
+        budgets.append(req.stop_conditions.max_tokens)
+        if len(budgets) == 1:
+            yield {"token_ids": [1, 2, 3]}
+            raise StreamError("die 1")
+        if len(budgets) == 2:
+            yield {"token_ids": [4, 5]}
+            raise StreamError("die 2")
+        yield {"token_ids": [6], "finish_reason": "stop"}
+
+    mig = Migration(inner=worker, migration_limit=3)
+    req = PreprocessedRequest(
+        token_ids=[0], stop_conditions=StopConditions(max_tokens=10))
+    req.request_id = "budget"
+    items = [x async for x in mig.generate(req)]
+    assert [t for i in items for t in i.get("token_ids", [])] == [1, 2, 3, 4, 5, 6]
+    assert budgets == [10, 7, 5]  # 10-(3), 10-(3+2): relative to original
+
+
+async def test_migration_ckpt_resume_stamps_annotations(monkeypatch):
+    """When the checkpoint lookup finds a record, the re-dispatch carries
+    the stream_ckpt.* annotations: the generated/draw counts come from
+    Migration's OWN complete token ledger (the stored record may lag one
+    interval), the PRNG key data from the record."""
+    real_sleep = asyncio.sleep
+    monkeypatch.setattr(asyncio, "sleep", lambda s: real_sleep(0))
+    seen: list[dict] = []
+
+    async def worker(req):
+        seen.append(dict(req.annotations))
+        if len(seen) == 1:
+            yield {"token_ids": [7, 8]}
+            raise StreamError("worker died")
+        yield {"token_ids": [9], "finish_reason": "stop"}
+
+    async def lookup(rid):
+        assert rid == "ck-resume"
+        return {"rid": rid, "generated": [7], "key": [3, 4], "draws": 1}
+
+    mig = Migration(inner=worker, migration_limit=2, lookup_ckpt=lookup)
+    req = PreprocessedRequest(token_ids=[1])
+    req.request_id = "ck-resume"
+    items = [x async for x in mig.generate(req)]
+    assert [t for i in items for t in i.get("token_ids", [])] == [7, 8, 9]
+    ann = seen[1]
+    assert ann[CKPT_GENERATED_KEY] == 2  # our ledger: both streamed tokens
+    assert ann[CKPT_DRAWS_KEY] == 2
+    assert ann[CKPT_KEY_DATA_KEY] == [3, 4]
+    assert ann[CKPT_KEY_DRAWS_KEY] == 1

@@ -1,0 +1,180 @@
+"""Crash-consistent stream checkpoints (kvbm/stream_ckpt.py): the record
+schema, the G4 store's spec-independent checkpoint namespace with lazy
+TTL, the engine's checkpoint cadence / crash-consistent record ordering /
+clean-finish reap, and the pure-function sampler resume — the key after n
+draws is a function of (seed, draws) alone, so a resumed sampled stream
+is bit-identical to the unkilled one.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.engine import (
+    EngineCore,
+    _advance_key_data,
+    _derived_seed,
+)
+from dynamo_tpu.kvbm.remote import RemoteBlockPool, ckpt_client
+from dynamo_tpu.kvbm.stream_ckpt import (
+    CKPT_DRAWS_KEY,
+    CKPT_GENERATED_KEY,
+    build_ckpt_record,
+    get_stream_ckpt_metrics,
+    parse_ckpt_record,
+)
+
+from tests.test_engine import make_req, run_to_completion, tiny_config
+from tests.test_kvbm_remote import SPEC, StoreFixture
+
+
+@pytest.fixture()
+def store():
+    s = StoreFixture()
+    yield s
+    s.close()
+
+
+# -- record schema -----------------------------------------------------------
+
+def test_record_roundtrip():
+    rec = build_ckpt_record("r1", [5, 6, 7], [11, 22], key_data=[1, 2],
+                            draws=3, seed=99, prompt_tokens=4)
+    parsed = parse_ckpt_record(rec)
+    assert parsed is not None
+    assert parsed["rid"] == "r1"
+    assert parsed["generated"] == [5, 6, 7]
+    assert parsed["hashes"] == [11, 22]
+    assert parsed["key"] == [1, 2]
+    assert parsed["draws"] == 3
+    assert parsed["seed"] == 99
+    assert parsed["prompt_tokens"] == 4
+    assert parsed["ts"] == pytest.approx(rec["ts"])
+    # key-less (greedy / derived-seed) records keep None
+    assert parse_ckpt_record(build_ckpt_record("r2", [], []))["key"] is None
+
+
+def test_record_malformed_degrades_to_none():
+    """A corrupt record must read as a miss (→ reprompt path), never raise
+    mid-recovery."""
+    assert parse_ckpt_record(None) is None
+    assert parse_ckpt_record("nope") is None
+    assert parse_ckpt_record({"rid": "x"}) is None  # no ledger
+    assert parse_ckpt_record({"generated": ["not", "ints"]}) is None
+    assert parse_ckpt_record({"generated": [1], "draws": "zero?"}) is None
+
+
+# -- store namespace ---------------------------------------------------------
+
+def test_store_ckpt_roundtrip_spec_independent(store):
+    """A record written by an engine-side pool (full KVCacheSpec) must be
+    readable by ckpt_client() — the frontend's record-only client, which
+    has no spec. That is the whole point of the fixed namespace."""
+    pool = RemoteBlockPool(SPEC, store.addr, fingerprint="m")
+    rec = build_ckpt_record("vic", [1, 2], [77], draws=2, prompt_tokens=5)
+    assert pool.put_stream_ckpt("vic", rec)
+    got = ckpt_client(store.addr).get_stream_ckpt("vic")
+    assert got is not None and got["generated"] == [1, 2]
+    assert got["hashes"] == [77]
+    pool.del_stream_ckpt("vic")
+    assert ckpt_client(store.addr).get_stream_ckpt("vic") is None
+
+
+def test_store_ckpt_ttl_expiry_reaps(store):
+    """A record a crashed worker never deleted reads as a miss once the TTL
+    lapses — counted on stream_ckpt_expired and eagerly deleted, so the
+    next lookup doesn't re-pay the parse."""
+    pool = RemoteBlockPool(SPEC, store.addr, fingerprint="m")
+    rec = build_ckpt_record("old", [9], [1])
+    rec["ts"] = time.time() - 10_000.0
+    assert pool.put_stream_ckpt("old", rec)
+    before = get_stream_ckpt_metrics().expired.get()
+    assert pool.get_stream_ckpt("old") is None          # default 600s TTL
+    assert get_stream_ckpt_metrics().expired.get() == before + 1
+    # ttl=0 disables the check — proves the record is GONE, not just stale
+    assert pool.get_stream_ckpt("old", ttl=0) is None
+
+
+# -- engine cadence / ordering / reap ---------------------------------------
+
+def test_engine_writes_ckpt_then_reaps_on_finish(store):
+    """With --stream-ckpt-blocks 1 the engine checkpoints as decode commits
+    blocks: mid-run the store holds a record whose ledger is a prefix of
+    the final output and whose hash chain is FULLY backed by stored blocks
+    (crash-consistent ordering); a clean finish deletes it."""
+    core = EngineCore(tiny_config(num_blocks=32, remote_kv_addr=store.addr,
+                                  stream_ckpt_blocks=1))
+    assert core.kvbm is not None and core.kvbm.ckpt_tier is not None
+    req = make_req(prompt=list(range(40, 52)), max_tokens=16, rid="ck1")
+    core.add_request(req)
+    reader = ckpt_client(store.addr)
+    seen_rec = None
+    toks: list[int] = []
+    for _ in range(200):
+        if not core.has_work():
+            break
+        for rid, out in core.step().items():
+            toks.extend(out.token_ids)
+        rec = reader.get_stream_ckpt("ck1")
+        if rec is not None:
+            seen_rec = rec
+            # ordering: every hash the record references is already stored
+            assert all(h in core.kvbm.ckpt_tier for h in rec["hashes"])
+    assert seen_rec is not None, "no checkpoint observed mid-run"
+    assert seen_rec["generated"] == toks[: len(seen_rec["generated"])]
+    assert seen_rec["prompt_tokens"] == 12
+    assert len(toks) == 16
+    # clean finish reaps the record — a finished stream is not resumable
+    assert reader.get_stream_ckpt("ck1") is None
+
+
+# -- sampler resume ----------------------------------------------------------
+
+def test_advance_key_data_matches_split_chain():
+    """_advance_key_data replays sample()'s per-draw split chain exactly."""
+    key = jax.random.key(123)
+    data = jax.random.key_data(key)
+    adv = _advance_key_data(data, jnp.int32(5))
+    k = key
+    for _ in range(5):
+        k = jax.random.split(k)[0]
+    np.testing.assert_array_equal(
+        np.asarray(adv), np.asarray(jax.random.key_data(k)))
+    # n=0 is the identity
+    np.testing.assert_array_equal(
+        np.asarray(_advance_key_data(data, jnp.int32(0))), np.asarray(data))
+
+
+def test_derived_seed_stable_per_request():
+    assert _derived_seed("abc") == _derived_seed("abc")
+    assert _derived_seed("abc") != _derived_seed("abd")
+
+
+def test_engine_sampled_resume_bit_identical():
+    """The tentpole contract, engine-level: a SAMPLED stream resumed from
+    annotations (same request id → same derived seed, draws advanced past
+    the replayed suffix) emits exactly the tokens the unkilled run would
+    have — no store involved, pure (seed, draws) function."""
+    prompt = list(range(60, 72))
+    ctrl = EngineCore(tiny_config(num_blocks=32))
+    control, fin = run_to_completion(
+        ctrl, [make_req(prompt=prompt, max_tokens=10, temperature=1.0,
+                        rid="same-rid")])
+    assert fin == {"same-rid"}
+    full = control["same-rid"]
+    assert len(full) == 10
+
+    # "crash" after 4 tokens: a fresh engine gets prompt + replayed suffix
+    resumed_core = EngineCore(tiny_config(num_blocks=32))
+    req = make_req(prompt=prompt + full[:4], max_tokens=6, temperature=1.0,
+                   rid="same-rid")
+    req.annotations[CKPT_GENERATED_KEY] = 4
+    req.annotations[CKPT_DRAWS_KEY] = 4
+    resumed, fin2 = run_to_completion(resumed_core, [req])
+    assert fin2 == {"same-rid"}
+    assert resumed["same-rid"] == full[4:]

@@ -40,7 +40,7 @@ PROVIDER_METRICS = {
         "num_steps", "prefill_tokens", "decode_tokens",
         "requests_finished", "preemptions", "prefix_hit_rate",
         "spec_proposed", "spec_accepted", "deadline_cancelled",
-        "session_remote_resumes",
+        "session_remote_resumes", "stream_ckpt_resumes",
     ),
 }
 
@@ -171,6 +171,19 @@ SLO_METRICS = (
     "slo_error_budget_remaining",
     "slo_burn_rate",
     "slo_violations_total",
+)
+
+# The crash-consistent stream-checkpoint family (kvbm/stream_ckpt.py
+# StreamCkptMetrics): checkpoint write volume, resume outcomes, and the
+# lag/TTL health gauges. Same bidirectional drift rule as
+# KV_TRANSFER_METRICS.
+STREAM_CKPT_METRICS = (
+    "stream_ckpt_writes",
+    "stream_ckpt_bytes",
+    "stream_ckpt_resumes",
+    "stream_ckpt_resume_recomputed_tokens",
+    "stream_ckpt_lag_blocks",
+    "stream_ckpt_expired",
 )
 
 # The failure-recovery family: health canaries (runtime/health.py),
@@ -497,6 +510,23 @@ def _lint_fleet_metrics(root: Path, problems: list[str]) -> None:
             "does not register it")
 
 
+def _lint_stream_ckpt_metrics(root: Path, problems: list[str]) -> None:
+    """The stream-checkpoint family must match what kvbm/stream_ckpt.py
+    actually registers — same no-silent-drift rule as KV_TRANSFER_METRICS."""
+    actual = _registered_names(root / "kvbm" / "stream_ckpt.py")
+    if actual is None:
+        return
+    declared = set(STREAM_CKPT_METRICS)
+    for key in sorted(actual - declared):
+        problems.append(
+            f"kvbm/stream_ckpt.py registers {key!r} but it is missing from "
+            "tools/lint_metrics.py STREAM_CKPT_METRICS")
+    for key in sorted(declared - actual):
+        problems.append(
+            f"STREAM_CKPT_METRICS declares {key!r} but kvbm/stream_ckpt.py "
+            "does not register it")
+
+
 def _lint_family_overlap(problems: list[str]) -> None:
     """No metric name may appear in two declared families: a duplicate
     means two modules would register (or two dashboards would grep) the
@@ -510,6 +540,7 @@ def _lint_family_overlap(problems: list[str]) -> None:
         "CONNECTOR_METRICS": CONNECTOR_METRICS,
         "RING_PREFILL_METRICS": RING_PREFILL_METRICS,
         "COMPILE_METRICS": COMPILE_METRICS,
+        "STREAM_CKPT_METRICS": STREAM_CKPT_METRICS,
         "FLEET_METRICS": FLEET_METRICS,
         "SLO_METRICS": SLO_METRICS,
         **{f"RECOVERY_METRICS[{'/'.join(parts)}]": names
@@ -588,6 +619,7 @@ def lint_tree(root: Path | None = None) -> list[str]:
     _lint_connector_metrics(root, problems)
     _lint_ring_prefill_metrics(root, problems)
     _lint_compile_metrics(root, problems)
+    _lint_stream_ckpt_metrics(root, problems)
     _lint_fleet_metrics(root, problems)
     _lint_recovery_metrics(root, problems)
     _lint_family_overlap(problems)
