@@ -66,6 +66,12 @@ Every line also carries a ``compile`` stamp from the XLA compile ledger
 seconds, and per-bucket compile counts and wall seconds — so a compile-time
 regression or a warmup-coverage hole lands on the same dashboard row as the
 throughput it taxes.
+
+Likewise a ``sched`` stamp from the scheduling ledger
+(obs/sched_ledger.py): goodput fraction (live vs bucket-padded FLOPs),
+padding-waste totals, admission-block and preempt-recompute causes, and HOL
+stall seconds — so a scheduling regression (batch raggedness, interference)
+shows up next to the throughput number it explains.
 """
 
 from __future__ import annotations
@@ -272,6 +278,24 @@ def _compile_stamp() -> dict | None:
         return None
 
 
+def _sched_stamp() -> dict | None:
+    """Scheduling-ledger stamp (obs/sched_ledger.py) attached to every
+    emitted line, same contract as ``_compile_stamp``: goodput, padding
+    waste, block/preempt causes, HOL stall totals. Best-effort — an
+    observability read must never cost the metric line. In the parent
+    process the ledger is empty; the child's line carries the populated
+    stamp and is forwarded as-is."""
+    try:
+        from dynamo_tpu.obs.sched_ledger import get_sched_ledger
+
+        led = get_sched_ledger()
+        if not led.enabled:
+            return {"enabled": False}
+        return led.snapshot()
+    except Exception:  # noqa: BLE001 — same best-effort rule as predicted
+        return None
+
+
 def _measure_session_turn2(deadline_at: float) -> dict | None:
     """Measured arm of the ``session`` entry: a real two-turn conversation
     against a fresh small EngineCore with prefix caching + session retention
@@ -373,6 +397,9 @@ def fail(stage: str, error: str, probe_log: str = "") -> None:
     comp = _compile_stamp()
     if comp is not None:
         out["compile"] = comp
+    sched = _sched_stamp()
+    if sched is not None:
+        out["sched"] = sched
     if probe_log.strip():
         out["probe_log"] = probe_log.strip()[-2000:]
     print(json.dumps(out))
@@ -514,6 +541,8 @@ def _cpu_fallback(probe_error: str, probe_log: str) -> None:
         # Child lines stamp their own (populated) ledger; this parent-side
         # stamp only covers a child that died before emitting one.
         out["compile"] = _compile_stamp()
+    if out.get("sched") is None:
+        out["sched"] = _sched_stamp()
     if probe_log.strip():
         out["probe_log"] = probe_log.strip()[-2000:]
     print(json.dumps(out))
@@ -659,6 +688,9 @@ def run_bench(deadline_at: float) -> dict:
         # Per-bucket compile seconds + warmup coverage for THIS run — the
         # ledger that just watched every jit entry point compile above.
         "compile": _compile_stamp(),
+        # Goodput / padding-waste / HOL view of the same steps — the
+        # scheduling ledger that just priced every dispatch above.
+        "sched": _sched_stamp(),
     }
 
 
@@ -763,6 +795,8 @@ def main() -> None:
         parsed.setdefault("fallback", None)
         if parsed.get("compile") is None:
             parsed["compile"] = _compile_stamp()
+        if parsed.get("sched") is None:
+            parsed["sched"] = _sched_stamp()
         print(json.dumps(parsed))
         sys.exit(proc.returncode)
     _cpu_fallback(

@@ -42,6 +42,17 @@ resumed-vs-reprompted-vs-lost stream counts (before/after deltas of
 ``dynamo_stream_ckpt_*`` family) and the disrupted cohort's TTFT/ITL cost
 against undisturbed streams — with ``--stream-ckpt-blocks`` on, disrupted
 streams should resume warm, recomputing at most one checkpoint interval.
+
+``--mode interference`` measures head-of-line prefill interference: steady
+closed-loop decode streams (short prompts, long outputs) with a few
+long-prompt arrivals (``--long-isl``, default 32k tokens) injected mid-run.
+Steady streams whose lifetime overlaps a long prompt's service window form
+the DISRUPTED cohort; the headline is their ITL p95 over the undisturbed
+cohort's, attributed server-side via the scraped ``dynamo_sched_*`` deltas
+(HOL stall seconds, interference row-seconds, goodput) and the per-culprit
+stall table from ``/debug/sched``. This is the before/after harness for
+the chunked prefill unification (ROADMAP item 2): chunking should pull the
+disrupted/steady ratio toward 1 while the stall attribution shrinks.
 """
 
 from __future__ import annotations
@@ -283,6 +294,59 @@ async def scrape_compile(urls: list[str]) -> "dict | None":
         out["coverage_min"] = (cov if out["coverage_min"] is None
                                else min(out["coverage_min"], cov))
     return out if seen else None
+
+
+async def scrape_sched(urls: list[str]) -> "dict | None":
+    """One snapshot of the scheduling-ledger series (obs/sched_ledger.py)
+    across the scraped /metrics endpoints. Stall seconds/counts come from
+    the ``dynamo_sched_hol_stall_seconds`` histogram's _sum/_count;
+    goodput is the MINIMUM across workers (the most padding-wasteful
+    worker bounds fleet efficiency). None when nothing was reachable."""
+    out = {"hol_stall_seconds": 0.0, "hol_stalls": 0.0,
+           "interference_row_seconds": 0.0, "padding_flops": 0.0,
+           "padding_hbm_bytes": 0.0, "preempt_recompute_tokens": 0.0,
+           "admission_blocked": 0.0, "goodput_min": None}
+    seen = False
+    for u in urls:
+        try:
+            sample = await fetch_metrics(u, timeout_s=5)
+        except Exception:
+            continue
+        seen = True
+        out["hol_stall_seconds"] += metric_sum(
+            sample, "dynamo_sched_hol_stall_seconds_sum")
+        out["hol_stalls"] += metric_sum(
+            sample, "dynamo_sched_hol_stall_seconds_count")
+        out["interference_row_seconds"] += metric_sum(
+            sample, "dynamo_sched_interference_row_seconds_total")
+        out["padding_flops"] += metric_sum(
+            sample, "dynamo_sched_padding_flops_total")
+        out["padding_hbm_bytes"] += metric_sum(
+            sample, "dynamo_sched_padding_hbm_bytes_total")
+        out["preempt_recompute_tokens"] += metric_sum(
+            sample, "dynamo_sched_preempt_recompute_tokens_total")
+        out["admission_blocked"] += metric_sum(
+            sample, "dynamo_sched_admission_blocked_total")
+        g = metric_sum(sample, "dynamo_sched_goodput_fraction")
+        out["goodput_min"] = (g if out["goodput_min"] is None
+                              else min(out["goodput_min"], g))
+    return out if seen else None
+
+
+async def fetch_sched_debug(url: str) -> "dict | None":
+    """Best-effort pull of <url>/debug/sched (the frontend merges worker
+    hol spans into trace_culprits). None on any failure — never a run
+    failure."""
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                    f"{url}/debug/sched",
+                    timeout=aiohttp.ClientTimeout(total=10)) as resp:
+                if resp.status != 200:
+                    return None
+                return await resp.json()
+    except Exception:
+        return None
 
 
 def fleet_slo_summary(sample: "dict[tuple[str, frozenset], float]") -> dict:
@@ -788,6 +852,151 @@ async def run_failover(url: str, model: str, concurrency: int,
     }
 
 
+async def run_interference(url: str, model: str, concurrency: int,
+                           num_requests: int, isl: int, osl: int,
+                           long_isl: int, long_requests: int,
+                           long_after_s: float, long_gap_s: float,
+                           metrics_urls: "list[str] | None" = None) -> dict:
+    """Interference mode: steady closed-loop decode streams with long-prompt
+    arrivals injected mid-run — the HOL-stall harness (obs/sched_ledger.py).
+
+    The steady cohort (short prompts, ``--osl`` outputs each) keeps
+    ``--concurrency`` decode streams resident. After ``--long-after``
+    seconds, ``--long-requests`` prompts of ``--long-isl`` tokens arrive
+    ``--long-gap`` apart; each one's prefill shares steps with (and so
+    delays) every co-resident decode stream. Steady requests whose
+    lifetime overlaps a long prompt's service window form the DISRUPTED
+    cohort. Attribution is server-side: ``dynamo_sched_*`` before/after
+    deltas (HOL stall seconds, interference row-seconds, padding waste,
+    goodput) plus the per-culprit stall table from ``/debug/sched`` —
+    victim ``engine.hol_stall`` spans carry the culprit request id, so the
+    degradation is NAMED, not inferred."""
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=30)
+    timed: list[tuple[float, RequestResult]] = []
+    long_results: list[RequestResult] = []
+    long_windows: list[tuple[float, float]] = []
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        cpt = await calibrate(session, url, model)
+        scrape_urls = metrics_urls or [url]
+        before = await scrape_sched(scrape_urls)
+        counter = iter(range(10 ** 9))
+
+        async def one_timed(seed: int) -> None:
+            t0 = time.perf_counter()
+            res = await one_request(session, url, model, isl, osl, seed, cpt)
+            timed.append((t0, res))
+
+        async def injector() -> None:
+            await asyncio.sleep(long_after_s)
+            for i in range(long_requests):
+                if i:
+                    await asyncio.sleep(long_gap_s)
+                t0 = time.perf_counter()
+                # Tiny OSL: the long request IS its prefill; its decode
+                # tail would blur the service window.
+                res = await one_request(session, url, model, long_isl, 4,
+                                        next(counter) + 500_000_000, cpt)
+                long_windows.append((t0, time.perf_counter()))
+                long_results.append(res)
+
+        t_start = time.perf_counter()
+        inject_task = asyncio.create_task(injector())
+        pending: set[asyncio.Task] = set()
+        issued = 0
+        while issued < num_requests or pending:
+            while issued < num_requests and len(pending) < concurrency:
+                pending.add(asyncio.create_task(one_timed(next(counter))))
+                issued += 1
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED)
+            for t in done:
+                t.result()  # surface unexpected exceptions
+        await inject_task
+        wall = time.perf_counter() - t_start
+
+        after = await scrape_sched(scrape_urls)
+        debug = await fetch_sched_debug(url)
+
+    good = [(t0, r) for t0, r in timed if r.ok]
+    bad = [r for _, r in timed if not r.ok]
+
+    def overlaps(t0: float, r: RequestResult) -> bool:
+        t1 = t0 + r.latency_s
+        return any(t0 <= we and wb <= t1 for wb, we in long_windows)
+
+    disrupted = [r for t0, r in good if overlaps(t0, r)]
+    steady = [r for t0, r in good if not overlaps(t0, r)]
+
+    def cohort(rs: list[RequestResult]) -> dict:
+        itls = [x for r in rs for x in r.itl_s]
+        stalls = [max(r.itl_s) for r in rs if r.itl_s]
+        return {
+            "streams": len(rs),
+            "itl_p50_s": round(percentile(itls, 50), 5),
+            "itl_p95_s": round(percentile(itls, 95), 5),
+            # worst single inter-token gap: for disrupted streams this is
+            # the long prompt's prefill wall itself
+            "itl_max_p95_s": round(percentile(stalls, 95), 4),
+        }
+
+    sched_delta: dict = {"scraped": False}
+    if before is not None and after is not None:
+        sched_delta = {
+            "scraped": True,
+            "hol_stall_seconds": round(
+                after["hol_stall_seconds"] - before["hol_stall_seconds"], 3),
+            "hol_stalls": int(after["hol_stalls"] - before["hol_stalls"]),
+            "interference_row_seconds": round(
+                after["interference_row_seconds"]
+                - before["interference_row_seconds"], 3),
+            "padding_flops": after["padding_flops"] - before["padding_flops"],
+            "padding_hbm_bytes": (after["padding_hbm_bytes"]
+                                  - before["padding_hbm_bytes"]),
+            "preempt_recompute_tokens": int(
+                after["preempt_recompute_tokens"]
+                - before["preempt_recompute_tokens"]),
+            "admission_blocked": int(after["admission_blocked"]
+                                     - before["admission_blocked"]),
+            # post-run gauge: the last step's goodput on the worst worker
+            "goodput_fraction": (round(after["goodput_min"], 4)
+                                 if after["goodput_min"] is not None
+                                 else None),
+        }
+    culprits: list = []
+    if debug is not None:
+        # The frontend's own ledger is empty (no engine in-process) but its
+        # recorder ingests worker hol spans — prefer that view; a worker's
+        # /debug/sched serves its ledger table directly.
+        culprits = debug.get("trace_culprits") or debug.get("top_culprits") or []
+
+    dis, st = cohort(disrupted), cohort(steady)
+    ratio = (round(dis["itl_p95_s"] / st["itl_p95_s"], 3)
+             if st["itl_p95_s"] else None)
+    return {
+        "mode": "interference",
+        "requests": len(timed),
+        "failed": len(bad),
+        "errors": sorted({r.error for r in bad})[:5],
+        "concurrency": concurrency,
+        "isl": isl,
+        "osl": osl,
+        "long_isl": long_isl,
+        "long_requests": len(long_results),
+        "long_failed": sum(1 for r in long_results if not r.ok),
+        "long_ttft_p50_s": round(percentile(
+            [r.ttft_s for r in long_results if r.ok], 50), 4),
+        "wall_s": round(wall, 3),
+        "disrupted": dis,
+        "steady": st,
+        # the interference users feel: how much worse token cadence gets
+        # while a long prompt's prefill shares the engine. Chunked prefill
+        # (ROADMAP item 2) should pull this toward 1.
+        "disrupted_over_steady_itl_p95": ratio,
+        "sched": sched_delta,
+        "top_culprits": culprits[:5],
+    }
+
+
 def _parse_mix(spec: str) -> list[tuple[str, float]]:
     """"interactive=0.2,standard=0.3,batch=0.5" → cumulative class mix."""
     mix = []
@@ -913,7 +1122,7 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--model", default="tiny-llama")
     ap.add_argument("--mode",
                     choices=["closed", "overload", "session", "coldstart",
-                             "failover"],
+                             "failover", "interference"],
                     default="closed",
                     help="closed: fixed-concurrency loop; overload: open-loop "
                          "Poisson arrivals past capacity (QoS shedding demo); "
@@ -927,7 +1136,12 @@ def main(argv: list[str] | None = None) -> dict:
                          "resumed/reprompted/lost stream counts plus the "
                          "disrupted cohort's TTFT/ITL cost from "
                          "dynamo_stream_ckpt_* and migration metrics "
-                         "(stream-checkpoint crash recovery demo)")
+                         "(stream-checkpoint crash recovery demo); "
+                         "interference: steady decode streams with long-"
+                         "prompt arrivals injected mid-run, reporting "
+                         "disrupted-vs-steady ITL p95 with the scraped "
+                         "dynamo_sched_* stall attribution (HOL / chunked-"
+                         "prefill harness)")
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--isl", type=int, default=128)
@@ -971,6 +1185,17 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--kill-after", type=float, default=3.0,
                     help="failover mode: seconds into the measured run to "
                          "fire the kill")
+    ap.add_argument("--long-isl", type=int, default=32768,
+                    help="interference mode: token length of the injected "
+                         "long prompts (keep under the engine's "
+                         "max_model_len)")
+    ap.add_argument("--long-requests", type=int, default=4,
+                    help="interference mode: long prompts injected")
+    ap.add_argument("--long-after", type=float, default=1.0,
+                    help="interference mode: seconds of steady decode "
+                         "before the first long prompt arrives")
+    ap.add_argument("--long-gap", type=float, default=0.5,
+                    help="interference mode: seconds between long prompts")
     ap.add_argument("--chips", type=int, default=1,
                     help="chips serving the endpoint (for tok/s/chip)")
     ap.add_argument("--kv-dtype", choices=["bfloat16", "int8", "int4"],
@@ -1049,6 +1274,24 @@ def main(argv: list[str] | None = None) -> dict:
             asyncio.run(fetch_traces(ns.url, ns.trace_out))
         if result["lost"]:
             print(f"loadgen: {result['lost']} lost streams: "
+                  f"{result['errors']}", file=sys.stderr)
+        return result
+
+    if ns.mode == "interference":
+        result = asyncio.run(run_interference(
+            ns.url, ns.model, ns.concurrency, ns.requests, ns.isl, ns.osl,
+            ns.long_isl, ns.long_requests, ns.long_after, ns.long_gap,
+            metrics_urls=ns.metrics_url))
+        _record_kv_dtype(result, ns.url, ns.kv_dtype)
+        attach_fleet_slo(result)
+        print(json.dumps(result))
+        if ns.out:
+            with open(ns.out, "w") as f:
+                json.dump(result, f, indent=2)
+        if ns.trace_out:
+            asyncio.run(fetch_traces(ns.url, ns.trace_out))
+        if result["failed"]:
+            print(f"loadgen: {result['failed']} failed requests: "
                   f"{result['errors']}", file=sys.stderr)
         return result
 

@@ -319,6 +319,13 @@ async def amain(ns: argparse.Namespace) -> None:
         # Installed for BOTH engine kinds — the mocker mirrors the ledger
         # device-free so fleet rollups see identical series either way.
         install_compile_metrics(rt.metrics)
+    from dynamo_tpu.obs.sched_ledger import install_sched_metrics
+
+    # Scheduling ledger feeds dynamo_sched_* (goodput, padding waste, HOL
+    # stalls — obs/sched_ledger.py). Also both engine kinds: the mocker
+    # mirrors step records device-free, so the fleet aggregator's
+    # decode_stall SLI evaluates in chaos scenarios without a TPU.
+    install_sched_metrics(rt.metrics)
 
     follower_shards: list[dict] = []
     if ns.engine == "mocker":

@@ -58,6 +58,7 @@ from dynamo_tpu.obs.compile_ledger import (
     get_compile_ledger,
 )
 from dynamo_tpu.obs.profiler import StepPerfProfiler, phase as _perf_phase
+from dynamo_tpu.obs.sched_ledger import HolStall, get_sched_ledger, step_geometry
 from dynamo_tpu.obs.tracer import get_tracer, trace_context_of
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
 from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
@@ -167,6 +168,10 @@ class PendingStep:
     (kind, rows, sample_rows, device tokens, device logprobs)."""
 
     batches: list[tuple[str, list, list[bool], Any, Any]] = field(default_factory=list)
+    # Scheduling-ledger context captured at plan time (decode_window,
+    # token-budget utilization, HOL victim list) — consumed by
+    # _record_step at finalize. None when DYN_SCHED_LEDGER=0.
+    sched: Any = None
 
 
 class ModelRunner:
@@ -1030,6 +1035,11 @@ class EngineCore:
         # coverage denominator in lazy mode (grows organically) and the
         # precompile worklist in full mode (EngineCore.warmup).
         get_compile_ledger().configure(engine_cfg.warmup_mode)
+        # Scheduling ledger gate (obs/sched_ledger.py): re-read the
+        # DYN_SCHED_LEDGER env at engine construction so tests flipping
+        # the env see the gate they set.
+        self.sched_led = get_sched_ledger()
+        self.sched_led.configure()
         self.model_cfg = resolve_model_config(engine_cfg.model)
         if engine_cfg.kv_dtype == "int4" and self.model_cfg.head_dim % 2:
             raise ValueError(
@@ -1559,6 +1569,25 @@ class EngineCore:
                 if sample_rows[i]:
                     seq.inflight_samples += 1
             pending.batches.append((kind, rows, sample_rows, toks, lps))
+        if self.sched_led.enabled:
+            used = (len(plan.decode) * plan.decode_window
+                    + sum(w.length for w in plan.prefill))
+            hol = None
+            if plan.prefill and plan.decode:
+                # Every decode-ready stream in this step waits out the
+                # prefill program before its token materializes; the
+                # culprit is the request contributing the largest chunk.
+                culprit = max(plan.prefill, key=lambda w: w.length)
+                hol = HolStall(
+                    culprit=culprit.seq.request_id,
+                    culprit_tokens=sum(w.length for w in plan.prefill),
+                    victims=[(s.trace_ctx, s.request_id, s.qos_priority)
+                             for s in plan.decode])
+            pending.sched = {
+                "decode_window": plan.decode_window,
+                "budget_util": used / max(self.sched.max_tokens_per_step, 1),
+                "hol": hol,
+            }
         return pending
 
     def _trace_plan(self, plan: StepPlan) -> None:
@@ -1644,6 +1673,16 @@ class EngineCore:
                        / max(self.engine_cfg.max_batch_size, 1)),
             **self.perf.measure(pending.batches, wall))
         self._trace_last_preempt = pc
+        if self.sched_led.enabled:
+            info = pending.sched or {}
+            self.sched_led.record_step(
+                wall_s=wall,
+                decode_window=info.get("decode_window", 1),
+                budget_util=info.get("budget_util", 0.0),
+                queue_depths=self.sched.waiting.depths(),
+                hol=info.get("hol"),
+                **step_geometry(self.model_cfg, self.engine_cfg,
+                                pending.batches))
 
     def _plan_verify(self, decode_seqs: list
                      ) -> tuple[list, list[list[int]], list]:
@@ -2771,6 +2810,11 @@ class AsyncJaxEngine:
             # Warmup coverage + compile stalls ride the published stats so
             # the planner and /debug/fleet can see cold-bucket workers.
             out["compile"] = led.snapshot()
+        sled = get_sched_ledger()
+        if sled.enabled:
+            # Goodput, padding waste, and stall attribution ride the same
+            # stats channel (bench stamps, planner feed, /debug/fleet).
+            out["sched"] = sled.snapshot()
         return out
 
 

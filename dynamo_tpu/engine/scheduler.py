@@ -26,6 +26,7 @@ from typing import Iterable
 from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
 from dynamo_tpu.engine.session import session_id_of
+from dynamo_tpu.obs.sched_ledger import get_sched_ledger
 from dynamo_tpu.protocols.common import FinishReason, PreprocessedRequest
 from dynamo_tpu.qos.deadline import NO_SPEC_KEY, deadline_of, expired, priority_of
 from dynamo_tpu.qos.wdrr import WdrrQueue
@@ -196,6 +197,10 @@ class Scheduler:
         self.running: list[Seq] = []
         self._slot_free: list[int] = list(range(max_batch_size - 1, -1, -1))
         self.preemption_count = 0
+        # Scheduling ledger (obs/sched_ledger.py): admission-block causes
+        # and preemption recompute accounting. Every hook is gated on
+        # .enabled so DYN_SCHED_LEDGER=0 adds zero work to the plan path.
+        self._sled = get_sched_ledger()
 
     # ------------------------------------------------------------------
     def add(self, seq: Seq) -> None:
@@ -227,6 +232,8 @@ class Scheduler:
         """Admit a waiting seq: match cached prefix, allocate prompt blocks,
         claim a sampling slot. Returns False under resource pressure."""
         if not self._slot_free:
+            if self._sled.enabled:
+                self._sled.record_block("batch_full")
             return False
         # Match at most prefill_target-1 tokens so at least one token is
         # computed (we need last-position state before decode can continue).
@@ -241,11 +248,15 @@ class Scheduler:
         # drain.
         if need + len(self.running) > self.pool.num_free:
             self.pool.release(matched)
+            if self._sled.enabled:
+                self._sled.record_block("no_free_blocks")
             return False
         try:
             fresh = self.pool.allocate(need)
         except NoFreeBlocks:
             self.pool.release(matched)
+            if self._sled.enabled:
+                self._sled.record_block("no_free_blocks")
             return False
         seq.block_ids = matched + fresh
         seq.committed_blocks = len(matched)
@@ -268,9 +279,13 @@ class Scheduler:
                 return False
         return True
 
-    def preempt(self, seq: Seq) -> None:
+    def preempt(self, seq: Seq, cause: str = "blocks") -> None:
         """Recompute-style preemption: release blocks, requeue at the front.
         (Reference pattern: vLLM recompute preemption, mirrored by the mocker.)"""
+        if self._sled.enabled:
+            # Every resident-KV token released here must be recomputed
+            # through prefill from position 0 on re-admission.
+            self._sled.record_preempt(seq.num_computed, cause)
         self.pool.release(seq.block_ids)
         seq.block_ids = []
         seq.committed_blocks = 0
@@ -311,8 +326,18 @@ class Scheduler:
         # Admit as many waiting seqs as resources allow.
         while self.waiting and len(self.running) < self.max_batch_size:
             if not self._try_admit(self.waiting[0]):
+                if self._sled.enabled and sum(
+                        1 for d in self.waiting.depths().values() if d) > 1:
+                    # The blocked head also gates every other non-empty
+                    # WDRR lane behind its lane commitment — seqs that
+                    # might have admitted had the round-robin pointer sat
+                    # elsewhere.
+                    self._sled.record_block("wdrr_gate")
                 break
             self.waiting.popleft()
+        if (self._sled.enabled and self.waiting
+                and len(self.running) >= self.max_batch_size):
+            self._sled.record_block("batch_full")
 
         # Decode batch first (every decodable stream advances every step);
         # grow blocks, preempting from the back on pressure.
@@ -361,7 +386,9 @@ class Scheduler:
                 if not victims:
                     break
                 victim = victims[0]
-                self.preempt(victim)
+                self.preempt(victim, cause=(
+                    "qos" if victim.qos_priority != seq.qos_priority
+                    else "blocks"))
                 if victim in decodable:
                     decodable.remove(victim)
             else:

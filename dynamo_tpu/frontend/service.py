@@ -140,6 +140,7 @@ class HttpService:
         self.app.router.add_post("/clear_kv_blocks", self.clear_kv_blocks)
         self.app.router.add_get("/engine_stats", self.engine_stats)
         self.app.router.add_get("/debug/traces", self.debug_traces)
+        self.app.router.add_get("/debug/sched", self.debug_sched)
         # KServe v2 protocol rides the same app/port (reference serves its
         # KServe gRPC flavor as a separate ingress; see frontend/kserve.py).
         from dynamo_tpu.frontend.kserve import register_kserve
@@ -207,6 +208,18 @@ class HttpService:
         if fmt != "chrome":
             return _error(400, f"unknown format '{fmt}' (chrome|jsonl)")
         return web.json_response(rec.dump_chrome(trace_id=trace_id))
+
+    async def debug_sched(self, request: web.Request) -> web.Response:
+        """Scheduling-ledger inspection (obs/sched_ledger.py): recent-step
+        ring, goodput trend, top HOL culprits. The frontend process runs
+        no engine, so its own ledger is usually empty — but worker
+        ``engine.hol_stall`` spans ship on the wire into this recorder, so
+        ``trace_culprits`` attributes fleet-wide stalls from here too
+        (docs/OBSERVABILITY.md)."""
+        from dynamo_tpu.obs.sched_ledger import get_sched_ledger
+
+        return web.json_response(
+            get_sched_ledger().debug_info(recorder=self.tracer.recorder))
 
     async def engine_stats(self, request: web.Request) -> web.Response:
         """Per-model engine stats (scheduler depth, KV usage, KVBM tiers) —

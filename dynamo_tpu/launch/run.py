@@ -176,6 +176,10 @@ async def run_http(ns: argparse.Namespace) -> None:
     # components/worker.py).
     from dynamo_tpu.obs.profiler import install_perf_metrics
     install_perf_metrics(svc.metrics)
+    # The scheduling ledger (dynamo_sched_*) likewise mirrors onto the
+    # single-process /metrics endpoint.
+    from dynamo_tpu.obs.sched_ledger import install_sched_metrics
+    install_sched_metrics(svc.metrics)
     if ns.session_ttl > 0:
         from dynamo_tpu.engine.session import install_session_metrics
 

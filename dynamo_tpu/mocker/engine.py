@@ -32,6 +32,7 @@ from dynamo_tpu.obs.compile_ledger import (
     get_compile_ledger,
     sig_for_rows,
 )
+from dynamo_tpu.obs.sched_ledger import HolStall, get_sched_ledger
 from dynamo_tpu.obs.tracer import get_tracer, trace_context_of
 from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.qos.config import class_rank
@@ -203,6 +204,14 @@ class MockEngine:
         self._ledger.configure(self.args.warmup_mode)
         if self.args.warmup_mode != "off":
             self._ledger.set_plan(enumerate_buckets(self._lattice_cfg))
+        # Scheduling-ledger mirror (obs/sched_ledger.py): each simulated
+        # step files a device-free step record — token-ratio goodput at
+        # the sig_for_rows bucket geometry, HOL victims (the running
+        # decode streams a serialized prefill makes wait), admission-block
+        # causes — so fleet/chaos scenarios exercise the dynamo_sched_*
+        # family and the decode_stall SLI without a TPU.
+        self._sled = get_sched_ledger()
+        self._sled.configure()
 
     def start(self) -> None:
         if self._task is None:
@@ -327,6 +336,14 @@ class MockEngine:
         digest = hashlib.md5(f"{rid}:{i}".encode()).digest()
         return int.from_bytes(digest[:4], "little") % self.args.vocab_size
 
+    def _queue_depths(self) -> dict[str, int]:
+        """Waiting seqs per QoS class — the mocker's stand-in for the real
+        scheduler's WdrrQueue.depths()."""
+        depths: dict[str, int] = {}
+        for s in self.waiting:
+            depths[s.priority] = depths.get(s.priority, 0) + 1
+        return depths
+
     async def generate(self, req: PreprocessedRequest) -> AsyncIterator[LLMEngineOutput]:
         self.start()
         if len(req.token_ids) >= self.args.max_model_len:
@@ -427,6 +444,8 @@ class MockEngine:
                     fresh = self.pool.allocate(max(need, 0))
                 except NoFreeBlocks:
                     self.pool.release(matched)
+                    if self._sled.enabled:
+                        self._sled.record_block("no_free_blocks")
                     if not self.running:
                         # Nothing running ⇒ no blocks will ever free up: the
                         # request is simply too large for the pool. Fail it
@@ -465,6 +484,9 @@ class MockEngine:
                                   prompt_tokens=len(seq.req.token_ids),
                                   prefix_hit_blocks=len(matched))
 
+            if (self._sled.enabled and self.waiting
+                    and len(self.running) >= a.max_batch_size):
+                self._sled.record_block("batch_full")
             self.steps += 1
             prefills = [s for s in self.running if not s.prefilled and not s.done]
             if prefills:
@@ -473,9 +495,27 @@ class MockEngine:
                 stall = self._mock_compile(
                     "prefill", 1, new_tokens, len(seq.block_ids),
                     victim=seq.trace_ctx)
-                await asyncio.sleep(
-                    stall +
-                    new_tokens * a.prefill_us_per_token / 1e6 / a.speedup_ratio)
+                wall = (stall + new_tokens * a.prefill_us_per_token
+                        / 1e6 / a.speedup_ratio)
+                await asyncio.sleep(wall)
+                if self._sled.enabled:
+                    # The mocker serializes prefill ahead of decode, so
+                    # every prefilled running stream literally waited this
+                    # whole iteration — the cleanest HOL victim set.
+                    victims = [s for s in self.running
+                               if s.prefilled and not s.done and s is not seq]
+                    sig = sig_for_rows("prefill", 1, max(new_tokens, 1),
+                                       len(seq.block_ids), self._lattice_cfg)
+                    self._sled.record_step(
+                        wall_s=wall, kinds=("prefill",), prefill_rows=1,
+                        live_tokens=new_tokens, sched_tokens=sig.b * sig.t,
+                        queue_depths=self._queue_depths(),
+                        hol=HolStall(
+                            culprit=seq.req.request_id,
+                            culprit_tokens=new_tokens,
+                            victims=[(v.trace_ctx, v.req.request_id,
+                                      v.priority) for v in victims])
+                        if victims else None)
                 seq.prefilled = True
                 self._trace_phase(seq, "engine.decode",
                                   batch=len(self.running))
@@ -490,8 +530,18 @@ class MockEngine:
                     max(len(s.block_ids) for s in decodes),
                     victim=next((s.trace_ctx for s in decodes
                                  if s.trace_ctx is not None), None))
-                await asyncio.sleep(
-                    stall + a.decode_itl_ms / 1e3 / a.speedup_ratio)
+                wall = stall + a.decode_itl_ms / 1e3 / a.speedup_ratio
+                await asyncio.sleep(wall)
+                if self._sled.enabled:
+                    sig = sig_for_rows(
+                        "decode", len(decodes), 1,
+                        max(len(s.block_ids) for s in decodes),
+                        self._lattice_cfg)
+                    self._sled.record_step(
+                        wall_s=wall, kinds=("decode",),
+                        decode_rows=len(decodes),
+                        live_tokens=len(decodes), sched_tokens=sig.b,
+                        queue_depths=self._queue_depths())
                 for seq in decodes:
                     # grow blocks as generated tokens fill them
                     total = len(seq.req.token_ids) + seq.generated + 1
@@ -689,6 +739,8 @@ class MockEngine:
                if self.sessions is not None else {}),
             **({"compile": self._ledger.snapshot()}
                if self._ledger.enabled else {}),
+            **({"sched": self._sled.snapshot()}
+               if self._sled.enabled else {}),
         }
 
     async def clear_kv(self) -> None:

@@ -100,6 +100,14 @@ DEFAULT_SLO_SPECS = (
             histogram="dynamo_frontend_inter_token_latency_seconds",
             threshold_s=0.2),
     SloSpec(name="availability", kind="availability", target=0.999),
+    # Scheduler interference (obs/sched_ledger.py): the fraction of HOL
+    # stalls — decode-ready streams waiting out a co-scheduled prefill —
+    # that stay under half a second. Burns when long prompts starve
+    # decode streams fleet-wide (the signal ROADMAP item 2's chunked
+    # prefill is meant to flatten).
+    SloSpec(name="decode_stall", kind="latency", target=0.99,
+            histogram="dynamo_sched_hol_stall_seconds",
+            threshold_s=0.5),
 )
 
 

@@ -55,6 +55,7 @@ class SystemStatusServer:
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/debug/sched", self._debug_sched)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, self.port)
@@ -82,6 +83,17 @@ class SystemStatusServer:
 
     async def _live(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "live"})
+
+    async def _debug_sched(self, request: web.Request) -> web.Response:
+        """Worker-local scheduling ledger (obs/sched_ledger.py): the
+        recent-step ring, goodput trend, and top HOL culprits of THIS
+        process's engine — span-level victim detail lives in the worker's
+        own FlightRecorder, so merge it in."""
+        from dynamo_tpu.obs.sched_ledger import get_sched_ledger
+        from dynamo_tpu.obs.tracer import get_tracer
+
+        return web.json_response(get_sched_ledger().debug_info(
+            recorder=get_tracer().recorder))
 
     async def _metrics(self, request: web.Request) -> web.Response:
         text = self.metrics.expose()

@@ -94,6 +94,23 @@ def test_compile_drift_detected(tmp_path: Path):
                for p in problems)
 
 
+def test_sched_drift_detected(tmp_path: Path):
+    """Bidirectional drift on the scheduling-ledger family: a registration
+    the SCHED_METRICS declaration doesn't know about AND every
+    declared-but-unregistered name must each produce a violation."""
+    (tmp_path / "obs").mkdir()
+    (tmp_path / "obs" / "sched_ledger.py").write_text(textwrap.dedent("""
+        def bind(reg):
+            reg.gauge("sched_goodput_fraction", "live/scheduled FLOPs")
+            reg.counter("sched_surprise", "undeclared registration")
+    """))
+    problems = lint_tree(tmp_path)
+    assert any("sched_surprise" in p and "SCHED_METRICS" in p
+               for p in problems)
+    assert any("sched_hol_stall_seconds" in p and "does not register" in p
+               for p in problems)
+
+
 def test_stream_ckpt_drift_detected(tmp_path: Path):
     """Bidirectional drift on the stream-checkpoint family: a registration
     the declaration doesn't know about AND every declared-but-unregistered

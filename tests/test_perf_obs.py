@@ -271,8 +271,14 @@ def test_engine_step_ring_carries_perf_counters():
 
 def test_profiler_disabled_is_inert(monkeypatch):
     """DYN_PERF_PROFILE=0: measure() returns {} BEFORE any cost-model math
-    (the overhead bound) and the engine still steps fine."""
+    (the overhead bound) and the engine still steps fine. The scheduling
+    ledger prices step geometry through the same cost model behind its own
+    independent gate (inertness covered by tests/test_sched_obs.py), so it
+    is disabled here too."""
+    from dynamo_tpu.obs.sched_ledger import SCHED_ENV, get_sched_ledger
+
     monkeypatch.setenv("DYN_PERF_PROFILE", "0")
+    monkeypatch.setenv(SCHED_ENV, "0")
     cfg = resolve_model_config("tiny-llama")
     prof = StepPerfProfiler(tiny_config_model(), tiny_config(),
                             device_kind="cpu")
@@ -288,6 +294,7 @@ def test_profiler_disabled_is_inert(monkeypatch):
     out, fin = run_to_completion(core, [make_req()])
     assert fin  # engine unaffected
     assert core.perf.enabled is False
+    get_sched_ledger().configure(True)  # don't leak the gate to other tests
 
 
 def tiny_config_model():

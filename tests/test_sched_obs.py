@@ -1,0 +1,571 @@
+"""Scheduler goodput & interference plane: ledger, HOL attribution, wiring.
+
+The load-bearing invariant is that ``step_geometry`` (obs/sched_ledger.py)
+prices the SAME padded program the engine's dispatch() compiled — the
+geometry tests below pin live and scheduled aggregates against
+hand-computed bucket math, so goodput is a pure FLOPs ratio a reviewer can
+recompute. The real-engine test is the tentpole acceptance check: a long
+prompt admitted over a live decode stream files ``engine.hol_stall``
+victim spans carrying the culprit request id.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.obs.sched_ledger import (
+    BLOCK_CAUSES,
+    PREEMPT_CAUSES,
+    SCHED_ENV,
+    HolStall,
+    SchedLedger,
+    get_sched_ledger,
+    get_sched_metrics,
+    hol_span_culprits,
+    install_sched_metrics,
+    sched_enabled,
+    step_geometry,
+)
+from dynamo_tpu.utils.config import EngineConfig
+from dynamo_tpu.utils.logging import TraceContext
+from dynamo_tpu.utils.metrics import (
+    MetricsRegistry,
+    metric_sum,
+    parse_prometheus,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_ledger():
+    """Isolate the process-global singleton: fresh totals and a fresh
+    metrics registry per test. Teardown forces enabled=True (not an env
+    re-read: a monkeypatched DYN_SCHED_LEDGER may still be set when this
+    finalizer runs)."""
+    led = get_sched_ledger()
+    led.reset()
+    led.configure(True)
+    install_sched_metrics(MetricsRegistry())
+    yield led
+    led.reset()
+    led.configure(True)
+
+
+def _req(tokens, max_tokens=4, rid=None, **annotations):
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    kw = {"request_id": rid} if rid is not None else {}
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens,
+                                       ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0),
+        annotations=annotations or None, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Env gate & token-ratio goodput
+# ---------------------------------------------------------------------------
+
+def test_env_gate(monkeypatch):
+    monkeypatch.delenv(SCHED_ENV, raising=False)
+    assert sched_enabled() is True
+    assert sched_enabled(default=False) is False
+    for off in ("0", "false", "no", "off"):
+        monkeypatch.setenv(SCHED_ENV, off)
+        assert sched_enabled() is False
+    monkeypatch.setenv(SCHED_ENV, "1")
+    assert sched_enabled() is True
+
+
+def test_token_ratio_goodput_and_snapshot():
+    led = SchedLedger()
+    rec = led.record_step(wall_s=0.01, kinds=("decode",), decode_rows=3,
+                          live_tokens=3, sched_tokens=4)
+    assert rec is not None
+    # no FLOPs given → token-ratio fallback: 3 live over 4 padded rows
+    assert rec.goodput == pytest.approx(0.75)
+    snap = led.snapshot(steps=True)
+    assert snap["steps_total"] == 1
+    assert snap["goodput_fraction"] == pytest.approx(0.75)
+    assert snap["live_tokens_total"] == 3
+    assert snap["sched_tokens_total"] == 4
+    assert snap["goodput_mean_recent"] == pytest.approx(0.75)
+    assert snap["steps"][0]["kinds"] == ["decode"]
+    # FLOPs take precedence over the token ratio when present; capped at 1
+    r2 = led.record_step(wall_s=0.01, kinds=("decode",), live_tokens=1,
+                         sched_tokens=4, live_flops=9.0, sched_flops=10.0)
+    assert r2.goodput == pytest.approx(0.9)
+    r3 = led.record_step(wall_s=0.01, kinds=("decode",), live_tokens=8,
+                         sched_tokens=4)
+    assert r3.goodput == 1.0
+
+
+# ---------------------------------------------------------------------------
+# step_geometry — pinned against hand-computed dispatch bucket math
+# ---------------------------------------------------------------------------
+
+def tiny_ec(**kw) -> EngineConfig:
+    defaults = dict(model="tiny-llama", max_model_len=128, block_size=16,
+                    max_batch_size=4, decode_bucket=(2, 4), prefill_chunk=32,
+                    num_blocks=64)
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+def _cost(model_cfg, ec, *, tokens, logit_rows, attn_q_ctx, kv_blocks):
+    from dynamo_tpu.obs import costmodel as cm
+
+    return cm.total_cost(cm.model_step_cost(
+        model_cfg, tokens=tokens, logit_rows=logit_rows,
+        attn_q_ctx=attn_q_ctx, kv_blocks=kv_blocks,
+        block_size=ec.block_size, kv_dtype="bfloat16", quantization="none"))
+
+
+def test_step_geometry_decode_hand_computed():
+    """3 decode rows at contexts 1/17/31 (block=16): live attn walks the
+    real block tables (1+2+2 blocks ×16); the padded program is b=4
+    (bucket of 3 in (2,4)), nblk=4 (pow2 of need 2, floor 4)."""
+    from dynamo_tpu.models.config import resolve_model_config
+
+    ec = tiny_ec()
+    mc = resolve_model_config("tiny-llama")
+    rows = [(None, 0, 1), (None, 16, 1), (None, 30, 1)]
+    toks = np.zeros(3, dtype=np.int32)
+    g = step_geometry(mc, ec, [("decode", rows, [True] * 3, toks, None)])
+    assert g["kinds"] == ("decode",)
+    assert g["prefill_rows"] == 0 and g["decode_rows"] == 3
+    assert g["live_tokens"] == 3 and g["sched_tokens"] == 4
+    live = _cost(mc, ec, tokens=3, logit_rows=3,
+                 attn_q_ctx=(1 + 2 + 2) * 16, kv_blocks=5)
+    sched = _cost(mc, ec, tokens=4, logit_rows=4,
+                  attn_q_ctx=4 * 1 * 4 * 16, kv_blocks=16)
+    assert g["live_flops"] == pytest.approx(live.flops)
+    assert g["sched_flops"] == pytest.approx(sched.flops)
+    assert g["live_bytes"] == pytest.approx(live.hbm_bytes)
+    assert g["sched_bytes"] == pytest.approx(sched.hbm_bytes)
+    led = SchedLedger()
+    rec = led.record_step(wall_s=0.01, **g)
+    assert rec.goodput == pytest.approx(
+        min(live.flops / sched.flops, 1.0))
+    assert 0.0 < rec.goodput < 1.0
+
+
+def test_step_geometry_prefill_hand_computed():
+    """One 20-token chunk: live prices 20 ragged tokens against 2 real
+    blocks; the padded program is b=1, t=pow2(20,16,32)=32, nblk=4."""
+    from dynamo_tpu.models.config import resolve_model_config
+
+    ec = tiny_ec()
+    mc = resolve_model_config("tiny-llama")
+    rows = [(None, 0, 20)]
+    toks = np.zeros((1, 20), dtype=np.int32)
+    g = step_geometry(mc, ec, [("prefill", rows, [True], toks, None)])
+    assert g["kinds"] == ("prefill",)
+    assert g["prefill_rows"] == 1 and g["decode_rows"] == 0
+    assert g["live_tokens"] == 20 and g["sched_tokens"] == 32
+    live = _cost(mc, ec, tokens=20, logit_rows=1,
+                 attn_q_ctx=20 * 2 * 16, kv_blocks=2)
+    sched = _cost(mc, ec, tokens=32, logit_rows=1,
+                  attn_q_ctx=1 * 32 * 4 * 16, kv_blocks=4)
+    assert g["live_flops"] == pytest.approx(live.flops)
+    assert g["sched_flops"] == pytest.approx(sched.flops)
+    # a mixed step sums both batches' aggregates into the kinds tuple
+    mixed = step_geometry(mc, ec, [
+        ("decode", [(None, 0, 1)], [True], np.zeros(1, dtype=np.int32),
+         None),
+        ("prefill", rows, [True], toks, None),
+    ])
+    assert mixed["kinds"] == ("decode", "prefill")
+    assert mixed["prefill_rows"] == 1 and mixed["decode_rows"] == 1
+    assert mixed["live_tokens"] == 21
+    assert mixed["live_flops"] > g["live_flops"]
+
+
+# ---------------------------------------------------------------------------
+# Block / preempt accumulators flush into the next step record
+# ---------------------------------------------------------------------------
+
+def test_block_and_preempt_flush(clean_ledger):
+    led = clean_ledger
+    assert set(BLOCK_CAUSES) == {"no_free_blocks", "batch_full", "wdrr_gate"}
+    assert set(PREEMPT_CAUSES) == {"blocks", "qos"}
+    led.record_block("batch_full")
+    led.record_block("batch_full")
+    led.record_block("no_free_blocks")
+    led.record_preempt(37, cause="qos")
+    led.record_preempt(5)  # default cause: blocks
+    rec = led.record_step(wall_s=0.01, kinds=("decode",), live_tokens=1,
+                          sched_tokens=2)
+    assert rec.blocked == {"batch_full": 2, "no_free_blocks": 1}
+    assert rec.preempt == {"qos": 37, "blocks": 5}
+    d = rec.to_dict()
+    assert d["blocked"] == rec.blocked
+    assert d["preempt_recompute_tokens"] == rec.preempt
+    # accumulators drained: the next step starts clean; totals persist
+    rec2 = led.record_step(wall_s=0.01, kinds=("decode",), live_tokens=1,
+                           sched_tokens=2)
+    assert rec2.blocked == {} and rec2.preempt == {}
+    snap = led.snapshot()
+    assert snap["admission_blocked"] == {"batch_full": 2,
+                                         "no_free_blocks": 1}
+    assert snap["preempt_recompute_tokens"] == {"qos": 37, "blocks": 5}
+    m = get_sched_metrics()
+    assert m.admission_blocked.get(cause="batch_full") == 2.0
+    assert m.preempt_recompute.get(cause="qos") == 37.0
+
+
+# ---------------------------------------------------------------------------
+# HOL attribution: retro victim spans, histogram, culprit table
+# ---------------------------------------------------------------------------
+
+def test_hol_victim_spans_and_metrics(clean_ledger):
+    from dynamo_tpu.obs.tracer import get_tracer
+
+    led = clean_ledger
+    reg = MetricsRegistry()
+    install_sched_metrics(reg)
+    ctx = TraceContext.new()
+    victims = [(ctx, "victim-1", "interactive"), (None, "victim-2", "batch")]
+    rec = led.record_step(
+        wall_s=0.05, kinds=("decode", "prefill"), prefill_rows=1,
+        decode_rows=2, live_tokens=34, sched_tokens=36,
+        hol=HolStall(culprit="culprit-1", culprit_tokens=64,
+                     victims=victims),
+        ts=100.0)
+    assert rec.hol_culprit == "culprit-1"
+    assert rec.hol_victims == 2
+    assert rec.interference_row_s == pytest.approx(0.1)
+    assert rec.to_dict()["hol"] == {
+        "culprit": "culprit-1", "victims": 2, "stall_s": 0.05,
+        "row_seconds": 0.1}
+    # only the traced victim gets a retroactive span, in its OWN trace
+    spans = [s for s in get_tracer().recorder.spans_for(ctx.trace_id)
+             if s.name == "engine.hol_stall"]
+    assert len(spans) == 1
+    s = spans[0]
+    assert s.attrs["culprit"] == "culprit-1"
+    assert s.attrs["culprit_tokens"] == 64
+    assert s.attrs["request_id"] == "victim-1"
+    assert s.attrs["qos_class"] == "interactive"
+    assert s.start == pytest.approx(99.95) and s.end == pytest.approx(100.0)
+    # both victims count in the histogram, labelled by their own class
+    rollup = parse_prometheus(reg.expose())
+    assert metric_sum(rollup, "dynamo_sched_hol_stall_seconds_count") == 2.0
+    assert ("dynamo_sched_hol_stall_seconds_count",
+            frozenset({("qos_class", "batch")})) in rollup
+    snap = led.snapshot()
+    assert snap["hol_victims_total"] == 2
+    assert snap["hol_stall_seconds_total"] == pytest.approx(0.1)
+    assert snap["interference_row_seconds_total"] == pytest.approx(0.1)
+    assert led.top_culprits()[0] == {"request_id": "culprit-1",
+                                     "stall_seconds": 0.1, "victims": 2}
+    # span-side aggregation (the frontend's cross-process view)
+    agg = [c for c in hol_span_culprits(get_tracer().recorder)
+           if c["request_id"] == "culprit-1"]
+    assert agg and agg[0]["victims"] >= 1
+
+
+def test_disabled_mode_records_nothing(clean_ledger):
+    led = clean_ledger
+    led.configure(False)
+    assert led.record_step(wall_s=1.0, kinds=("decode",), live_tokens=1,
+                           sched_tokens=8) is None
+    led.record_block("batch_full")
+    led.record_preempt(100)
+    assert led.steps_total == 0
+    assert led.blocked_totals == {} and led.preempt_totals == {}
+    snap = led.snapshot()
+    assert snap["enabled"] is False and snap["goodput_fraction"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Scheduler wiring: admission-block causes & preemption accounting
+# ---------------------------------------------------------------------------
+
+def _sched(pool, **kw):
+    from dynamo_tpu.engine.scheduler import Scheduler
+
+    defaults = dict(max_batch_size=4, prefill_chunk=16, max_model_len=64)
+    defaults.update(kw)
+    return Scheduler(pool, **defaults)
+
+
+def _seq(ntok, block_size=16, **req_kw):
+    from dynamo_tpu.engine.scheduler import Seq
+
+    return Seq(req=_req(range(ntok), **req_kw), block_size=block_size)
+
+
+def test_scheduler_batch_full_cause(clean_ledger):
+    from dynamo_tpu.engine.prefix_pool import PrefixPool
+
+    led = clean_ledger
+    sched = _sched(PrefixPool(16, 16), max_batch_size=1)
+    sched.add(_seq(17))
+    sched.add(_seq(17, rid="second"))
+    plan = sched.plan()
+    assert plan.prefill and len(sched.running) == 1
+    assert led.blocked_totals.get("batch_full", 0) >= 1
+    assert "no_free_blocks" not in led.blocked_totals
+
+
+def test_scheduler_no_free_blocks_and_wdrr_causes(clean_ledger):
+    from dynamo_tpu.engine.prefix_pool import PrefixPool
+    from dynamo_tpu.qos.deadline import PRIORITY_KEY
+
+    led = clean_ledger
+    # 3-block pool: the first 17-token prompt takes 2; the second then
+    # needs 2 + 1 running > 1 free → watermark refusal.
+    sched = _sched(PrefixPool(3, 16))
+    sched.add(_seq(17))
+    sched.plan()
+    assert led.blocked_totals == {}
+    sched.add(_seq(17, rid="starved"))
+    # second non-empty WDRR lane behind the blocked head → wdrr_gate too
+    sched.add(_seq(17, rid="vip", **{PRIORITY_KEY: "interactive"}))
+    sched.plan()
+    assert led.blocked_totals.get("no_free_blocks", 0) >= 1
+    assert led.blocked_totals.get("wdrr_gate", 0) >= 1
+
+
+def test_scheduler_preempt_recompute_tokens(clean_ledger):
+    from dynamo_tpu.engine.prefix_pool import PrefixPool
+
+    led = clean_ledger
+    sched = _sched(PrefixPool(16, 16))
+    seq = _seq(17)
+    sched.add(seq)
+    sched.plan()
+    seq.num_computed = 17  # as if the prefill chunk had been finalized
+    sched.preempt(seq, cause="qos")
+    assert led.preempt_totals == {"qos": 17}
+    assert seq.num_computed == 0 and seq in sched.waiting
+
+
+# ---------------------------------------------------------------------------
+# Real engine: mixed prefill/decode run files victim spans (acceptance)
+# ---------------------------------------------------------------------------
+
+def test_real_engine_hol_attribution(clean_ledger):
+    """A traced decode stream + a 33-token prompt admitted behind it: the
+    co-scheduled chunks stall the stream, and its trace gains
+    ``engine.hol_stall`` spans naming the long prompt as culprit."""
+    from dynamo_tpu.engine.engine import EngineCore
+    from dynamo_tpu.obs.tracer import TRACE_KEY, get_tracer
+
+    led = clean_ledger
+    ec = EngineConfig(model="tiny-llama", block_size=16, num_blocks=32,
+                      max_batch_size=2, max_model_len=64, prefill_chunk=16,
+                      decode_bucket=(1, 2), allow_random_weights=True)
+    core = EngineCore(ec)
+    ctx = TraceContext.new()
+    core.add_request(_req([10, 11, 12, 13, 14], max_tokens=12,
+                          **{TRACE_KEY: ctx.header()}))
+    for _ in range(50):
+        if any(s.in_decode for s in core.sched.running):
+            break
+        core.step()
+    assert any(s.in_decode for s in core.sched.running)
+    core.add_request(_req(range(100, 133), max_tokens=2, rid="long-prompt"))
+    for _ in range(300):
+        if not core.has_work():
+            break
+        core.step()
+    assert not core.has_work()
+    assert led.steps_total > 0
+    assert led.hol_victims_total >= 1
+    spans = [s for s in get_tracer().recorder.spans_for(ctx.trace_id)
+             if s.name == "engine.hol_stall"]
+    assert spans, "victim stream must carry hol spans in its own trace"
+    assert all(s.attrs["culprit"] == "long-prompt" for s in spans)
+    assert all(s.attrs["qos_class"] == "standard" for s in spans)
+    assert led.top_culprits()[0]["request_id"] == "long-prompt"
+    # goodput under ragged tiny batches: valid fraction, < 1 somewhere
+    assert all(0.0 < r.goodput <= 1.0 for r in led.steps)
+    assert any(r.goodput < 1.0 for r in led.steps)
+    kinds = {k for r in led.steps for k in r.kinds}
+    assert {"prefill", "decode"} <= kinds
+
+
+def test_real_engine_disabled_is_inert(clean_ledger, monkeypatch):
+    from dynamo_tpu.engine.engine import EngineCore
+
+    monkeypatch.setenv(SCHED_ENV, "0")
+    led = clean_ledger
+    ec = EngineConfig(model="tiny-llama", block_size=16, num_blocks=8,
+                      max_batch_size=1, max_model_len=32, prefill_chunk=16,
+                      decode_bucket=(1,), allow_random_weights=True)
+    core = EngineCore(ec)  # __init__ re-reads the env gate
+    assert led.enabled is False
+    core.add_request(_req([10, 11, 12, 13, 14], max_tokens=6))
+    for _ in range(100):
+        if not core.has_work():
+            break
+        core.step()
+    assert led.steps_total == 0
+    assert len(led.steps) == 0
+    assert led.blocked_totals == {} and led.preempt_totals == {}
+
+
+# ---------------------------------------------------------------------------
+# Mocker mirror: device-free parity for the whole family
+# ---------------------------------------------------------------------------
+
+def _mock_args(**kw):
+    from dynamo_tpu.mocker.engine import MockEngineArgs
+
+    defaults = dict(block_size=4, speedup_ratio=1000.0, max_model_len=256,
+                    num_blocks=128, compile_s=0.0)
+    defaults.update(kw)
+    return MockEngineArgs(**defaults)
+
+
+async def _gen_mock(engine, req):
+    toks = []
+    async for out in engine.generate(req):
+        toks.extend(out.token_ids)
+    return toks
+
+
+def test_mocker_sched_parity(clean_ledger):
+    from dynamo_tpu.mocker.engine import MockEngine
+
+    led = clean_ledger
+    eng = MockEngine(_mock_args())
+    asyncio.run(_gen_mock(eng, _req(range(5, 29), max_tokens=4)))
+    sched = eng.stats()["sched"]
+    assert sched["steps_total"] == led.steps_total > 0
+    assert 0.0 < sched["goodput_fraction"] <= 1.0
+    assert sched["live_tokens_total"] > 0
+    assert sched["sched_tokens_total"] >= sched["live_tokens_total"]
+    kinds = {k for r in led.steps for k in r.kinds}
+    assert {"prefill", "decode"} <= kinds
+
+
+def test_mocker_disabled_omits_stats_block(clean_ledger, monkeypatch):
+    from dynamo_tpu.mocker.engine import MockEngine
+
+    monkeypatch.setenv(SCHED_ENV, "0")
+    eng = MockEngine(_mock_args())
+    asyncio.run(_gen_mock(eng, _req(range(5, 29), max_tokens=2)))
+    assert "sched" not in eng.stats()
+    assert clean_ledger.steps_total == 0
+
+
+async def test_mocker_concurrent_hol_attribution(clean_ledger):
+    """e2e mirror of the real-engine acceptance check, device-free: a
+    traced long decode stream is stalled by a second request's prefill,
+    which names itself as culprit in the victim's span."""
+    from dynamo_tpu.mocker.engine import MockEngine
+    from dynamo_tpu.obs.tracer import TRACE_KEY, get_tracer
+
+    led = clean_ledger
+    eng = MockEngine(_mock_args(speedup_ratio=100.0))
+    ctx = TraceContext.new()
+    first_token = asyncio.Event()
+
+    async def run_victim():
+        async for _ in eng.generate(_req(range(5, 29), max_tokens=100,
+                                         rid="victim-a",
+                                         **{TRACE_KEY: ctx.header()})):
+            first_token.set()
+
+    victim = asyncio.create_task(run_victim())
+    await asyncio.wait_for(first_token.wait(), 10)
+    # victim-a is now prefilled and decoding: culprit-b's prefill chunk
+    # runs while it sits decode-ready
+    await _gen_mock(eng, _req(range(200, 232), max_tokens=2,
+                              rid="culprit-b"))
+    await asyncio.wait_for(victim, 30)
+    assert led.hol_victims_total >= 1
+    spans = [s for s in get_tracer().recorder.spans_for(ctx.trace_id)
+             if s.name == "engine.hol_stall"]
+    assert spans
+    assert any(s.attrs["culprit"] == "culprit-b" for s in spans)
+    assert any(c["request_id"] == "culprit-b" for c in led.top_culprits())
+    assert eng.stats()["sched"]["hol_victims_total"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# /debug/sched, metrics re-install, fleet decode_stall SLI
+# ---------------------------------------------------------------------------
+
+async def test_debug_sched_endpoint(clean_ledger):
+    import aiohttp
+
+    from dynamo_tpu.runtime.status import SystemStatusServer
+
+    clean_ledger.record_block("batch_full")
+    clean_ledger.record_step(wall_s=0.01, kinds=("decode",), decode_rows=2,
+                             live_tokens=2, sched_tokens=4,
+                             queue_depths={"standard": 1})
+    srv = SystemStatusServer(MetricsRegistry(), port=0)
+    port = await srv.start("127.0.0.1")
+    try:
+        async with aiohttp.ClientSession() as s:
+            d = await (await s.get(
+                f"http://127.0.0.1:{port}/debug/sched")).json()
+    finally:
+        await srv.stop()
+    assert d["enabled"] is True and d["env"] == SCHED_ENV
+    assert d["goodput_trend"] == [0.5]
+    assert d["totals"]["admission_blocked"] == {"batch_full": 1}
+    step = d["recent_steps"][-1]
+    assert step["goodput"] == 0.5 and step["kinds"] == ["decode"]
+    assert step["queue_depths"] == {"standard": 1}
+    assert step["blocked"] == {"batch_full": 1}
+    assert "top_culprits" in d and "trace_culprits" in d
+
+
+def test_install_republishes_gauges(clean_ledger):
+    clean_ledger.record_step(wall_s=0.01, kinds=("decode",), live_tokens=1,
+                             sched_tokens=2, budget_util=0.25,
+                             queue_depths={"batch": 3})
+    # a registry installed AFTER the step still exposes current gauges
+    reg = MetricsRegistry()
+    install_sched_metrics(reg)
+    rollup = parse_prometheus(reg.expose())
+    assert metric_sum(rollup, "dynamo_sched_goodput_fraction") == 0.5
+    assert metric_sum(
+        rollup, "dynamo_sched_token_budget_utilization") == 0.25
+    assert ("dynamo_sched_queue_depth",
+            frozenset({("qos_class", "batch")})) in rollup
+
+
+def test_fleet_decode_stall_sli():
+    from dynamo_tpu.obs.fleet import (
+        DEFAULT_SLO_SPECS,
+        FleetAggregator,
+        SloEngine,
+    )
+
+    spec = next(s for s in DEFAULT_SLO_SPECS if s.name == "decode_stall")
+    assert spec.kind == "latency"
+    assert spec.histogram == "dynamo_sched_hol_stall_seconds"
+    assert spec.threshold_s == 0.5
+    rollup = parse_prometheus("\n".join([
+        'dynamo_sched_hol_stall_seconds_bucket{qos_class="standard",'
+        'le="0.02"} 3',
+        'dynamo_sched_hol_stall_seconds_bucket{qos_class="standard",'
+        'le="0.5"} 8',
+        'dynamo_sched_hol_stall_seconds_bucket{qos_class="standard",'
+        'le="+Inf"} 10',
+        'dynamo_sched_hol_stall_seconds_count{qos_class="standard"} 10',
+    ]) + "\n")
+    agg = FleetAggregator(None, registry=MetricsRegistry())
+    # good = cumulative count at the smallest bound >= 0.5s
+    assert agg._slo_counts(spec, rollup) == (8.0, 10.0)
+    eng = SloEngine([spec], registry=MetricsRegistry())
+    eng.observe("decode_stall", 0.0, 0.0, t=0.0)
+    eng.observe("decode_stall", 8.0, 10.0, t=300.0)
+    out = eng.evaluate()
+    assert out["decode_stall"]["kind"] == "latency"
+    assert out["decode_stall"]["good"] == 8.0
+    assert out["decode_stall"]["total"] == 10.0

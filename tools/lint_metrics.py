@@ -152,6 +152,23 @@ COMPILE_METRICS = (
     "xla_compile_warmup_buckets",
 )
 
+# The scheduling-ledger family (obs/sched_ledger.py SchedMetrics):
+# per-step goodput/padding-waste gauges, admission/preemption cause
+# counters, and the HOL-stall histogram. Same bidirectional drift rule
+# as KV_TRANSFER_METRICS.
+SCHED_METRICS = (
+    "sched_goodput_fraction",
+    "sched_token_budget_utilization",
+    "sched_queue_depth",
+    "sched_steps_total",
+    "sched_admission_blocked_total",
+    "sched_preempt_recompute_tokens_total",
+    "sched_padding_flops_total",
+    "sched_padding_hbm_bytes_total",
+    "sched_hol_stall_seconds",
+    "sched_interference_row_seconds_total",
+)
+
 # The fleet-aggregation family (obs/fleet.py FleetAggregator): scrape
 # attempts/failures, target freshness, and sweep latency. Same
 # bidirectional drift rule as KV_TRANSFER_METRICS.
@@ -481,6 +498,23 @@ def _lint_compile_metrics(root: Path, problems: list[str]) -> None:
             "does not register it")
 
 
+def _lint_sched_metrics(root: Path, problems: list[str]) -> None:
+    """The scheduling-ledger family must match what obs/sched_ledger.py
+    actually registers — same no-silent-drift rule as KV_TRANSFER_METRICS."""
+    actual = _registered_names(root / "obs" / "sched_ledger.py")
+    if actual is None:
+        return
+    declared = set(SCHED_METRICS)
+    for key in sorted(actual - declared):
+        problems.append(
+            f"obs/sched_ledger.py registers {key!r} but it is missing "
+            "from tools/lint_metrics.py SCHED_METRICS")
+    for key in sorted(declared - actual):
+        problems.append(
+            f"SCHED_METRICS declares {key!r} but obs/sched_ledger.py "
+            "does not register it")
+
+
 def _lint_fleet_metrics(root: Path, problems: list[str]) -> None:
     """FLEET_METRICS + SLO_METRICS together must match what obs/fleet.py
     actually registers — same no-silent-drift rule as KV_TRANSFER_METRICS.
@@ -540,6 +574,7 @@ def _lint_family_overlap(problems: list[str]) -> None:
         "CONNECTOR_METRICS": CONNECTOR_METRICS,
         "RING_PREFILL_METRICS": RING_PREFILL_METRICS,
         "COMPILE_METRICS": COMPILE_METRICS,
+        "SCHED_METRICS": SCHED_METRICS,
         "STREAM_CKPT_METRICS": STREAM_CKPT_METRICS,
         "FLEET_METRICS": FLEET_METRICS,
         "SLO_METRICS": SLO_METRICS,
@@ -619,6 +654,7 @@ def lint_tree(root: Path | None = None) -> list[str]:
     _lint_connector_metrics(root, problems)
     _lint_ring_prefill_metrics(root, problems)
     _lint_compile_metrics(root, problems)
+    _lint_sched_metrics(root, problems)
     _lint_stream_ckpt_metrics(root, problems)
     _lint_fleet_metrics(root, problems)
     _lint_recovery_metrics(root, problems)

@@ -14,10 +14,15 @@ default ``/debug/traces`` format) — and prints:
 * an XLA compile table (``engine.compile`` spans from the compile
   ledger, obs/compile_ledger.py) grouped by bucket signature — which
   cold buckets stalled serving, for how long, how many victim traces;
+* a HOL-stall table (``engine.hol_stall`` spans from the scheduling
+  ledger, obs/sched_ledger.py) grouped by CULPRIT request id — which
+  prefill requests stalled how many decode victims for how long;
 * the slowest ``request`` spans with their per-phase breakdown so a
   tail-latency outlier can be attributed to queueing vs prefill vs
   decode vs KV transfer at a glance — rows whose critical path contains
-  an ``engine.compile`` span are flagged as cold-start victims.
+  an ``engine.compile`` span are flagged as cold-start victims, and rows
+  containing an ``engine.hol_stall`` span as HOL-stall victims (with the
+  culprit request id).
 
 Dependency-free; pairs with ``benchmarks/loadgen.py --trace-out``.
 
@@ -187,6 +192,45 @@ def compile_summary(spans: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def hol_summary(spans: list[dict]) -> str:
+    """Per-culprit totals of ``engine.hol_stall`` spans — the scheduling
+    ledger's trace-side view: each row is one prefill request with the
+    total wall it stalled decode streams and how many victim streams it
+    touched (a victim accrues one span per shared step)."""
+    stalls = [s for s in spans if s.get("name") == "engine.hol_stall"]
+    if not stalls:
+        return ""
+    by_culprit: dict[str, list[dict]] = defaultdict(list)
+    for s in stalls:
+        by_culprit[str(s.get("attrs", {}).get("culprit", "?"))].append(s)
+    rows = [("culprit", "stall ms", "spans", "victims", "max ms")]
+    order = sorted(
+        by_culprit.items(),
+        key=lambda kv: sum(max(float(s.get("end", 0))
+                               - float(s.get("start", 0)), 0.0)
+                           for s in kv[1]),
+        reverse=True)
+    for culprit, ss in order:
+        durs = [max(float(s.get("end", 0)) - float(s.get("start", 0)), 0.0)
+                * 1e3 for s in ss]
+        victims = {str(s.get("attrs", {}).get("request_id", "")) or
+                   str(s.get("trace_id", "")) for s in ss}
+        rows.append((culprit, f"{sum(durs):.2f}", str(len(ss)),
+                     str(len(victims)), f"{max(durs):.2f}"))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    victims_all = {str(s.get("attrs", {}).get("request_id", "")) or
+                   str(s.get("trace_id", "")) for s in stalls}
+    lines = [f"hol stalls: {len(stalls)} span(s), "
+             f"{len(victims_all)} victim stream(s), "
+             f"{len(by_culprit)} culprit(s)"]
+    for i, r in enumerate(rows):
+        lines.append("  ".join(c.ljust(widths[j]) if j == 0 else
+                               c.rjust(widths[j]) for j, c in enumerate(r)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
 def slowest_requests(spans: list[dict], top: int) -> str:
     by_trace: dict[str, list[dict]] = defaultdict(list)
     for s in spans:
@@ -209,6 +253,19 @@ def slowest_requests(spans: list[dict], top: int) -> str:
             for c in children if c.get("name") == "engine.compile") * 1e3
         flag = f"  COLD-START VICTIM ({cold_ms:.2f} ms compiling)" \
             if cold_ms > 0 else ""
+        # HOL attribution: an engine.hol_stall span means this stream's
+        # token cadence waited out a co-scheduled prefill — name the
+        # worst culprit so the slow row points at a REQUEST, not a phase.
+        hols = [c for c in children if c.get("name") == "engine.hol_stall"]
+        if hols:
+            hol_ms = sum(
+                max(float(c.get("end", 0)) - float(c.get("start", 0)), 0.0)
+                for c in hols) * 1e3
+            worst = max(hols, key=lambda c: float(c.get("end", 0))
+                        - float(c.get("start", 0)))
+            culprit = worst.get("attrs", {}).get("culprit", "?")
+            flag += (f"  HOL-STALL VICTIM ({hol_ms:.2f} ms behind "
+                     f"{culprit})")
         out.append(f"request {rid}  {dur:.2f} ms  status={root.get('status')}"
                    f"  model={attrs.get('model', '?')}"
                    f"  in={attrs.get('input_tokens', '?')}"
@@ -246,6 +303,9 @@ def main(argv: list[str] | None = None) -> int:
     compiles = compile_summary(spans)
     if compiles:
         print(f"\n{compiles}")
+    hols = hol_summary(spans)
+    if hols:
+        print(f"\n{hols}")
     print(f"\nslowest requests (top {args.top}):")
     print(slowest_requests(spans, args.top))
     return 0
