@@ -72,6 +72,18 @@ Likewise a ``sched`` stamp from the scheduling ledger
 padding-waste totals, admission-block and preempt-recompute causes, and HOL
 stall seconds — so a scheduling regression (batch raggedness, interference)
 shows up next to the throughput number it explains.
+
+A third always-green nested entry, ``mixed_step`` (metric
+``mixed_step_itl_ms_<model>_bs16_ctx8k``), tracks the unified ragged
+mixed-phase step: predicted decode ITL at the longctx geometry when a
+prefill chunk rides the SAME launch (unified) vs the legacy two-launch sum,
+the SLO-driven per-QoS auto chunk the cost model would pick, and — whenever
+the in-process scheduling ledger actually recorded mixed steps — a
+measured-vs-predicted ``agreement`` ratio (median measured mixed-step wall
+over the cost model's prediction for the same recorded geometry). The
+analytic arms are pure cost model, so the entry rides on success, cpu_probe
+fallback, and failure lines alike; ``agreement`` is null where no engine
+ran in-process.
 """
 
 from __future__ import annotations
@@ -129,6 +141,13 @@ LONGCTX_BATCH = int(os.environ.get("DYN_BENCH_LONGCTX_BATCH", "16"))
 LONGCTX_CTX = int(os.environ.get("DYN_BENCH_LONGCTX_CTX", "8192"))
 LONGCTX_METRIC = (f"decode_throughput_{MODEL.replace('-', '_')}"
                   f"_bs{LONGCTX_BATCH}_ctx{LONGCTX_CTX // 1024}k")
+
+# Mixed-step companion metric (always-green, analytic + opportunistically
+# measured): decode ITL at the longctx geometry when a prefill chunk rides
+# the same unified launch vs the legacy two-launch sum.
+MIXED_CHUNK = int(os.environ.get("DYN_BENCH_MIXED_CHUNK", "512"))
+MIXED_METRIC = (f"mixed_step_itl_ms_{MODEL.replace('-', '_')}"
+                f"_bs{LONGCTX_BATCH}_ctx{LONGCTX_CTX // 1024}k")
 
 # Session companion metric (always-green): two turns of one conversation —
 # turn 1 decodes and finishes, its committed KV is retained under the
@@ -248,6 +267,90 @@ def _session_metric() -> dict | None:
             "recompute_seconds_saved": round(
                 trade.recompute_seconds(committed), 6),
         }
+    except Exception:  # noqa: BLE001 — same best-effort rule as predicted
+        return None
+
+
+def _mixed_step_metric() -> dict | None:
+    """The nested always-green ``mixed_step`` entry: predicted decode ITL at
+    the longctx geometry when a MIXED_CHUNK-token prefill chunk rides the
+    SAME unified launch vs the legacy two-launch sum (decode launch + the
+    chunk alone), plus the SLO-driven per-QoS auto chunk. Analytic arms are
+    pure cost model — no jax, no device — so they ride on every emit path.
+
+    When the in-process scheduling ledger recorded real mixed steps (the
+    child that just ran an engine), ``agreement`` is the median ratio of
+    measured mixed-step wall to the cost model's prediction for each step's
+    own recorded geometry on the device that actually ran it — the
+    measured-vs-predicted hook tools/perf_report.py surfaces. Null when no
+    engine ran in this process (parent, failure lines)."""
+    try:
+        from dynamo_tpu.models.config import MODEL_PRESETS
+        from dynamo_tpu.obs import costmodel as cm
+
+        cfg = MODEL_PRESETS[MODEL]
+        hw = cm.hw_spec_for(TARGET_DEVICE)
+        kw = dict(block_size=16, kv_dtype=KV_DTYPE, quantization=QUANT)
+        unified_s = cm.mixed_step_seconds(
+            cfg, hw, decode_rows=LONGCTX_BATCH, decode_kv_len=LONGCTX_CTX,
+            chunk=MIXED_CHUNK, chunk_kv_len=MIXED_CHUNK, **kw)
+        decode_s = cm.mixed_step_seconds(
+            cfg, hw, decode_rows=LONGCTX_BATCH, decode_kv_len=LONGCTX_CTX,
+            chunk=0, chunk_kv_len=0, **kw)
+        prefill_s = cm.mixed_step_seconds(
+            cfg, hw, decode_rows=0, decode_kv_len=0,
+            chunk=MIXED_CHUNK, chunk_kv_len=MIXED_CHUNK, **kw)
+        legacy_s = decode_s + prefill_s
+        auto = {qos: cm.auto_prefill_chunk(
+                    cfg, hw, itl_slo_s=0.05, decode_rows=LONGCTX_BATCH,
+                    decode_kv_len=LONGCTX_CTX, max_chunk=8192,
+                    qos_class=qos, **kw)
+                for qos in cm.QOS_ITL_SLO_SCALE}
+        out = {
+            "metric": MIXED_METRIC,
+            "unit": "ms/step",
+            "source": "costmodel",
+            "device": hw.name,
+            "decode_rows": LONGCTX_BATCH,
+            "context": LONGCTX_CTX,
+            "chunk": MIXED_CHUNK,
+            "unified_itl_ms": round(unified_s * 1e3, 4),
+            "legacy_itl_ms": round(legacy_s * 1e3, 4),
+            "unified_over_legacy": (round(unified_s / legacy_s, 4)
+                                    if legacy_s > 0 else None),
+            "auto_chunk_slo50ms": auto,
+            "agreement": None,
+        }
+        try:
+            # jax only if the bench already initialized it — the parent
+            # process must never pay (or hang on) a device init for a stamp.
+            jax = sys.modules.get("jax")
+            from dynamo_tpu.obs.sched_ledger import get_sched_ledger
+
+            led = get_sched_ledger()
+            mixed = [r for r in getattr(led, "steps", ())
+                     if "mixed" in r.kinds and r.wall_s > 0]
+            if jax is not None and mixed:
+                hw_run = cm.hw_spec_for(
+                    getattr(jax.devices()[0], "device_kind", "cpu"))
+                ratios = []
+                for r in mixed:
+                    pred = cm.mixed_step_seconds(
+                        cfg, hw_run, decode_rows=r.decode_rows,
+                        decode_kv_len=PROMPT_LEN + DECODE_TOKENS // 2,
+                        chunk=max(r.live_tokens - r.decode_rows, 0),
+                        chunk_kv_len=max(r.live_tokens - r.decode_rows, 0),
+                        **kw)
+                    if pred > 0:
+                        ratios.append(r.wall_s / pred)
+                if ratios:
+                    ratios.sort()
+                    out["agreement"] = round(ratios[len(ratios) // 2], 4)
+                    out["agreement_steps"] = len(ratios)
+                    out["agreement_device"] = hw_run.name
+        except Exception:  # noqa: BLE001 — measured arm is garnish on garnish
+            pass
+        return out
     except Exception:  # noqa: BLE001 — same best-effort rule as predicted
         return None
 
@@ -394,6 +497,9 @@ def fail(stage: str, error: str, probe_log: str = "") -> None:
     session = _session_metric()
     if session is not None:
         out["session"] = session
+    mixed = _mixed_step_metric()
+    if mixed is not None:
+        out["mixed_step"] = mixed
     comp = _compile_stamp()
     if comp is not None:
         out["compile"] = comp
@@ -537,6 +643,10 @@ def _cpu_fallback(probe_error: str, probe_log: str) -> None:
         session = _session_metric()
         if session is not None:
             out["session"] = session
+    if out.get("mixed_step") is None:
+        # Child lines carry their own (agreement-bearing) entry; the
+        # parent-side analytic stamp covers a child that died first.
+        out["mixed_step"] = _mixed_step_metric()
     if out.get("compile") is None:
         # Child lines stamp their own (populated) ledger; this parent-side
         # stamp only covers a child that died before emitting one.
@@ -685,6 +795,9 @@ def run_bench(deadline_at: float) -> dict:
         "perf": perf,
         "longctx": _longctx_metric(),
         "session": session,
+        # Unified-vs-legacy predicted ITL plus measured-vs-predicted
+        # agreement from the mixed steps the ledger just recorded.
+        "mixed_step": _mixed_step_metric(),
         # Per-bucket compile seconds + warmup coverage for THIS run — the
         # ledger that just watched every jit entry point compile above.
         "compile": _compile_stamp(),
@@ -797,6 +910,8 @@ def main() -> None:
             parsed["compile"] = _compile_stamp()
         if parsed.get("sched") is None:
             parsed["sched"] = _sched_stamp()
+        if parsed.get("mixed_step") is None:
+            parsed["mixed_step"] = _mixed_step_metric()
         print(json.dumps(parsed))
         sys.exit(proc.returncode)
     _cpu_fallback(

@@ -305,7 +305,8 @@ async def scrape_sched(urls: list[str]) -> "dict | None":
     out = {"hol_stall_seconds": 0.0, "hol_stalls": 0.0,
            "interference_row_seconds": 0.0, "padding_flops": 0.0,
            "padding_hbm_bytes": 0.0, "preempt_recompute_tokens": 0.0,
-           "admission_blocked": 0.0, "goodput_min": None}
+           "admission_blocked": 0.0, "goodput_min": None,
+           "prefill_chunk_tokens": {}}
     seen = False
     for u in urls:
         try:
@@ -313,6 +314,14 @@ async def scrape_sched(urls: list[str]) -> "dict | None":
         except Exception:
             continue
         seen = True
+        # Serving chunk per QoS class (SLO-driven when --prefill-chunk 0);
+        # max across workers — the report's predicted mixed step uses the
+        # biggest chunk any worker would co-schedule.
+        for (name, labels), value in sample.items():
+            if name == "dynamo_sched_prefill_chunk_tokens":
+                q = dict(labels).get("qos_class", "?")
+                out["prefill_chunk_tokens"][q] = max(
+                    out["prefill_chunk_tokens"].get(q, 0.0), value)
         out["hol_stall_seconds"] += metric_sum(
             sample, "dynamo_sched_hol_stall_seconds_sum")
         out["hol_stalls"] += metric_sum(
@@ -961,6 +970,12 @@ async def run_interference(url: str, model: str, concurrency: int,
             "goodput_fraction": (round(after["goodput_min"], 4)
                                  if after["goodput_min"] is not None
                                  else None),
+            # config gauge (not a delta): the per-QoS chunk the workers
+            # served with — feeds perf_report's measured-vs-predicted
+            # mixed-step agreement row.
+            "prefill_chunk_tokens": {
+                q: int(v) for q, v in
+                sorted(after.get("prefill_chunk_tokens", {}).items())},
         }
     culprits: list = []
     if debug is not None:

@@ -172,6 +172,11 @@ class PendingStep:
     # token-budget utilization, HOL victim list) — consumed by
     # _record_step at finalize. None when DYN_SCHED_LEDGER=0.
     sched: Any = None
+    # Unified steps: the leading decode-row count of the "mixed" batch
+    # (rows [0:n] are decode/guided, the rest prefill chunks) — captured
+    # at plan time because prefill_target() moves as finalize appends
+    # tokens, so a finalize-time re-derivation would misclassify.
+    mixed_dec_rows: int = 0
 
 
 class ModelRunner:
@@ -532,6 +537,7 @@ class ModelRunner:
         sample_rows: list[bool],
         window: int = 1,
         masks: list | None = None,  # per-row bool[V] allow-masks (guided)
+        mixed: bool = False,
     ) -> tuple[jax.Array, jax.Array]:
         """Enqueue one bucketed step on the device WITHOUT blocking; returns
         device arrays (tokens [B] or [B, window], logprobs likewise) still
@@ -539,12 +545,23 @@ class ModelRunner:
         assembly for earlier steps) with the device, then materializes via
         ``np.asarray``. ``window > 1`` (decode rows only) fuses that many
         steps into the dispatch — the caller must have grown each seq's
-        block table to cover ``window`` more tokens."""
+        block table to cover ``window`` more tokens. ``mixed`` marks a
+        unified ragged step (decode rows packed with prefill-chunk rows):
+        the batch buckets over the DECODE row ladder while t takes the
+        prefill chunk ladder — same ragged step program, different bucket
+        geometry (legacy prefill's (1,2,4,8) row ladder can't hold a full
+        decode batch)."""
         ec = self.engine_cfg
         n = len(rows)
         t_max = max(length for _, _, length in rows)
         if t_max == 1:
-            b, t = _bucket(n, ec.decode_bucket), 1
+            # Degenerate mixed batches (every live row is one token) ARE
+            # the decode program — classify them as such so the ledger
+            # matches the program actually minted.
+            b, t, mixed = _bucket(n, ec.decode_bucket), 1, False
+        elif mixed:
+            window = 1
+            b, t = _bucket(n, ec.decode_bucket), _pow2_bucket(t_max, 16, ec.prefill_chunk)
         else:
             window = 1  # windows are a decode-dispatch concept
             b, t = _bucket(n, (1, 2, 4, 8)), _pow2_bucket(t_max, 16, ec.prefill_chunk)
@@ -692,7 +709,8 @@ class ModelRunner:
             dt = time.perf_counter() - t_compile
             led.mark_inflight(False)
             kind = ("window" if window > 1
-                    else "decode" if t == 1 else "prefill")
+                    else "decode" if t == 1
+                    else "mixed" if mixed else "prefill")
             led.record(
                 BucketSig(kind, b, t, nblk, fast_greedy,
                           ec.kv_dtype or "bfloat16"),
@@ -1046,6 +1064,46 @@ class EngineCore:
                 f"kv_dtype=int4 packs two nibbles per byte along head_dim and "
                 f"needs it even; model {engine_cfg.model!r} has head_dim="
                 f"{self.model_cfg.head_dim}")
+        # SLO-driven chunk sizing (prefill_chunk=0 = auto): resolve to
+        # concrete per-QoS chunks BEFORE bucket enumeration and the
+        # scheduler read the config — the prefill t ladder, warmup plan
+        # and per-step token budget all key off ec.prefill_chunk, so auto
+        # must not leave a 0 behind. The cap is the batch class's chunk
+        # (largest SLO budget); interactive/standard refine downward
+        # per-seq inside the scheduler.
+        from dynamo_tpu.obs import costmodel as cm
+        self._hw = cm.hw_spec_for(jax.devices()[0].device_kind)
+        if engine_cfg.prefill_chunk <= 0:
+            import dataclasses as _dc
+            ladder_cap = min(engine_cfg.max_model_len,
+                             engine_cfg.max_tokens_per_step)
+            self.chunk_by_qos = {
+                qos: cm.auto_prefill_chunk(
+                    self.model_cfg, self._hw,
+                    itl_slo_s=engine_cfg.itl_slo_ms / 1e3,
+                    decode_rows=engine_cfg.max_batch_size,
+                    decode_kv_len=max(engine_cfg.max_model_len // 2,
+                                      engine_cfg.block_size),
+                    block_size=engine_cfg.block_size,
+                    max_chunk=ladder_cap,
+                    kv_dtype=engine_cfg.kv_dtype or "bfloat16",
+                    quantization=engine_cfg.quantization or "none",
+                    qos_class=qos)
+                for qos in cm.QOS_ITL_SLO_SCALE}
+            resolved = max(self.chunk_by_qos.values())
+            log.info("auto prefill chunk (itl_slo=%.1fms): %s -> cap %d",
+                     engine_cfg.itl_slo_ms, self.chunk_by_qos, resolved)
+            engine_cfg = _dc.replace(engine_cfg, prefill_chunk=resolved)
+            self.engine_cfg = engine_cfg
+        else:
+            self.chunk_by_qos = {qos: engine_cfg.prefill_chunk
+                                 for qos in cm.QOS_ITL_SLO_SCALE}
+        self.sched_led.set_prefill_chunks(self.chunk_by_qos)
+        # Unified ragged mixed-phase steps: one launch per iteration when
+        # prefill work rides along. Fused decode windows keep the legacy
+        # path (a window is a decode-only scan).
+        self._unified = (engine_cfg.unified_step
+                         and engine_cfg.decode_window == 1)
         if mesh is None and any(v != 1 for v in engine_cfg.mesh_shape().values()):
             mesh = make_mesh(MeshConfig(dp=engine_cfg.dp, pp=engine_cfg.pp,
                                         sp=engine_cfg.sp, tp=engine_cfg.tp,
@@ -1072,6 +1130,7 @@ class EngineCore:
             decode_window=engine_cfg.decode_window,
             spec_lookahead=(engine_cfg.spec_k if engine_cfg.spec_ngram > 0
                             else 0),
+            chunk_by_qos=self.chunk_by_qos,
         )
         # Session-sticky KV retention (engine/session.py): finished streams
         # carrying a session.id keep their committed blocks pinned so the
@@ -1502,9 +1561,12 @@ class EngineCore:
                 self._init_slot(seq)
                 seq.slot_initialized = True
 
-        # Decode and prefill run as two bucketed programs in the same step
-        # (decode first — see scheduler module docstring for why they are
-        # not one padded batch).
+        # Unified mode: decode rows and the step's prefill-chunk rows pack
+        # into ONE ragged "mixed" program (per-row live-token counts ride
+        # the scalar-prefetch path, so padding costs DMA-elided grid steps,
+        # not FLOPs). Legacy mode (--no-unified-step, or decode_window>1)
+        # runs them as two bucketed programs, decode first — see the
+        # scheduler module docstring.
         pending = PendingStep()
         batches: list[tuple[str, list, list[bool], int, list | None]] = []
         decode_seqs = plan.decode
@@ -1530,36 +1592,58 @@ class EngineCore:
                     seq.verify_inflight = True
                 pending.batches.append(
                     ("verify", verify_rows, verify_chunks, toks, lps))
-        if decode_seqs:
-            rows = [(s, s.num_computed, 1) for s in decode_seqs]
-            batches.append(("decode", rows, [True] * len(rows),
-                            plan.decode_window, None))
-        if guided_rows:
-            batches.append(("decode", guided_rows, [True] * len(guided_rows),
-                            1, [s.guided.mask() for s, _, _ in guided_rows]))
+        pf_rows, pf_sample_rows, pf_masks = [], [], None
         if plan.prefill:
-            rows = [(w.seq, w.start, w.length) for w in plan.prefill]
+            pf_rows = [(w.seq, w.start, w.length) for w in plan.prefill]
             # Sample only on the chunk completing a *fresh* prompt; a
             # preempt-resumed seq already holds its next token (the resume
             # prefill just rebuilds KV) so sampling would duplicate output.
-            sample_rows = [
+            pf_sample_rows = [
                 w.start + w.length >= w.seq.prefill_target()
                 and len(w.seq.tokens) == w.seq.prompt_len
                 for w in plan.prefill
             ]
-            pf_masks = None
             if any(w.seq.guided is not None and s for w, s in
-                   zip(plan.prefill, sample_rows)):
+                   zip(plan.prefill, pf_sample_rows)):
                 # The FIRST sampled token must already obey the grammar.
                 pf_masks = [
                     w.seq.guided.mask()
-                    if (w.seq.guided is not None and sample_rows[i]) else None
+                    if (w.seq.guided is not None and pf_sample_rows[i])
+                    else None
                     for i, w in enumerate(plan.prefill)]
-            batches.append(("prefill", rows, sample_rows, 1, pf_masks))
+        if self._unified and pf_rows:
+            # One ragged launch: decode rows, guided decode rows (their
+            # masks join per-row), then the prefill chunks. dispatch()
+            # classifies a degenerate all-length-1 batch back to "decode".
+            rows = ([(s, s.num_computed, 1) for s in decode_seqs]
+                    + guided_rows + pf_rows)
+            sample_rows = ([True] * (len(decode_seqs) + len(guided_rows))
+                           + pf_sample_rows)
+            pending.mixed_dec_rows = len(decode_seqs) + len(guided_rows)
+            masks = None
+            if guided_rows or pf_masks is not None:
+                masks = ([None] * len(decode_seqs)
+                         + [s.guided.mask() for s, _, _ in guided_rows]
+                         + (pf_masks if pf_masks is not None
+                            else [None] * len(pf_rows)))
+            batches.append(("mixed", rows, sample_rows, 1, masks))
+        else:
+            if decode_seqs:
+                rows = [(s, s.num_computed, 1) for s in decode_seqs]
+                batches.append(("decode", rows, [True] * len(rows),
+                                plan.decode_window, None))
+            if guided_rows:
+                batches.append(("decode", guided_rows,
+                                [True] * len(guided_rows), 1,
+                                [s.guided.mask() for s, _, _ in guided_rows]))
+            if pf_rows:
+                batches.append(("prefill", pf_rows, pf_sample_rows, 1,
+                                pf_masks))
 
         for kind, rows, sample_rows, window, b_masks in batches:
             toks, lps = self.runner.dispatch(rows, sample_rows, window=window,
-                                             masks=b_masks)
+                                             masks=b_masks,
+                                             mixed=(kind == "mixed"))
             # Value-independent bookkeeping, done at dispatch so the next
             # plan() sees advanced positions. Token metrics count at
             # finalize, so discarded speculative rows don't inflate them.
@@ -1575,14 +1659,37 @@ class EngineCore:
             hol = None
             if plan.prefill and plan.decode:
                 # Every decode-ready stream in this step waits out the
-                # prefill program before its token materializes; the
-                # culprit is the request contributing the largest chunk.
+                # prefill work before its token materializes; the culprit
+                # is the request contributing the largest chunk. Under the
+                # unified step the stall is NOT a whole separate launch —
+                # only the chunk's marginal share of the mixed step's wall
+                # (priced by the cost model) is charged to the victims.
                 culprit = max(plan.prefill, key=lambda w: w.length)
+                stall_share = None
+                if self._unified:
+                    from dynamo_tpu.obs import costmodel as cm
+                    kw = dict(
+                        decode_rows=len(plan.decode),
+                        decode_kv_len=max(s.num_computed
+                                          for s in plan.decode),
+                        chunk_kv_len=max(w.start + w.length
+                                         for w in plan.prefill),
+                        block_size=self.engine_cfg.block_size,
+                        kv_dtype=self.engine_cfg.kv_dtype or "bfloat16",
+                        quantization=self.engine_cfg.quantization or "none")
+                    mixed_s = cm.mixed_step_seconds(
+                        self.model_cfg, self._hw,
+                        chunk=sum(w.length for w in plan.prefill), **kw)
+                    pure_s = cm.mixed_step_seconds(
+                        self.model_cfg, self._hw, chunk=0, **kw)
+                    if mixed_s > 0:
+                        stall_share = max(mixed_s - pure_s, 0.0) / mixed_s
                 hol = HolStall(
                     culprit=culprit.seq.request_id,
                     culprit_tokens=sum(w.length for w in plan.prefill),
                     victims=[(s.trace_ctx, s.request_id, s.qos_priority)
-                             for s in plan.decode])
+                             for s in plan.decode],
+                    stall_share=stall_share)
             pending.sched = {
                 "decode_window": plan.decode_window,
                 "budget_util": used / max(self.sched.max_tokens_per_step, 1),
@@ -1660,6 +1767,9 @@ class EngineCore:
         for kind, rows, *_ in pending.batches:
             if kind == "prefill":
                 n_pf += len(rows)
+            elif kind == "mixed":
+                n_dec += pending.mixed_dec_rows
+                n_pf += len(rows) - pending.mixed_dec_rows
             else:
                 n_dec += len(rows)
         pc = self.sched.preemption_count
@@ -1682,7 +1792,8 @@ class EngineCore:
                 queue_depths=self.sched.waiting.depths(),
                 hol=info.get("hol"),
                 **step_geometry(self.model_cfg, self.engine_cfg,
-                                pending.batches))
+                                pending.batches,
+                                mixed_dec_rows=pending.mixed_dec_rows))
 
     def _plan_verify(self, decode_seqs: list
                      ) -> tuple[list, list[list[int]], list]:
@@ -1801,7 +1912,13 @@ class EngineCore:
                     # Finished (stop/abort) while this step was in flight:
                     # its speculative row is discarded.
                     continue
-                if kind != "decode":
+                # A mixed batch's leading rows are decode rows (the split
+                # was captured at plan time); everything after them, and
+                # every row of a plain prefill batch, counts as prefill.
+                decode_row = (kind == "decode"
+                              or (kind == "mixed"
+                                  and i < pending.mixed_dec_rows))
+                if not decode_row:
                     self.metrics.num_prefill_tokens += length
                 if sample_rows[i]:
                     seq.inflight_samples -= 1
@@ -1816,7 +1933,7 @@ class EngineCore:
                 # freed at finish).
                 self._emit_and_finish(
                     seq, [int(x) for x in toks[i]], lps[i], outputs,
-                    count_decode=(kind == "decode"))
+                    count_decode=decode_row)
         self._record_step(t0, pending)
         if self.kvbm is not None and not self.sched.has_work():
             # Engine going idle: this finalize's commits would otherwise sit

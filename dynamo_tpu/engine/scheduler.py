@@ -7,14 +7,26 @@ prefill) → RUNNING (decode) → FINISHED, with block allocation against the
 PrefixPool, recompute-style preemption under block pressure, and prefix-cache
 reuse feeding back into TTFT.
 
-One step = one decode batch AND at most one prefill-chunk batch (decode
-first): decode streams advance every step, so a long prompt's prefill can
-stall ITL by at most one chunk's compute, not the whole prompt (the
-reference's engines mix within token-budgeted steps the same way,
-lib/llm/src/mocker/scheduler.rs:117-178). The two batches stay separate
-XLA programs because their shapes differ radically — padding decode rows
-to the prefill chunk T would multiply their FLOPs by T. Static-shape
-buckets keep XLA compile counts bounded.
+One step = decode rows AND at most a token-budgeted set of prefill chunks
+(decode first): decode streams advance every step, so a long prompt's
+prefill can stall ITL by at most one chunk's compute, not the whole prompt
+(the reference's engines mix within token-budgeted steps the same way,
+lib/llm/src/mocker/scheduler.rs:117-178). By default the engine dispatches
+the whole plan as ONE ragged mixed-phase XLA launch: the step program is
+already per-row ragged (per-row q_start/q_len ride the scalar-prefetch
+path, so a decode row padded to the chunk ladder T costs DMA-elided grid
+steps, not T× FLOPs), which removes the second launch's dispatch gap and
+lets XLA overlap decode attention with prefill matmuls.
+``--no-unified-step`` restores the legacy two-launch path; fused decode
+windows (decode_window > 1) are decode-only scans and also keep it.
+Static-shape buckets keep XLA compile counts bounded either way.
+
+Chunk size is cost-model-driven when ``prefill_chunk == 0``: the engine
+resolves a per-QoS-class cap (costmodel.auto_prefill_chunk — largest chunk
+whose predicted mixed-step time keeps decode ITL inside the SLO ladder)
+and passes it here as ``chunk_by_qos``; plan() caps each seq's chunk by
+its own class, so interactive traffic takes small chunks while batch
+prompts chew through large ones.
 """
 
 from __future__ import annotations
@@ -178,10 +190,15 @@ class Scheduler:
         decode_window: int = 1,
         spec_lookahead: int = 0,
         qos_weights: dict[str, int] | None = None,
+        chunk_by_qos: dict[str, int] | None = None,
     ):
         self.pool = pool
         self.max_batch_size = max_batch_size
         self.prefill_chunk = prefill_chunk
+        # Per-QoS chunk caps (SLO-driven auto mode): each seq's prefill
+        # chunk is additionally capped by its own class. None/empty =
+        # uniform prefill_chunk for everyone.
+        self.chunk_by_qos = dict(chunk_by_qos) if chunk_by_qos else {}
         self.max_model_len = max_model_len
         self.max_tokens_per_step = max_tokens_per_step
         self.decode_window = max(decode_window, 1)
@@ -406,7 +423,8 @@ class Scheduler:
         for seq in self.running:
             target = seq.prefill_target()
             if seq.num_computed < target and budget > 0:
-                chunk = min(target - seq.num_computed, self.prefill_chunk, budget)
+                cap = self.chunk_by_qos.get(seq.qos_priority, self.prefill_chunk)
+                chunk = min(target - seq.num_computed, cap, budget)
                 plan.prefill.append(PrefillWork(seq=seq, start=seq.num_computed, length=chunk))
                 budget -= chunk
         return plan

@@ -83,6 +83,13 @@ class MockEngineArgs:
     # Simulated wall seconds one cold-bucket compile stalls the step loop
     # (divided by speedup_ratio like every other simulated time).
     compile_s: float = 0.5
+    # Unified mixed-phase step mirror (engine/engine.py step_begin): the
+    # prefill chunk and every decode row advance in ONE simulated step —
+    # sig_for_rows("mixed", ...), a single sched-ledger record whose HOL
+    # stall is the chunk's MARGINAL share of the step wall (decode rows no
+    # longer lose a whole serialized iteration). False = legacy two-step
+    # serialization, matching --no-unified-step.
+    unified_step: bool = True
     # Crash-consistent stream checkpoints mirror (kvbm/stream_ckpt.py):
     # every this-many committed decode blocks (QoS-degraded like the JAX
     # engine: interactive 1x, standard 2x, batch 4x) the stream's newly
@@ -199,7 +206,8 @@ class MockEngine:
             block_size=self.args.block_size,
             max_batch_size=self.args.max_batch_size,
             max_model_len=self.args.max_model_len,
-            warmup_mode=self.args.warmup_mode)
+            warmup_mode=self.args.warmup_mode,
+            unified_step=self.args.unified_step)
         self._ledger = get_compile_ledger()
         self._ledger.configure(self.args.warmup_mode)
         if self.args.warmup_mode != "off":
@@ -489,6 +497,68 @@ class MockEngine:
                 self._sled.record_block("batch_full")
             self.steps += 1
             prefills = [s for s in self.running if not s.prefilled and not s.done]
+            decodes = [s for s in self.running if s.prefilled and not s.done]
+            if prefills and a.unified_step:
+                # Unified mixed-phase step: the chunk and every decode row
+                # advance in ONE simulated launch. The decode rows still pay
+                # the chunk's compute alongside their own ITL, but no longer
+                # lose a whole serialized iteration — HOL stall is the
+                # chunk's MARGINAL share of this step, not its full wall.
+                seq = prefills[0]
+                new_tokens = len(seq.req.token_ids) - seq.cached_blocks * a.block_size
+                n_rows = 1 + len(decodes)
+                t_max = max(new_tokens, 1)
+                nblk = max(len(s.block_ids) for s in [seq] + decodes)
+                # Degenerate mixed batches (every live row one token) ARE
+                # the decode program — same rule as dispatch().
+                kind = "mixed" if t_max > 1 else "decode"
+                stall = self._mock_compile(kind, n_rows, t_max, nblk,
+                                           victim=seq.trace_ctx)
+                pf_wall = new_tokens * a.prefill_us_per_token / 1e6 / a.speedup_ratio
+                dec_wall = (a.decode_itl_ms / 1e3 / a.speedup_ratio
+                            if decodes else 0.0)
+                # One launch prices at the roofline MAX of the two phases
+                # (costmodel.mixed_step_seconds), not the serialized sum the
+                # legacy two-launch path below pays.
+                wall = stall + max(pf_wall, dec_wall)
+                await asyncio.sleep(wall)
+                if self._sled.enabled:
+                    sig = sig_for_rows(kind, n_rows, t_max, nblk,
+                                       self._lattice_cfg)
+                    share = (pf_wall / (pf_wall + dec_wall)
+                             if pf_wall + dec_wall > 0 else None)
+                    self._sled.record_step(
+                        wall_s=wall, kinds=(kind,), prefill_rows=1,
+                        decode_rows=len(decodes),
+                        live_tokens=new_tokens + len(decodes),
+                        sched_tokens=sig.b * sig.t,
+                        queue_depths=self._queue_depths(),
+                        hol=HolStall(
+                            culprit=seq.req.request_id,
+                            culprit_tokens=new_tokens,
+                            victims=[(v.trace_ctx, v.req.request_id,
+                                      v.priority) for v in decodes],
+                            stall_share=share)
+                        if decodes else None)
+                seq.prefilled = True
+                self._trace_phase(seq, "engine.decode",
+                                  batch=len(self.running))
+                self._commit(seq, len(seq.req.token_ids))
+                self._emit_token(seq)
+                for dseq in decodes:
+                    if dseq.done:
+                        continue
+                    total = len(dseq.req.token_ids) + dseq.generated + 1
+                    need = -(-total // a.block_size)
+                    if need > len(dseq.block_ids):
+                        try:
+                            dseq.block_ids.extend(
+                                self.pool.allocate(need - len(dseq.block_ids)))
+                        except NoFreeBlocks:
+                            continue  # starved this step; retried next step
+                    self._emit_token(dseq)
+                    self._commit(dseq, total - 1)
+                continue
             if prefills:
                 seq = prefills[0]
                 new_tokens = len(seq.req.token_ids) - seq.cached_blocks * a.block_size
@@ -523,7 +593,6 @@ class MockEngine:
                 self._emit_token(seq)
                 continue
 
-            decodes = [s for s in self.running if s.prefilled and not s.done]
             if decodes:
                 stall = self._mock_compile(
                     "decode", len(decodes), 1,

@@ -70,8 +70,11 @@ def _pow2_bucket(n: int, lo: int, hi: int) -> int:
 @dataclass(frozen=True)
 class BucketSig:
     """One compiled program's bucket signature. ``kind`` is one of
-    decode | window | prefill | verify | embed; ``greedy`` is the
-    argmax-only fast path variant (always True for verify/embed)."""
+    decode | window | prefill | mixed | verify | embed; ``greedy`` is the
+    argmax-only fast path variant (always True for verify/embed). "mixed"
+    is the unified ragged step (decode rows + a prefill chunk in one
+    launch): b buckets over the DECODE ladder, t over the prefill chunk
+    ladder — the program itself is the same ragged step fn either way."""
 
     kind: str
     b: int
@@ -120,7 +123,7 @@ class CompileMetrics:
         self.events = registry.counter(
             "xla_compile_events_total",
             "XLA compiles observed by the ledger, by kind (decode|window|"
-            "prefill|verify|embed) and source (serve|warmup)")
+            "prefill|mixed|verify|embed) and source (serve|warmup)")
         self.seconds = registry.histogram(
             "xla_compile_seconds",
             "Wall seconds one XLA trace+compile blocked the engine-core "
@@ -392,7 +395,13 @@ def enumerate_buckets(ec) -> list[BucketSig]:
     """The reachable generate-path bucket lattice for one EngineConfig —
     what ``--warmup-mode full`` precompiles and what coverage is measured
     against. Excludes: embed (off-path), sp-prefill/multimodal/guided
-    variants (workload-dependent; organic compiles, still ledgered)."""
+    variants (workload-dependent; organic compiles, still ledgered).
+
+    Unified mode (``ec.unified_step``): every step carrying prefill work
+    dispatches as ONE ragged "mixed" program (decode-ladder b × prefill
+    t ladder), so the separate "prefill" rungs are unreachable and are
+    pruned from the plan — coverage stays honest. Pure-decode steps still
+    dispatch the decode/window rungs, which stay."""
     kv = ec.kv_dtype or "bfloat16"
     max_nblk = -(-ec.max_model_len // ec.block_size)
     nblks = _nblk_ladder(max_nblk)
@@ -405,12 +414,17 @@ def enumerate_buckets(ec) -> list[BucketSig]:
                 out.append(BucketSig("decode", b, 1, nblk, g, kv))
                 if ec.decode_window > 1:
                     out.append(BucketSig("window", b, 1, nblk, g, kv))
-    pf_bs = [x for x in (1, 2, 4, 8) if x <= max(ec.max_batch_size, 1)]
+    # Fused decode windows are a decode-only concept: a window>1 engine
+    # keeps the legacy two-launch path, so its prefill rungs stay.
+    unified = getattr(ec, "unified_step", False) and ec.decode_window == 1
+    pf_kind = "mixed" if unified else "prefill"
+    pf_bs = (dec_bs if unified else
+             [x for x in (1, 2, 4, 8) if x <= max(ec.max_batch_size, 1)])
     for b in pf_bs:
         for t in _prefill_t_ladder(ec):
             for nblk in nblks:
                 for g in greedy_variants:
-                    out.append(BucketSig("prefill", b, t, nblk, g, kv))
+                    out.append(BucketSig(pf_kind, b, t, nblk, g, kv))
     if ec.spec_ngram > 0:
         for b in dec_bs:
             for t in _verify_t_ladder(ec.spec_k):
@@ -433,6 +447,17 @@ def sig_for_rows(kind: str, n_rows: int, t_max: int, nblk_need: int,
         t = min(_pow2_bucket(t_max, 2, ec.spec_k + 1), ec.spec_k + 1)
         return BucketSig(kind, _bucket(n_rows, ec.decode_bucket), t, nblk,
                          True, kv)
+    if kind == "mixed":
+        # Unified ragged step: rows bucket over the DECODE ladder (the
+        # batch can carry up to max_batch_size decode rows), t over the
+        # prefill chunk ladder. Degenerate mixed batches (every live row
+        # one token) ARE the decode program — same rule as dispatch().
+        if t_max <= 1:
+            return BucketSig("decode", _bucket(n_rows, ec.decode_bucket),
+                             1, nblk, greedy, kv)
+        t = _pow2_bucket(t_max, 16, ec.prefill_chunk)
+        return BucketSig("mixed", _bucket(n_rows, ec.decode_bucket), t,
+                         nblk, greedy, kv)
     t = _pow2_bucket(t_max, 16, ec.prefill_chunk)
     return BucketSig("prefill", _bucket(n_rows, (1, 2, 4, 8)), t, nblk,
                      greedy, kv)

@@ -63,6 +63,10 @@ __all__ = [
     "prefix_cache_cost",
     "RingPrefillDecision",
     "chunked_prefill_seconds",
+    "mixed_step_cost",
+    "mixed_step_seconds",
+    "auto_prefill_chunk",
+    "QOS_ITL_SLO_SCALE",
     "ring_prefill_seconds",
     "ring_vs_chunked_prefill",
     "ring_prefill_break_even_tokens",
@@ -650,6 +654,115 @@ def chunked_prefill_seconds(
         flops += total_cost(phases).flops
         done += c
     return flops / eff
+
+
+# ---------------------------------------------------------------------------
+# Unified ragged mixed-phase steps: decode + prefill chunk in one launch
+# ---------------------------------------------------------------------------
+
+#: Per-QoS-class scale applied to the decode-ITL SLO budget that
+#: auto_prefill_chunk sizes against — the same 1x/2x/4x degradation ladder
+#: the stream-checkpoint cadence uses. Interactive streams tolerate the
+#: smallest prefill-induced ITL inflation, batch the largest (so batch
+#: traffic prefills in bigger, more efficient chunks).
+QOS_ITL_SLO_SCALE = {"interactive": 1.0, "standard": 2.0, "batch": 4.0}
+
+
+def mixed_step_cost(
+    cfg: ModelConfig,
+    *,
+    decode_rows: int,
+    decode_kv_len: int,
+    chunk: int,
+    chunk_kv_len: int,
+    block_size: int,
+    kv_dtype: str = "bfloat16",
+    quantization: str = "none",
+    attn_num_splits: int = 1,
+) -> dict[str, KernelCost]:
+    """One unified ragged mixed step: ``decode_rows`` decode rows (one live
+    query token attending ``decode_kv_len`` context each) packed with one
+    prefill-chunk row (``chunk`` live tokens attending ``chunk_kv_len``
+    context — the chunk end for fresh prompts) in a SINGLE program. The
+    ragged grid early-exits padded positions, so the live volume is exactly
+    the sum of the two phases' volumes; the aggregate inputs below are the
+    hand-checkable expansion (tests/test_perf_obs.py)."""
+    nblk_d = _ceil_div(max(decode_kv_len, 1), block_size)
+    nblk_p = _ceil_div(max(chunk_kv_len, 1), block_size)
+    return model_step_cost(
+        cfg,
+        tokens=decode_rows + chunk,
+        logit_rows=decode_rows + (1 if chunk > 0 else 0),
+        attn_q_ctx=float(decode_rows * nblk_d * block_size
+                         + chunk * nblk_p * block_size),
+        kv_blocks=float(decode_rows * nblk_d + (nblk_p if chunk > 0 else 0)),
+        block_size=block_size, kv_dtype=kv_dtype,
+        quantization=quantization, attn_num_splits=attn_num_splits)
+
+
+def mixed_step_seconds(
+    cfg: ModelConfig,
+    hw: HardwareSpec,
+    *,
+    decode_rows: int,
+    decode_kv_len: int,
+    chunk: int,
+    chunk_kv_len: int,
+    block_size: int,
+    kv_dtype: str = "bfloat16",
+    quantization: str = "none",
+    attn_num_splits: int = 1,
+    prefill_mfu: float = PREFILL_MFU,
+) -> float:
+    """Predicted wall time of one unified mixed step — decode ITL when a
+    chunk rides along. Compute is derated to achieved prefill MFU (the
+    chunk's matmuls dominate the FLOP side, consistent with
+    chunked_prefill_seconds); bandwidth stays at peak (the decode side is
+    a streaming KV read, consistent with the decode roofline). chunk=0
+    prices the pure-decode step, so ``mixed - pure`` is the chunk's
+    marginal ITL inflation the HOL attribution charges."""
+    cost = total_cost(mixed_step_cost(
+        cfg, decode_rows=decode_rows, decode_kv_len=decode_kv_len,
+        chunk=chunk, chunk_kv_len=chunk_kv_len, block_size=block_size,
+        kv_dtype=kv_dtype, quantization=quantization,
+        attn_num_splits=attn_num_splits))
+    eff = hw.peak_flops * prefill_mfu
+    return max(cost.flops / eff if eff > 0 else 0.0,
+               cost.hbm_bytes / hw.hbm_bw if hw.hbm_bw > 0 else 0.0)
+
+
+def auto_prefill_chunk(
+    cfg: ModelConfig,
+    hw: HardwareSpec,
+    *,
+    itl_slo_s: float,
+    decode_rows: int,
+    decode_kv_len: int,
+    block_size: int,
+    max_chunk: int,
+    kv_dtype: str = "bfloat16",
+    quantization: str = "none",
+    qos_class: str = "interactive",
+    min_chunk: int = 16,
+) -> int:
+    """SLO-driven chunk sizing: the largest power-of-two chunk (the compile
+    ledger's 16-doubling t ladder, so auto never mints new buckets) whose
+    predicted mixed-step time stays inside the decode-ITL SLO budget for
+    ``qos_class`` (budget × QOS_ITL_SLO_SCALE). Returns ``min_chunk`` even
+    when the SLO is already blown by the pure-decode step — prefill must
+    keep making forward progress."""
+    budget = itl_slo_s * QOS_ITL_SLO_SCALE.get(qos_class, 1.0)
+    best = min_chunk
+    chunk = min_chunk
+    while chunk <= max(max_chunk, min_chunk):
+        predicted = mixed_step_seconds(
+            cfg, hw, decode_rows=decode_rows, decode_kv_len=decode_kv_len,
+            chunk=chunk, chunk_kv_len=chunk, block_size=block_size,
+            kv_dtype=kv_dtype, quantization=quantization)
+        if predicted <= budget:
+            best = chunk
+        chunk *= 2
+    return min(best, max(max_chunk, min_chunk))
 
 
 def ring_prefill_seconds(

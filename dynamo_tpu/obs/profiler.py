@@ -235,7 +235,12 @@ class StepPerfProfiler:
                     nblk = -(-(start + length) // bs)
                     attn_q_ctx += length * nblk * bs
                     kv_blocks += nblk
-                    if kind == "prefill":
+                    # Unified "mixed" batches carry both phases: multi-token
+                    # rows are prefill chunks, single-token rows decode.
+                    # (A 1-token prefill tail inside a mixed batch lands on
+                    # the decode counter — one token of split drift; the
+                    # aggregate volumes above stay exact.)
+                    if kind == "prefill" or (kind == "mixed" and length > 1):
                         pf_tokens += length
                     else:
                         dec_tokens += length

@@ -105,8 +105,13 @@ class SchedMetrics:
         self.steps = registry.counter(
             "sched_steps_total",
             "Engine steps recorded by the scheduling ledger, by batch kind "
-            "(prefill|decode|window|verify|guided; a mixed step counts "
-            "once per kind it dispatched)")
+            "(prefill|decode|window|verify|guided|mixed; a multi-batch "
+            "step counts once per kind it dispatched)")
+        self.prefill_chunk = registry.gauge(
+            "sched_prefill_chunk_tokens",
+            "Effective prefill chunk size in tokens per QoS class "
+            "(SLO-driven per-class when --prefill-chunk 0 auto mode is "
+            "on, uniform otherwise), qos_class label")
         self.admission_blocked = registry.counter(
             "sched_admission_blocked_total",
             "Admission attempts blocked, by cause (no_free_blocks|"
@@ -161,6 +166,8 @@ def install_sched_metrics(registry: MetricsRegistry) -> SchedMetrics:
         m.budget_util.set(last.budget_util)
         for cls, d in last.queue_depths.items():
             m.queue_depth.set(float(d), qos_class=cls)
+    for cls, chunk in led.prefill_chunks.items():
+        m.prefill_chunk.set(float(chunk), qos_class=cls)
     return m
 
 
@@ -171,11 +178,18 @@ def install_sched_metrics(registry: MetricsRegistry) -> SchedMetrics:
 @dataclass
 class HolStall:
     """One step's head-of-line interference: the culprit prefill and the
-    decode-ready streams whose token delivery its chunk delayed."""
+    decode-ready streams whose token delivery its chunk delayed.
+
+    ``stall_share`` scales the per-victim stall below the full step wall:
+    under the unified mixed step the chunk is not a separate launch, so
+    the engine passes the chunk's cost-model marginal share of the step
+    (mixed minus pure-decode over mixed). None = legacy two-launch
+    attribution (the whole wall)."""
 
     culprit: str                    # culprit request id (largest chunk)
     culprit_tokens: int             # prefill tokens the step carried
     victims: list = field(default_factory=list)  # (trace_ctx, rid, qos_class)
+    stall_share: float | None = None  # chunk's marginal fraction of the wall
 
 
 @dataclass
@@ -201,7 +215,8 @@ class SchedStepRecord:
     preempt: dict = field(default_factory=dict)        # cause -> tokens
     hol_culprit: str = ""
     hol_victims: int = 0
-    hol_stall_s: float = 0.0        # per-victim stall (== step wall)
+    hol_stall_s: float = 0.0        # per-victim stall (wall x stall_share;
+                                    # == full wall on the legacy path)
     interference_row_s: float = 0.0  # victims x stall
 
     def to_dict(self) -> dict:
@@ -261,6 +276,8 @@ class SchedLedger:
         self.interference_row_seconds_total = 0.0
         self.blocked_totals: dict[str, int] = {}
         self.preempt_totals: dict[str, int] = {}
+        # effective per-QoS prefill chunk sizes (engine publishes at init)
+        self.prefill_chunks: dict[str, int] = {}
         # per-culprit {rid: (stall_seconds, victim_count)}
         self._culprits: dict[str, tuple[float, int]] = {}
         # accumulated between steps, flushed into the next record
@@ -291,6 +308,19 @@ class SchedLedger:
             self._culprits.clear()
             self._blocked_step.clear()
             self._preempt_step.clear()
+            self.prefill_chunks = {}
+
+    def set_prefill_chunks(self, chunk_by_qos: dict) -> None:
+        """Publish the effective per-QoS prefill chunk sizes (resolved at
+        engine construction — SLO-driven in auto mode, uniform otherwise)
+        to the dynamo_sched_prefill_chunk_tokens gauge."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.prefill_chunks = dict(chunk_by_qos)
+        m = get_sched_metrics()
+        for qos, chunk in chunk_by_qos.items():
+            m.prefill_chunk.set(float(chunk), qos_class=qos)
 
     # -- recording ------------------------------------------------------
     def record_block(self, cause: str) -> None:
@@ -362,10 +392,13 @@ class SchedLedger:
         pad_f = max(sched_flops - live_flops, 0.0)
         pad_b = max(sched_bytes - live_bytes, 0.0)
         if hol is not None and hol.victims:
-            # Every decode-ready stream in the step waited the full step
-            # wall for its token (outputs materialize at finalize, after
-            # the prefill program).
-            stall = wall_s
+            # Every decode-ready stream in the step waited for its token
+            # (outputs materialize at finalize). Legacy two-launch steps
+            # charge the full step wall (the prefill program serialized
+            # after decode); unified mixed steps charge only the chunk's
+            # marginal share of the single launch.
+            stall = (wall_s * hol.stall_share
+                     if hol.stall_share is not None else wall_s)
             rec.hol_culprit = hol.culprit
             rec.hol_victims = len(hol.victims)
             rec.hol_stall_s = stall
@@ -449,6 +482,8 @@ class SchedLedger:
                 "interference_row_seconds_total": round(
                     self.interference_row_seconds_total, 6),
             }
+            if self.prefill_chunks:
+                out["prefill_chunk_tokens"] = dict(self.prefill_chunks)
         if recent:
             out["goodput_mean_recent"] = round(
                 sum(r.goodput for r in recent) / len(recent), 4)
@@ -509,7 +544,8 @@ def get_sched_ledger() -> SchedLedger:
 # Live-vs-scheduled step geometry — the SAME math as engine dispatch.
 # ---------------------------------------------------------------------------
 
-def step_geometry(model_cfg, engine_cfg, batches) -> dict:
+def step_geometry(model_cfg, engine_cfg, batches, *,
+                  mixed_dec_rows: int = 0) -> dict:
     """Live and scheduled (bucket-padded) work for one finalized step.
 
     ``batches`` is PendingStep.batches: (kind, rows, sample_rows, toks,
@@ -520,6 +556,12 @@ def step_geometry(model_cfg, engine_cfg, batches) -> dict:
     which can have shrunk by finalize time for finished seqs). Both sides
     run through obs/costmodel.model_step_cost, so goodput is a pure FLOPs
     ratio hand-computable at any known bucket geometry.
+
+    Unified "mixed" batches (decode rows + prefill chunks in one launch)
+    price as: live = per-row exact tokens/contexts, scheduled = the mixed
+    program's b (DECODE row ladder) × t (prefill chunk ladder) envelope.
+    ``mixed_dec_rows`` is the plan-time decode-row count of the step's
+    mixed batch (leading rows), splitting prefill_rows/decode_rows.
 
     Returns {kinds, prefill_rows, decode_rows, live_tokens, sched_tokens,
     live_flops, sched_flops, live_bytes, sched_bytes}.
@@ -547,7 +589,15 @@ def step_geometry(model_cfg, engine_cfg, batches) -> dict:
             t = min(_pow2_bucket(t_max, 2, ec.spec_k + 1), ec.spec_k + 1)
             window = 1
         elif t_max == 1:
+            # Includes degenerate "mixed" batches (every live row one token):
+            # dispatch reclassifies those to the decode program.
             b, t = _bucket(n, ec.decode_bucket), 1
+        elif kind == "mixed":
+            # Unified step: decode-row ladder for b, prefill chunk ladder
+            # for t — the envelope dispatch() compiles for mixed batches.
+            b, t = _bucket(n, ec.decode_bucket), _pow2_bucket(
+                t_max, 16, ec.prefill_chunk)
+            window = 1
         else:
             b, t = _bucket(n, (1, 2, 4, 8)), _pow2_bucket(
                 t_max, 16, ec.prefill_chunk)
@@ -559,6 +609,14 @@ def step_geometry(model_cfg, engine_cfg, batches) -> dict:
         if kind == "prefill":
             kinds.append("prefill")
             pf_rows += n
+        elif kind == "mixed":
+            # Leading rows of a mixed batch are decode/guided by
+            # construction; the split is captured at plan time because
+            # prefill_target() moves as finalize appends tokens.
+            kinds.append("mixed" if t_max > 1 else "decode")
+            d = min(mixed_dec_rows, n)
+            dec_rows += d
+            pf_rows += n - d
         elif kind == "verify":
             kinds.append("verify")
             dec_rows += n

@@ -94,8 +94,24 @@ class EngineConfig:
     max_batch_size: int = 64
     max_model_len: int = 8192
     max_tokens_per_step: int = 8192       # prefill token budget per step
-    prefill_chunk: int = 512              # chunked-prefill bucket
+    # Chunked-prefill bucket. 0 = auto: costmodel.auto_prefill_chunk picks
+    # the largest chunk whose predicted mixed-step time keeps decode ITL
+    # inside itl_slo_ms (resolved to a concrete cap at engine construction
+    # so bucket enumeration and warmup see real shapes).
+    prefill_chunk: int = 512
     decode_bucket: tuple[int, ...] = (8, 16, 32, 64)
+    # Unified ragged mixed-phase steps: pack the step's decode rows (one
+    # live token each) and prefill-chunk rows (up to prefill_chunk live
+    # tokens) into ONE ragged XLA program per iteration — per-row live
+    # token counts ride the scalar-prefetch path, so padding costs
+    # DMA-elided grid steps, not FLOPs. False = legacy two-launch path
+    # (decode program, then prefill program) for bisection.
+    unified_step: bool = True
+    # Decode inter-token-latency SLO budget (milliseconds) that
+    # costmodel.auto_prefill_chunk sizes chunks against when
+    # prefill_chunk=0. Per-QoS ladder scales it: interactive 1x,
+    # standard 2x, batch 4x.
+    itl_slo_ms: float = 50.0
     # Mesh axes sizes; 1 = unsharded. (data, pipe, seq, model, expert)
     dp: int = 1
     pp: int = 1

@@ -413,3 +413,118 @@ def test_fast_greedy_path_matches_general():
         "general core unexpectedly used the fast path"
     for i in range(2):
         assert fast[f"g{i}"] == general[f"g{i}"], (fast, general)
+
+
+# ---------------------------------------------------------------------------
+# Unified ragged mixed-phase steps: decode rows and prefill chunks dispatched
+# as ONE launch must emit streams identical to the legacy two-launch path
+# (--no-unified-step). Prompts span multiple chunks so decode rows genuinely
+# co-batch with in-flight prefill chunks mid-run.
+# ---------------------------------------------------------------------------
+
+def test_unified_matches_legacy_greedy():
+    def reqs(tag):
+        return [make_req(prompt=[(3 * i + j) % 100 for j in range(5 + 17 * i)],
+                         max_tokens=6 + 2 * i, rid=f"{tag}{i}") for i in range(4)]
+
+    got_a, got_b = _stream_pair({"unified_step": False}, {}, reqs)
+    for i in range(4):
+        assert got_b[f"b{i}"] == got_a[f"a{i}"], f"stream {i} diverged"
+        assert len(got_b[f"b{i}"]) == 6 + 2 * i
+
+
+@pytest.mark.slow
+def test_unified_sampled_reproducible():
+    """Seeded sampling + penalties: per-slot PRNG keys advance once per token
+    whether the row decodes in a pure-decode launch or a mixed one."""
+    def reqs(tag):
+        return [make_req(prompt=[(7 * i + j) % 90 for j in range(6 + 15 * i)],
+                         max_tokens=10, temperature=0.8, seed=42 + i,
+                         frequency_penalty=0.3, rid=f"{tag}{i}")
+                for i in range(3)]
+
+    got_a, got_b = _stream_pair({"unified_step": False}, {}, reqs)
+    for i in range(3):
+        assert got_b[f"b{i}"] == got_a[f"a{i}"], f"stream {i} diverged"
+
+
+@pytest.mark.slow
+def test_unified_pipelined_matches_legacy():
+    """Unified steps under one-step-in-flight pipelining (production loop)."""
+    def reqs(tag):
+        return [make_req(prompt=[(5 * i + j) % 80 for j in range(4 + 16 * i)],
+                         max_tokens=7 + i, rid=f"{tag}{i}") for i in range(3)]
+
+    got_a, got_b = _stream_pair({"unified_step": False}, {}, reqs,
+                                pipelined=True)
+    for i in range(3):
+        assert got_b[f"b{i}"] == got_a[f"a{i}"], f"stream {i} diverged"
+
+
+def test_unified_under_block_pressure():
+    """Preemption and resume land on the mixed path too: resumed seqs
+    re-prefill their chunks next to still-live decode rows."""
+    def reqs(tag):
+        return [make_req(prompt=[(11 * i + j) % 70 for j in range(8)],
+                         max_tokens=12, rid=f"{tag}{i}") for i in range(4)]
+
+    got_a, got_b = _stream_pair({"num_blocks": 25, "unified_step": False},
+                                {"num_blocks": 25}, reqs)
+    for i in range(4):
+        assert got_b[f"b{i}"] == got_a[f"a{i}"], f"stream {i} diverged"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv", ["bfloat16", "int8", "int4"])
+def test_unified_wildly_ragged_bench_geometry(kv, monkeypatch):
+    """Wildly-ragged mixed batch at the bench attention geometry (8 KV heads
+    x head_dim 128, the llama-3-8b shape): one-block decode rows co-batched
+    with a near-chunk-size prefill arriving mid-decode, for every paged-cache
+    dtype."""
+    from dynamo_tpu.models.config import MODEL_PRESETS, ModelConfig
+    monkeypatch.setitem(MODEL_PRESETS, "tiny-kh8-d128", ModelConfig(
+        name="tiny-kh8-d128", vocab_size=256, hidden_size=1024,
+        intermediate_size=256, num_layers=1, num_heads=8, num_kv_heads=8,
+        head_dim=128))
+
+    def run(unified):
+        core = EngineCore(tiny_config(model="tiny-kh8-d128", kv_dtype=kv,
+                                      unified_step=unified))
+        early = [make_req(prompt=[10 * i + j for j in range(3)],
+                          max_tokens=14, rid=f"d{i}") for i in range(3)]
+        for r in early:
+            core.add_request(r)
+        got = {r.request_id: [] for r in early}
+        for _ in range(4):  # establish pure decode before the prefill lands
+            for rid, out in core.step().items():
+                got[rid].extend(out.token_ids)
+        core.add_request(make_req(prompt=[(7 * j) % 200 for j in range(30)],
+                                  max_tokens=8, rid="pf"))
+        got["pf"] = []
+        fin = set()
+        for _ in range(200):
+            if not core.has_work():
+                break
+            for rid, out in core.step().items():
+                got[rid].extend(out.token_ids)
+                if out.finish_reason is not None:
+                    fin.add(rid)
+        assert len(fin) == 4
+        return got
+
+    assert run(True) == run(False)
+
+
+def test_auto_prefill_chunk_engine_init():
+    """prefill_chunk=0 resolves to concrete SLO-driven per-QoS chunks before
+    bucket enumeration and the scheduler read the config — and the engine
+    still serves."""
+    core = EngineCore(tiny_config(prefill_chunk=0))
+    ec = core.engine_cfg
+    assert ec.prefill_chunk >= 16
+    assert set(core.chunk_by_qos) == {"interactive", "standard", "batch"}
+    assert ec.prefill_chunk == max(core.chunk_by_qos.values())
+    assert core.chunk_by_qos["batch"] >= core.chunk_by_qos["interactive"]
+    assert all(c & (c - 1) == 0 for c in core.chunk_by_qos.values())
+    out, fin = run_to_completion(core, [make_req(rid="auto")])
+    assert len(out["auto"]) == 8 and "auto" in fin

@@ -298,3 +298,30 @@ def test_guided_vocab_sentencepiece_byte_fallback():
     assert pieces[3] == ""          # lone non-ASCII byte stays disallowed
     assert pieces[4] == "world"
     assert pieces[5] == " added"    # backfilled via convert_ids_to_tokens
+
+
+@pytest.mark.slow
+def test_guided_unified_matches_legacy():
+    """Guided rows join the unified mixed launch via per-row masks: the
+    guided stream AND its plain sibling (whose multi-chunk prompt forces
+    real mixed steps while the guided row decodes) match --no-unified-step
+    exactly."""
+    schema = {"type": "object",
+              "properties": {"name": {"type": "string",
+                                      "enum": ["ada", "bob"]},
+                             "ok": {"type": "boolean"}},
+              "required": ["name", "ok"]}
+
+    def run(unified):
+        core = EngineCore(tiny_config(unified_step=unified))
+        out, fin = run_to_completion(core, [
+            guided_req(schema, max_tokens=64),
+            make_req(prompt=[(3 * j) % 90 for j in range(40)],
+                     max_tokens=10, rid="p"),
+        ])
+        assert fin == {"g", "p"}
+        return out
+
+    uni = run(True)
+    assert uni == run(False)
+    validate_json_output(decode_out(uni["g"]), schema)

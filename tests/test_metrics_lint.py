@@ -109,6 +109,10 @@ def test_sched_drift_detected(tmp_path: Path):
                for p in problems)
     assert any("sched_hol_stall_seconds" in p and "does not register" in p
                for p in problems)
+    # The SLO-driven chunk gauge is part of the declared family: dropping
+    # its registration must trip the same drift check.
+    assert any("sched_prefill_chunk_tokens" in p and "does not register" in p
+               for p in problems)
 
 
 def test_stream_ckpt_drift_detected(tmp_path: Path):

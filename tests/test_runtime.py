@@ -19,11 +19,11 @@ pytestmark = pytest.mark.asyncio
 
 
 @contextlib.asynccontextmanager
-async def cluster(n_workers: int = 1, handler_factory=None):
+async def cluster(n_workers: int = 1, handler_factory=None, **cfg_kw):
     """Coordinator + n worker runtimes serving ns.backend.generate."""
     server = CoordinatorServer()
     await server.start()
-    cfg = RuntimeConfig(coordinator_url=server.url)
+    cfg = RuntimeConfig(coordinator_url=server.url, **cfg_kw)
     runtimes = []
 
     def default_factory(i):
@@ -316,7 +316,9 @@ async def test_client_blip_reuses_lease_no_churn():
     runtime must REUSE its still-live primary lease — no key deletions are
     broadcast, registrations stay intact, and the keepalive resumes (the
     lease survives well past its TTL afterwards)."""
-    async with cluster(n_workers=1) as (server, cfg, runtimes):
+    # short TTL (the chaos harness serves fleets at 3s) keeps the
+    # multiple-TTL survival window cheap
+    async with cluster(n_workers=1, lease_ttl_s=3.0) as (server, cfg, runtimes):
         rt = runtimes[0]
         old_lease = rt.primary_lease.id
         key = rt._served[next(iter(rt._served))].endpoint.instance_key(

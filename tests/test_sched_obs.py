@@ -390,8 +390,14 @@ def test_real_engine_hol_attribution(clean_ledger):
     # goodput under ragged tiny batches: valid fraction, < 1 somewhere
     assert all(0.0 < r.goodput <= 1.0 for r in led.steps)
     assert any(r.goodput < 1.0 for r in led.steps)
+    # Unified step (default): the prefill chunks rode mixed launches.
     kinds = {k for r in led.steps for k in r.kinds}
-    assert {"prefill", "decode"} <= kinds
+    assert {"mixed", "decode"} <= kinds
+    # Marginal HOL attribution: each mixed record's stall is the chunk's
+    # cost-model share of the step wall, never more than the full wall.
+    mixed_hol = [r for r in led.steps if "mixed" in r.kinds and r.hol_victims]
+    assert mixed_hol
+    assert all(0.0 <= r.hol_stall_s <= r.wall_s for r in mixed_hol)
 
 
 def test_real_engine_disabled_is_inert(clean_ledger, monkeypatch):
@@ -446,7 +452,8 @@ def test_mocker_sched_parity(clean_ledger):
     assert sched["live_tokens_total"] > 0
     assert sched["sched_tokens_total"] >= sched["live_tokens_total"]
     kinds = {k for r in led.steps for k in r.kinds}
-    assert {"prefill", "decode"} <= kinds
+    assert {"mixed", "decode"} <= kinds
+    assert "prefill" not in kinds  # unified default: no serialized prefill
 
 
 def test_mocker_disabled_omits_stats_block(clean_ledger, monkeypatch):
@@ -524,6 +531,22 @@ async def test_debug_sched_endpoint(clean_ledger):
     assert "top_culprits" in d and "trace_culprits" in d
 
 
+def test_prefill_chunk_gauge_republishes(clean_ledger):
+    """The per-QoS chunk gauge survives a late install: a registry bound
+    AFTER the engine resolved its chunks still exposes every class."""
+    clean_ledger.set_prefill_chunks(
+        {"interactive": 64, "standard": 128, "batch": 512})
+    reg = MetricsRegistry()
+    install_sched_metrics(reg)
+    rollup = parse_prometheus(reg.expose())
+    for cls, want in (("interactive", 64), ("standard", 128), ("batch", 512)):
+        key = ("dynamo_sched_prefill_chunk_tokens",
+               frozenset({("qos_class", cls)}))
+        assert rollup.get(key) == float(want)
+    assert clean_ledger.snapshot()["prefill_chunk_tokens"] == {
+        "interactive": 64, "standard": 128, "batch": 512}
+
+
 def test_install_republishes_gauges(clean_ledger):
     clean_ledger.record_step(wall_s=0.01, kinds=("decode",), live_tokens=1,
                              sched_tokens=2, budget_util=0.25,
@@ -569,3 +592,40 @@ def test_fleet_decode_stall_sli():
     assert out["decode_stall"]["kind"] == "latency"
     assert out["decode_stall"]["good"] == 8.0
     assert out["decode_stall"]["total"] == 10.0
+
+
+async def test_mocker_unified_lowers_hol_stall(clean_ledger):
+    """Acceptance mirror, device-free: the SAME victim/culprit traffic
+    attributes strictly less HOL stall under unified mixed steps — one
+    co-scheduled launch priced at the phase roofline max, victims charged
+    only the chunk's marginal share — than under the legacy path, where the
+    serialized prefill's full wall lands on every co-resident stream."""
+    from dynamo_tpu.mocker.engine import MockEngine
+
+    led = clean_ledger
+
+    async def run(unified):
+        led.reset()
+        eng = MockEngine(_mock_args(unified_step=unified,
+                                    speedup_ratio=100.0))
+        first = asyncio.Event()
+
+        async def victim():
+            async for _ in eng.generate(_req(range(5, 29), max_tokens=60,
+                                             rid="victim")):
+                first.set()
+
+        vt = asyncio.create_task(victim())
+        await asyncio.wait_for(first.wait(), 10)
+        # victim is decoding: the culprit's 32-token prefill must share
+        # (unified) or preempt (legacy) its next iterations
+        await _gen_mock(eng, _req(range(200, 232), max_tokens=2,
+                                  rid="culprit"))
+        await asyncio.wait_for(vt, 30)
+        return led.snapshot()
+
+    uni = await run(True)
+    legacy = await run(False)
+    assert legacy["hol_stall_seconds_total"] > 0
+    assert (uni["hol_stall_seconds_total"]
+            < legacy["hol_stall_seconds_total"])

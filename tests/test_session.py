@@ -332,3 +332,37 @@ async def test_mock_engine_session_retention():
     assert sm.hits.get() - base_hits == 1
     assert sm.avoided_tokens.get() - base_avoided > 0
     await eng.stop()
+
+
+@pytest.mark.slow
+def test_session_turn2_unified_matches_legacy():
+    """Session-retained turn 2 (suffix-only prefill riding a mixed step next
+    to a live decode row) emits the same streams under the unified one-launch
+    path as --no-unified-step."""
+    def run(unified):
+        core = _make_core(unified_step=unified, max_batch_size=4)
+        p1 = list(range(1, 17))
+        out1 = _generate(core, p1, "s1")
+        # A sibling stream decodes while turn 2's suffix prefill lands.
+        sib = PreprocessedRequest(
+            token_ids=list(range(60, 68)),
+            sampling_options=SamplingOptions(temperature=0.0),
+            stop_conditions=StopConditions(max_tokens=16, ignore_eos=True))
+        sib.request_id = "sib"
+        core.add_request(sib)
+        core.step()
+        core.step()
+        p2 = p1 + out1 + [3, 1, 4, 1, 5, 9, 2, 6]
+        t2 = PreprocessedRequest(
+            token_ids=p2, annotations={SESSION_KEY: "s1"},
+            sampling_options=SamplingOptions(temperature=0.0),
+            stop_conditions=StopConditions(max_tokens=4, ignore_eos=True))
+        t2.request_id = "t2"
+        core.add_request(t2)
+        got = {"sib": [], "t2": []}
+        while core.has_work():
+            for rid, o in core.step().items():
+                got[rid].extend(o.token_ids)
+        return out1, got
+
+    assert run(True) == run(False)

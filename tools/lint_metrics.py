@@ -167,6 +167,7 @@ SCHED_METRICS = (
     "sched_padding_hbm_bytes_total",
     "sched_hol_stall_seconds",
     "sched_interference_row_seconds_total",
+    "sched_prefill_chunk_tokens",
 )
 
 # The fleet-aggregation family (obs/fleet.py FleetAggregator): scrape
