@@ -399,6 +399,23 @@ def _sched_stamp() -> dict | None:
         return None
 
 
+def _mem_stamp() -> dict | None:
+    """Memory-ledger stamp (obs/mem_ledger.py) attached to every emitted
+    line, same contract as ``_sched_stamp``: per-owner device occupancy,
+    tier waterfall, TTX forecast/posture, orphan-pin count. In the parent
+    process the ledger is empty; the child's line carries the populated
+    stamp and is forwarded as-is."""
+    try:
+        from dynamo_tpu.obs.mem_ledger import get_mem_ledger
+
+        led = get_mem_ledger()
+        if not led.enabled:
+            return {"enabled": False}
+        return led.snapshot()
+    except Exception:  # noqa: BLE001 — same best-effort rule as predicted
+        return None
+
+
 def _measure_session_turn2(deadline_at: float) -> dict | None:
     """Measured arm of the ``session`` entry: a real two-turn conversation
     against a fresh small EngineCore with prefix caching + session retention
@@ -506,6 +523,9 @@ def fail(stage: str, error: str, probe_log: str = "") -> None:
     sched = _sched_stamp()
     if sched is not None:
         out["sched"] = sched
+    mem = _mem_stamp()
+    if mem is not None:
+        out["mem"] = mem
     if probe_log.strip():
         out["probe_log"] = probe_log.strip()[-2000:]
     print(json.dumps(out))
@@ -653,6 +673,8 @@ def _cpu_fallback(probe_error: str, probe_log: str) -> None:
         out["compile"] = _compile_stamp()
     if out.get("sched") is None:
         out["sched"] = _sched_stamp()
+    if out.get("mem") is None:
+        out["mem"] = _mem_stamp()
     if probe_log.strip():
         out["probe_log"] = probe_log.strip()[-2000:]
     print(json.dumps(out))
@@ -804,6 +826,9 @@ def run_bench(deadline_at: float) -> dict:
         # Goodput / padding-waste / HOL view of the same steps — the
         # scheduling ledger that just priced every dispatch above.
         "sched": _sched_stamp(),
+        # Occupancy waterfall / TTX / orphan-pin view of the same run —
+        # the memory ledger the engine above pinned and audited against.
+        "mem": _mem_stamp(),
     }
 
 
@@ -910,6 +935,8 @@ def main() -> None:
             parsed["compile"] = _compile_stamp()
         if parsed.get("sched") is None:
             parsed["sched"] = _sched_stamp()
+        if parsed.get("mem") is None:
+            parsed["mem"] = _mem_stamp()
         if parsed.get("mixed_step") is None:
             parsed["mixed_step"] = _mixed_step_metric()
         print(json.dumps(parsed))

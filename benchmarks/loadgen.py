@@ -53,6 +53,16 @@ cohort's, attributed server-side via the scraped ``dynamo_sched_*`` deltas
 stall table from ``/debug/sched``. This is the before/after harness for
 the chunked prefill unification (ROADMAP item 2): chunking should pull the
 disrupted/steady ratio toward 1 while the stall attribution shrinks.
+
+``--mode capacity`` validates the memory ledger's time-to-exhaustion
+forecast (obs/mem_ledger.py): long-decode streams ramp up
+(``--ramp-step`` more every ``--ramp-every`` seconds) until the device
+block pool exhausts — free blocks near zero, admission blocked, or the
+first 429/503. ``dynamo_mem_*`` is scraped throughout; the summary
+reports the MEASURED time-to-exhaustion against what
+``dynamo_mem_ttx_seconds`` forecast at each sample (median relative
+error; the acceptance gate is agreement within 30%) plus the per-owner
+occupancy waterfall at saturation.
 """
 
 from __future__ import annotations
@@ -339,6 +349,41 @@ async def scrape_sched(urls: list[str]) -> "dict | None":
         g = metric_sum(sample, "dynamo_sched_goodput_fraction")
         out["goodput_min"] = (g if out["goodput_min"] is None
                               else min(out["goodput_min"], g))
+    return out if seen else None
+
+
+async def scrape_mem(urls: list[str]) -> "dict | None":
+    """One snapshot of the memory-ledger series (obs/mem_ledger.py) across
+    the scraped /metrics endpoints. Pinned-owner and free/cached block
+    gauges SUM across workers (fleet occupancy); the TTX forecast takes the
+    MINIMUM and the posture the MAXIMUM (the first worker to exhaust is the
+    one the router feels). ``admission_blocked`` rides along as an
+    exhaustion signal. None when nothing was reachable."""
+    out = {"owners": {}, "free": 0.0, "cached": 0.0, "ttx_min": None,
+           "posture_max": 0, "admission_blocked": 0.0}
+    seen = False
+    for u in urls:
+        try:
+            sample = await fetch_metrics(u, timeout_s=5)
+        except Exception:
+            continue
+        seen = True
+        for (name, labels), value in sample.items():
+            if name == "dynamo_mem_device_blocks":
+                owner = dict(labels).get("owner", "?")
+                if owner == "free":
+                    out["free"] += value
+                elif owner == "cached":
+                    out["cached"] += value
+                else:
+                    out["owners"][owner] = out["owners"].get(owner, 0.0) + value
+            elif name == "dynamo_mem_ttx_seconds":
+                out["ttx_min"] = (value if out["ttx_min"] is None
+                                  else min(out["ttx_min"], value))
+            elif name == "dynamo_mem_capacity_posture":
+                out["posture_max"] = max(out["posture_max"], int(value))
+        out["admission_blocked"] += metric_sum(
+            sample, "dynamo_sched_admission_blocked_total")
     return out if seen else None
 
 
@@ -1087,6 +1132,142 @@ async def run_overload(url: str, model: str, arrival_rate: float,
     }
 
 
+async def run_capacity(url: str, model: str, concurrency: int, isl: int,
+                       osl: int, ramp_step: int, ramp_every_s: float,
+                       max_streams: int,
+                       metrics_urls: "list[str] | None" = None) -> dict:
+    """Capacity mode: ramp long-decode streams until the device block pool
+    exhausts, validating the mem ledger's TTX forecast against the clock.
+
+    ``--concurrency`` streams launch immediately; every ``--ramp-every``
+    seconds ``--ramp-step`` more join, each decoding ``--osl`` tokens with
+    ``ignore_eos`` so resident KV grows monotonically (one block per
+    block-size tokens per stream). ``dynamo_mem_*`` is polled twice a
+    second the whole way; exhaustion is the FIRST of: free blocks under 2%
+    of the observed pool, a ``dynamo_sched_admission_blocked_total``
+    increment, or a 429/503 on any stream.
+
+    The headline is forecast agreement: at each poll t the ledger said
+    "ttx seconds left"; the clock later says exhaustion landed at t_ex, so
+    the measured remaining was t_ex - t. The summary reports the median
+    relative error over the settled half of the ramp (the EWMA needs a few
+    observations before its rate means anything) — the acceptance gate is
+    ``median_ttx_err <= 0.30``. The per-owner occupancy waterfall and
+    posture at saturation ride along, then every in-flight stream is
+    cancelled (aborting server-side) so the run ends promptly."""
+    from dynamo_tpu.obs.mem_ledger import POSTURES, TTX_CAP_S
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=30)
+    poll_s = 0.5
+    samples: list[tuple[float, float]] = []  # (t_rel, forecast ttx)
+    statuses: list[int] = []
+    exhaust_signal: list[str] = []
+    saturation: "dict | None" = None
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        cpt = await calibrate(session, url, model)
+        scrape_urls = metrics_urls or [url]
+        base = await scrape_mem(scrape_urls)
+        blocked0 = base["admission_blocked"] if base else 0.0
+        counter = iter(range(10 ** 9))
+        pending: set[asyncio.Task] = set()
+        done_results: list[RequestResult] = []
+
+        def launch(n: int) -> None:
+            for _ in range(n):
+                pending.add(asyncio.create_task(one_request(
+                    session, url, model, isl, osl, next(counter), cpt)))
+
+        t_start = time.perf_counter()
+        launch(min(concurrency, max_streams))
+        issued = len(pending)
+        next_ramp = ramp_every_s
+        t_ex: "float | None" = None
+        while pending:
+            done, pending = await asyncio.wait(pending, timeout=poll_s)
+            for t in done:
+                r = t.result()
+                done_results.append(r)
+                statuses.append(r.status)
+            now = time.perf_counter() - t_start
+            mem = await scrape_mem(scrape_urls)
+            if mem is not None:
+                if mem["ttx_min"] is not None:
+                    samples.append((now, mem["ttx_min"]))
+                total = (mem["free"] + mem["cached"]
+                         + sum(mem["owners"].values()))
+                if total > 0 and mem["free"] <= max(total * 0.02, 1.0):
+                    exhaust_signal.append("free_blocks")
+                if mem["admission_blocked"] - blocked0 > 0:
+                    exhaust_signal.append("admission_blocked")
+            if any(s in (429, 503) for s in statuses):
+                exhaust_signal.append("http_reject")
+            if exhaust_signal:
+                t_ex = now
+                saturation = mem
+                break
+            if now >= next_ramp and issued < max_streams:
+                step = min(ramp_step, max_streams - issued)
+                launch(step)
+                issued += step
+                next_ramp += ramp_every_s
+            if issued >= max_streams and not pending:
+                break  # every stream drained without exhausting: undersized
+        for t in pending:
+            t.cancel()  # closing the connection aborts the stream server-side
+        await asyncio.gather(*pending, return_exceptions=True)
+        if saturation is None:
+            saturation = await scrape_mem(scrape_urls)
+        wall = time.perf_counter() - t_start
+
+    # Forecast agreement: only the settled half — early samples fold a
+    # still-learning EWMA and a still-growing arrival rate.
+    errs: list[float] = []
+    series: list[list[float]] = []
+    if t_ex is not None:
+        for t, ttx in samples:
+            measured = t_ex - t
+            if measured <= poll_s or ttx >= TTX_CAP_S:
+                continue
+            if t < t_ex * 0.5:
+                continue
+            errs.append(abs(ttx - measured) / measured)
+            series.append([round(t, 2), round(ttx, 2), round(measured, 2)])
+    median_err = percentile(errs, 50) if errs else None
+    occupancy = None
+    if saturation is not None:
+        occupancy = {
+            **{k: int(v) for k, v in sorted(saturation["owners"].items())},
+            "free": int(saturation["free"]),
+            "cached": int(saturation["cached"]),
+        }
+    return {
+        "mode": "capacity",
+        "streams_launched": issued,
+        "streams_finished": len(done_results),
+        "isl": isl,
+        "osl": osl,
+        "ramp_step": ramp_step,
+        "ramp_every_s": ramp_every_s,
+        "wall_s": round(wall, 3),
+        "exhausted": t_ex is not None,
+        "exhaust_signal": exhaust_signal[0] if exhaust_signal else None,
+        "time_to_exhaustion_s": round(t_ex, 3) if t_ex is not None else None,
+        "forecast": {
+            "scraped": bool(samples),
+            "samples_used": len(errs),
+            "median_ttx_err": (round(median_err, 3)
+                               if median_err is not None else None),
+            # the ISSUE's acceptance gate: measured within 30% of forecast
+            "within_30pct": (median_err <= 0.30
+                             if median_err is not None else None),
+            "series": series[-16:],
+        },
+        "occupancy_at_saturation": occupancy,
+        "posture_at_saturation": (
+            POSTURES[min(saturation["posture_max"], len(POSTURES) - 1)]
+            if saturation is not None else None),
+    }
+
+
 async def fetch_traces(url: str, path: str) -> None:
     """Pull the frontend flight recorder (Chrome trace JSON) post-run."""
     try:
@@ -1137,7 +1318,7 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--model", default="tiny-llama")
     ap.add_argument("--mode",
                     choices=["closed", "overload", "session", "coldstart",
-                             "failover", "interference"],
+                             "failover", "interference", "capacity"],
                     default="closed",
                     help="closed: fixed-concurrency loop; overload: open-loop "
                          "Poisson arrivals past capacity (QoS shedding demo); "
@@ -1156,7 +1337,12 @@ def main(argv: list[str] | None = None) -> dict:
                          "prompt arrivals injected mid-run, reporting "
                          "disrupted-vs-steady ITL p95 with the scraped "
                          "dynamo_sched_* stall attribution (HOL / chunked-"
-                         "prefill harness)")
+                         "prefill harness); "
+                         "capacity: ramp long-decode streams until the "
+                         "device block pool exhausts, reporting measured "
+                         "time-to-exhaustion vs the dynamo_mem_ttx_seconds "
+                         "forecast and the per-owner occupancy at "
+                         "saturation (memory-ledger TTX validation)")
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--isl", type=int, default=128)
@@ -1211,6 +1397,14 @@ def main(argv: list[str] | None = None) -> dict:
                          "before the first long prompt arrives")
     ap.add_argument("--long-gap", type=float, default=0.5,
                     help="interference mode: seconds between long prompts")
+    ap.add_argument("--ramp-step", type=int, default=4,
+                    help="capacity mode: extra streams added each ramp tick")
+    ap.add_argument("--ramp-every", type=float, default=2.0,
+                    help="capacity mode: seconds between ramp ticks")
+    ap.add_argument("--max-streams", type=int, default=256,
+                    help="capacity mode: stop ramping past this many "
+                         "streams (a pool this load can't exhaust is "
+                         "reported as exhausted=false)")
     ap.add_argument("--chips", type=int, default=1,
                     help="chips serving the endpoint (for tok/s/chip)")
     ap.add_argument("--kv-dtype", choices=["bfloat16", "int8", "int4"],
@@ -1308,6 +1502,23 @@ def main(argv: list[str] | None = None) -> dict:
         if result["failed"]:
             print(f"loadgen: {result['failed']} failed requests: "
                   f"{result['errors']}", file=sys.stderr)
+        return result
+
+    if ns.mode == "capacity":
+        result = asyncio.run(run_capacity(
+            ns.url, ns.model, ns.concurrency, ns.isl, ns.osl,
+            ns.ramp_step, ns.ramp_every, ns.max_streams,
+            metrics_urls=ns.metrics_url))
+        attach_fleet_slo(result)
+        print(json.dumps(result))
+        if ns.out:
+            with open(ns.out, "w") as f:
+                json.dump(result, f, indent=2)
+        if ns.trace_out:
+            asyncio.run(fetch_traces(ns.url, ns.trace_out))
+        if not result["exhausted"]:
+            print("loadgen: pool never exhausted — raise --max-streams or "
+                  "--osl, or shrink the engine's block pool", file=sys.stderr)
         return result
 
     if ns.mode == "overload":

@@ -593,6 +593,31 @@ def scenario_aggregator_partition(seed: int = 1234) -> ScenarioResult:
         return res
 
 
+def _check_orphan_pins(res: ScenarioResult, stats: dict) -> None:
+    """Mem-ledger leak audit (obs/mem_ledger.py): every worker publishing
+    a ``mem`` stats block must report zero orphan pins at its last audit —
+    a pin whose owner id no longer exists anywhere is a leaked device
+    reference no drain can reclaim."""
+    orphans: dict[str, int] = {}
+    checked = 0
+    for model, s in stats.items():
+        for wid, m in (s.get("workers") or {}).items():
+            if not isinstance(m, dict):
+                continue
+            mem = m.get("mem") or {}
+            if not mem.get("enabled"):
+                continue
+            checked += 1
+            n = int(mem.get("orphan_pins", 0) or 0)
+            if n:
+                orphans[f"{model}/{wid}"] = n
+    res.report.details["orphan_pins_workers_checked"] = checked
+    if orphans:
+        res.report.fail(f"mem-ledger audit found orphan pins: {orphans}")
+    elif checked:
+        res.report.ok("orphan_pins_zero")
+
+
 def scenario_retire_under_load(seed: int = 1234,
                                quick: bool = False) -> ScenarioResult:
     """Drain-aware retirement end to end (runtime/drain.py): a worker
@@ -667,6 +692,7 @@ def scenario_retire_under_load(seed: int = 1234,
         warm = InvariantChecker()
         warm.report = res.report
         warm.check_warm_resume(stats, minimum=n_sessions)
+        _check_orphan_pins(res, stats)
 
         def parse_drained(line: str) -> dict:
             try:
@@ -777,6 +803,7 @@ def scenario_worker_kill_mid_decode(seed: int = 1234,
         ck = InvariantChecker()
         ck.report = res.report
         ck.check_ckpt_resume(stats, minimum=1)
+        _check_orphan_pins(res, stats)
         res.report.details["ckpt"] = {
             "resumed_text": resumed_text, "control_text": ctrl_text,
             "kill_after": kill_after, "interval_blocks": interval_blocks}

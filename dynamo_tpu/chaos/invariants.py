@@ -108,9 +108,15 @@ class InvariantChecker:
         """``engine_stats`` is the frontend /engine_stats JSON: per model,
         ``workers`` maps worker id -> published engine stats. Single-worker
         fleets (no kv router) may publish no per-worker map; that is a skip,
-        not a pass."""
+        not a pass.
+
+        Workers running the memory ledger (obs/mem_ledger.py) publish a
+        ``mem`` block whose pin-owner audit replaces the old kv_usage
+        heuristic: ``orphan_pins`` must be zero AND no owner class may
+        still hold pinned blocks after drain. Workers without the block
+        (DYN_MEM_LEDGER=0) fall back to the kv_usage walk."""
         leaks: list[str] = []
-        seen = 0
+        seen = audited = 0
         for model, stats in engine_stats.items():
             for wid, m in (stats.get("workers") or {}).items():
                 if not isinstance(m, Mapping):
@@ -123,11 +129,32 @@ class InvariantChecker:
                     leaks.append(
                         f"{model}/{wid}: {running} running + {waiting} "
                         "waiting after drain")
+                    continue
+                mem = m.get("mem")
+                if isinstance(mem, Mapping) and mem.get("enabled"):
+                    audited += 1
+                    orphans = int(mem.get("orphan_pins", 0) or 0)
+                    if orphans:
+                        leaks.append(
+                            f"{model}/{wid}: {orphans} orphan pin(s) at "
+                            "last mem-ledger audit (leaked references)")
+                    held = {
+                        cls: n for cls, n in
+                        (mem.get("device_blocks") or {}).items()
+                        if cls not in ("free", "cached") and n}
+                    # session pins legitimately survive a drain (retained
+                    # turns are the feature, not a leak)
+                    held.pop("session", None)
+                    if held:
+                        leaks.append(
+                            f"{model}/{wid}: pinned blocks after drain "
+                            f"by owner {held}")
                 elif usage > 1e-9:
                     leaks.append(
                         f"{model}/{wid}: kv_usage={usage:.4f} with no "
                         "running requests (leaked pinned blocks)")
         self.report.details["block_leak_workers_checked"] = seen
+        self.report.details["block_leak_workers_audited"] = audited
         for leak in leaks:
             self.report.fail(f"kv leak: {leak}")
         if not leaks and seen:

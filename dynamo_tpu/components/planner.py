@@ -139,6 +139,12 @@ async def amain(ns: argparse.Namespace) -> None:
                 slo = scraper.slo_reason()
                 if slo:
                     reason = f"{reason} | {slo}"
+                # Likewise the capacity forecast: the worst worker's TTX
+                # and posture (obs/mem_ledger.py) stamp every decision so
+                # a scale-up justified by memory pressure says so.
+                mem = scraper.mem_reason()
+                if mem:
+                    reason = f"{reason} | {mem}"
             if connector is not None:
                 await connector.apply(decision.prefill_replicas,
                                       decision.decode_replicas, reason)

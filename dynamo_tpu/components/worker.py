@@ -336,6 +336,13 @@ async def amain(ns: argparse.Namespace) -> None:
     # mirrors step records device-free, so the fleet aggregator's
     # decode_stall SLI evaluates in chaos scenarios without a TPU.
     install_sched_metrics(rt.metrics)
+    from dynamo_tpu.obs.mem_ledger import install_mem_metrics
+
+    # Memory ledger feeds dynamo_mem_* (occupancy waterfall, pin-leak
+    # audit, TTX forecast — obs/mem_ledger.py). Both engine kinds: the
+    # mocker mirrors pins/forecast device-free, so the fleet kv_headroom
+    # SLI and chaos orphan assertions evaluate without a TPU.
+    install_mem_metrics(rt.metrics)
 
     follower_shards: list[dict] = []
     if ns.engine == "mocker":

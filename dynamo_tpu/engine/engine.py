@@ -33,7 +33,11 @@ import numpy as np
 from jax import lax
 
 from dynamo_tpu import chaos
-from dynamo_tpu.engine.cache import KVCacheSpec, allocate_cache
+from dynamo_tpu.engine.cache import (
+    KVCacheSpec,
+    allocate_cache,
+    register_device_tier,
+)
 from dynamo_tpu.engine.prefix_pool import PrefixPool
 from dynamo_tpu.engine.sampling import (
     SamplingState,
@@ -58,6 +62,7 @@ from dynamo_tpu.obs.compile_ledger import (
     get_compile_ledger,
 )
 from dynamo_tpu.obs.profiler import StepPerfProfiler, phase as _perf_phase
+from dynamo_tpu.obs.mem_ledger import get_mem_ledger, live_ids_of
 from dynamo_tpu.obs.sched_ledger import HolStall, get_sched_ledger, step_geometry
 from dynamo_tpu.obs.tracer import get_tracer, trace_context_of
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
@@ -1238,6 +1243,35 @@ class EngineCore:
                            if (engine_cfg.stream_ckpt_blocks > 0
                                and jax.process_count() == 1)
                            else None))
+        # Memory & capacity ledger (obs/mem_ledger.py): re-read the
+        # DYN_MEM_LEDGER env at construction (same contract as the sched
+        # ledger above), publish this engine's device pool as the G1 tier
+        # row, register every KVBM tier's occupancy callback, and hand the
+        # audit a live-id source so orphaned pins reconcile against what
+        # this engine actually holds. Tier callbacks and the live source
+        # are pulled only at snapshot/audit time, never on the step path.
+        self.mem_led = get_mem_ledger()
+        self.mem_led.configure()
+        register_device_tier(self.pool, self.runner.spec)
+        if self.kvbm is not None:
+            for tier in self.kvbm.tiers:
+                self.mem_led.register_tier(tier.name, tier.occupancy)
+        self._mem_source_key = f"engine:{id(self):x}"
+        self.mem_led.register_live_source(self._mem_source_key,
+                                          self._mem_live_ids)
+
+    def _mem_live_ids(self) -> dict:
+        """Per-owner-class live ids for the mem-ledger leak audit. A pin
+        tagged under any class but absent from the matching set here is an
+        orphan — a reference the engine no longer knows about."""
+        staged = getattr(self, "_staged_pins", {})
+        return live_ids_of(
+            streams=self._seqs.keys(),
+            sessions=(self.sessions.session_ids()
+                      if self.sessions is not None else ()),
+            **(self.kvbm.queue_live_ids() if self.kvbm is not None else {}),
+            staging=staged.keys(),
+        )
 
     def _guided_pieces(self) -> tuple[list[str], list[int]]:
         if self._guided_vocab is None:
@@ -1794,6 +1828,17 @@ class EngineCore:
                 **step_geometry(self.model_cfg, self.engine_cfg,
                                 pending.batches,
                                 mixed_dec_rows=pending.mixed_dec_rows))
+        if self.mem_led.enabled:
+            # Capacity forecast + leak audit cadence ride the step clock:
+            # free-pool observations feed the per-QoS EWMA consumption
+            # rates behind dynamo_mem_ttx_seconds, and maybe_audit is a
+            # no-op until audit_interval_s has elapsed.
+            self.mem_led.observe_device(
+                free=self.pool.num_free_raw,
+                cached=self.pool.num_inactive,
+                total=self.pool.num_blocks - 1)
+            self.mem_led.observe_free(self.pool.num_free, now=time.time())
+            self.mem_led.maybe_audit(time.time())
 
     def _plan_verify(self, decode_seqs: list
                      ) -> tuple[list, list[list[int]], list]:
@@ -2056,6 +2101,9 @@ class EngineCore:
             except Exception:
                 log.exception("session %s: tier demotion failed; releasing "
                               "pins to LRU", session_id)
+        if self.mem_led.enabled and entry.pinned:
+            self.mem_led.record_churn("device", "session_demote",
+                                      len(entry.pinned))
         self.pool.release(entry.pinned)
         entry.pinned = []
 
@@ -2268,6 +2316,8 @@ class EngineCore:
         parents: list[int | None] = [None, *covered[:-1]]
         touch.fill(xfer_id, covered, parents, data[:n], self.my_box())
         self._staged_pins[xfer_id] = block_ids
+        if self.mem_led.enabled:
+            self.mem_led.pin("staging", xfer_id, len(block_ids))
         return n
 
     def release_export(self, xfer_id: str) -> None:
@@ -2281,6 +2331,8 @@ class EngineCore:
             self._stream_exports.pop(st.request_id, None)
         self.staging.drop(xfer_id)
         ids = self._staged_pins.pop(xfer_id, None)
+        if ids is not None and self.mem_led.enabled:
+            self.mem_led.unpin("staging", xfer_id)
         if ids:
             self.pool.release(ids)
 
@@ -2359,6 +2411,8 @@ class EngineCore:
             self.pool.release(ids[:start])
         keep = ids[start:]
         self._staged_pins.setdefault(xfer_id, []).extend(keep)
+        if keep and self.mem_led.enabled:
+            self.mem_led.pin("staging", xfer_id, len(keep))
         if st.failed:
             return st.staged
         if len(ids) < stop:
@@ -2397,6 +2451,8 @@ class EngineCore:
         covered = self._vote_min(st.staged)
         pins = self._staged_pins.get(xfer_id, [])
         if len(pins) > covered:
+            if self.mem_led.enabled:
+                self.mem_led.unpin("staging", xfer_id, len(pins) - covered)
             self.pool.release(pins[covered:])
             self._staged_pins[xfer_id] = pins[:covered]
         self.staging.finalize(xfer_id, covered)
@@ -2654,6 +2710,9 @@ class AsyncJaxEngine:
         self._wake.set()
         if self._started:
             await asyncio.get_running_loop().run_in_executor(None, self._thread.join, 5.0)
+        # A dead engine must not keep vouching for its pins: drop its
+        # live-id source so anything it leaked surfaces in the next audit.
+        self.core.mem_led.unregister_live_source(self.core._mem_source_key)
 
     def _emit_op(self, op: dict) -> None:
         """Broadcast one op to follower ranks; a failed broadcast is fatal
@@ -2932,6 +2991,12 @@ class AsyncJaxEngine:
             # Goodput, padding waste, and stall attribution ride the same
             # stats channel (bench stamps, planner feed, /debug/fleet).
             out["sched"] = sled.snapshot()
+        mled = get_mem_ledger()
+        if mled.enabled:
+            # Tier occupancy, pin-owner totals, TTX posture, and the last
+            # leak-audit verdict ride the same channel — chaos invariants
+            # read orphan_pins from here (chaos/invariants.py).
+            out["mem"] = mled.snapshot()
         return out
 
 

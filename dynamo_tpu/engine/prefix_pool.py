@@ -18,6 +18,7 @@ from collections import OrderedDict
 from typing import Callable
 
 from dynamo_tpu.engine.errors import NoFreeBlocks
+from dynamo_tpu.obs.mem_ledger import get_mem_ledger
 from dynamo_tpu.router.events import BlockRemoved, BlockStored, KvCacheEvent
 
 
@@ -49,11 +50,27 @@ class PrefixPool:
         self._hash_of: dict[int, int] = {}          # block_id -> seq_hash (committed)
         self._by_hash: dict[int, int] = {}          # seq_hash -> block_id
         self._inactive: OrderedDict[int, None] = OrderedDict()  # block_id -> LRU order
+        # Memory ledger (obs/mem_ledger.py): device-tier eviction churn is
+        # recorded where it happens. _churn_cause distinguishes pressure
+        # evictions from the deliberate clear() sweep.
+        self._mled = get_mem_ledger()
+        self._churn_cause = "allocation_pressure"
 
     # -- introspection -------------------------------------------------------
     @property
     def num_free(self) -> int:
         return len(self._free) + len(self._inactive)
+
+    @property
+    def num_free_raw(self) -> int:
+        """Free-list blocks only (never-written or fully released)."""
+        return len(self._free)
+
+    @property
+    def num_inactive(self) -> int:
+        """Committed-but-unreferenced blocks parked in the LRU (matchable,
+        evictable on allocation pressure)."""
+        return len(self._inactive)
 
     @property
     def usage(self) -> float:
@@ -104,6 +121,8 @@ class PrefixPool:
             if self.evict_hook is not None:
                 self.evict_hook(bid, h)
             del self._by_hash[h]
+            if self._mled.enabled:
+                self._mled.record_churn("device", self._churn_cause, 1)
             self._emit(BlockRemoved(block_hashes=(h,)))
         return bid
 
@@ -165,8 +184,10 @@ class PrefixPool:
         (reference: http/service/clear_kv_blocks.rs). A deliberate clear
         drops content outright (no write-back offload)."""
         hook, self.evict_hook = self.evict_hook, None
+        self._churn_cause = "clear"
         try:
             while self._inactive:
                 self._free.append(self._evict_one())
         finally:
             self.evict_hook = hook
+            self._churn_cause = "allocation_pressure"

@@ -38,6 +38,7 @@ from typing import Iterable
 from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
 from dynamo_tpu.engine.session import session_id_of
+from dynamo_tpu.obs.mem_ledger import get_mem_ledger
 from dynamo_tpu.obs.sched_ledger import get_sched_ledger
 from dynamo_tpu.protocols.common import FinishReason, PreprocessedRequest
 from dynamo_tpu.qos.deadline import NO_SPEC_KEY, deadline_of, expired, priority_of
@@ -218,6 +219,10 @@ class Scheduler:
         # and preemption recompute accounting. Every hook is gated on
         # .enabled so DYN_SCHED_LEDGER=0 adds zero work to the plan path.
         self._sled = get_sched_ledger()
+        # Memory ledger (obs/mem_ledger.py): stream-owned pin taxonomy and
+        # per-QoS block consumption rates (TTX forecast). Same zero-work
+        # gating contract under DYN_MEM_LEDGER=0.
+        self._mled = get_mem_ledger()
 
     # ------------------------------------------------------------------
     def add(self, seq: Seq) -> None:
@@ -279,6 +284,9 @@ class Scheduler:
         seq.committed_blocks = len(matched)
         seq.num_computed = len(matched) * seq.block_size
         seq.prefix_hit_blocks = len(matched)
+        if self._mled.enabled:
+            self._mled.pin("stream", seq.request_id, len(seq.block_ids))
+            self._mled.record_alloc(seq.qos_priority, len(fresh))
         seq.slot = self._slot_free.pop()
         seq.slot_initialized = False
         seq.phase = Phase.RUNNING
@@ -290,10 +298,14 @@ class Scheduler:
         allocation failed."""
         need = seq.blocks_needed(seq.num_computed + tokens_ahead)
         if need > len(seq.block_ids):
+            grow = need - len(seq.block_ids)
             try:
-                seq.block_ids.extend(self.pool.allocate(need - len(seq.block_ids)))
+                seq.block_ids.extend(self.pool.allocate(grow))
             except NoFreeBlocks:
                 return False
+            if self._mled.enabled:
+                self._mled.pin("stream", seq.request_id, grow)
+                self._mled.record_alloc(seq.qos_priority, grow)
         return True
 
     def preempt(self, seq: Seq, cause: str = "blocks") -> None:
@@ -303,6 +315,9 @@ class Scheduler:
             # Every resident-KV token released here must be recomputed
             # through prefill from position 0 on re-admission.
             self._sled.record_preempt(seq.num_computed, cause)
+        if self._mled.enabled:
+            self._mled.unpin("stream", seq.request_id)
+            self._mled.record_release(seq.qos_priority, len(seq.block_ids))
         self.pool.release(seq.block_ids)
         seq.block_ids = []
         seq.committed_blocks = 0
@@ -322,6 +337,9 @@ class Scheduler:
             self.running.remove(seq)
         elif seq in self.waiting:
             self.waiting.remove(seq)
+        if self._mled.enabled and seq.block_ids:
+            self._mled.unpin("stream", seq.request_id)
+            self._mled.record_release(seq.qos_priority, len(seq.block_ids))
         self.pool.release(seq.block_ids)
         seq.block_ids = []
         if seq.slot >= 0:

@@ -33,6 +33,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Mapping
 
+from dynamo_tpu.obs.mem_ledger import get_mem_ledger
 from dynamo_tpu.utils.metrics import MetricsRegistry
 
 SESSION_KEY = "session.id"
@@ -144,6 +145,14 @@ class SessionStore:
         self.ttl = ttl
         self.max_sessions = max_sessions
         self._entries: "OrderedDict[str, SessionEntry]" = OrderedDict()
+        # Memory ledger (obs/mem_ledger.py): session-owner pin taxonomy.
+        # Pins tag/untag exactly with _entries membership, so the audit's
+        # live set is simply the store's current session ids.
+        self._mled = get_mem_ledger()
+
+    def session_ids(self) -> list[str]:
+        """Live session ids (the mem-ledger audit's live set)."""
+        return list(self._entries)
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -166,12 +175,16 @@ class SessionStore:
         :meth:`pop_oldest` pressure — here they are just released."""
         stale = self._entries.pop(session_id, None)
         if stale is not None:
+            if self._mled.enabled:
+                self._mled.unpin("session", session_id)
             self.pool.release(stale.pinned)
             stale.pinned = []
         pinned = self.pool.match_prefix(list(seq_hashes))
         if not pinned:
             self._gauges()
             return None
+        if self._mled.enabled:
+            self._mled.pin("session", session_id, len(pinned))
         entry = SessionEntry(
             seq_hashes=tuple(seq_hashes[: len(pinned)]),
             pinned=pinned,
@@ -191,6 +204,8 @@ class SessionStore:
         entry = self._entries.pop(session_id, None)
         if entry is None:
             return None
+        if self._mled.enabled:
+            self._mled.unpin("session", session_id)
         self.pool.release(entry.pinned)
         entry.pinned = []
         if now is not None:
@@ -207,6 +222,11 @@ class SessionStore:
                if now - e.last_used >= self.ttl]
         for sid, _ in out:
             del self._entries[sid]
+            if self._mled.enabled:
+                # Pin ownership passes to the caller's demote path, which
+                # releases within the same engine step — the ledger drops
+                # the session tag at store-exit time.
+                self._mled.unpin("session", sid)
         if out:
             self._gauges()
         return out
@@ -216,14 +236,18 @@ class SessionStore:
         if not self._entries:
             return None
         sid, entry = self._entries.popitem(last=False)
+        if self._mled.enabled:
+            self._mled.unpin("session", sid)
         self._gauges()
         return sid, entry
 
     def release_all(self) -> int:
         """Drop every pin (engine wipe / fail_all). Returns blocks freed."""
         n = 0
-        for entry in self._entries.values():
+        for sid, entry in self._entries.items():
             n += len(entry.pinned)
+            if self._mled.enabled:
+                self._mled.unpin("session", sid)
             self.pool.release(entry.pinned)
             entry.pinned = []
         self._entries.clear()

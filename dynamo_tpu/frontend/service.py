@@ -141,6 +141,7 @@ class HttpService:
         self.app.router.add_get("/engine_stats", self.engine_stats)
         self.app.router.add_get("/debug/traces", self.debug_traces)
         self.app.router.add_get("/debug/sched", self.debug_sched)
+        self.app.router.add_get("/debug/mem", self.debug_mem)
         # KServe v2 protocol rides the same app/port (reference serves its
         # KServe gRPC flavor as a separate ingress; see frontend/kserve.py).
         from dynamo_tpu.frontend.kserve import register_kserve
@@ -220,6 +221,17 @@ class HttpService:
 
         return web.json_response(
             get_sched_ledger().debug_info(recorder=self.tracer.recorder))
+
+    async def debug_mem(self, request: web.Request) -> web.Response:
+        """Memory-ledger inspection (obs/mem_ledger.py): tier occupancy
+        waterfall, top pin owners, churn trend, TTX forecast, last leak
+        audit. On an in-process deployment (serve.py, mocker fleets) the
+        engines share this process's ledger, so the document covers them;
+        for subprocess workers hit the worker's own /debug/mem
+        (runtime/status.py)."""
+        from dynamo_tpu.obs.mem_ledger import get_mem_ledger
+
+        return web.json_response(get_mem_ledger().debug_info())
 
     async def engine_stats(self, request: web.Request) -> web.Response:
         """Per-model engine stats (scheduler depth, KV usage, KVBM tiers) —

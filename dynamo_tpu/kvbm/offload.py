@@ -29,6 +29,7 @@ from dynamo_tpu.engine.errors import NoFreeBlocks
 from dynamo_tpu.engine.prefix_pool import PrefixPool
 from dynamo_tpu.kvbm.metrics import get_prefix_cache_metrics
 from dynamo_tpu.kvbm.stream_ckpt import get_stream_ckpt_metrics
+from dynamo_tpu.obs.mem_ledger import get_mem_ledger
 from dynamo_tpu.kvbm.transfer import BlockTransferEngine
 from dynamo_tpu.utils.logging import get_logger
 
@@ -162,6 +163,10 @@ class OffloadManager:
         # (request_id, record, seq_hashes still awaiting flush)
         self._ckpt_records: list[tuple[str, dict, set[int]]] = []
         self._onboarding = False
+        # Memory ledger (obs/mem_ledger.py): queued publish/checkpoint
+        # blocks are device references held outside the pool's refcounts —
+        # tagged per owner class for the occupancy waterfall and audit.
+        self._mled = get_mem_ledger()
         pool.evict_hook = self._on_evict
         if publish_tier is not None:
             pool.commit_hook = self._on_commit
@@ -185,8 +190,13 @@ class OffloadManager:
         # eviction write-back below carries the content to the tier cascade
         # instead.
         if self._publish_pending:
-            self._publish_pending = [
-                (b, h) for b, h in self._publish_pending if b != block_id]
+            stale = [h for b, h in self._publish_pending if b == block_id]
+            if stale:
+                self._publish_pending = [
+                    (b, h) for b, h in self._publish_pending if b != block_id]
+                if self._mled.enabled:
+                    for h in stale:
+                        self._mled.unpin("prefix_publish", str(h))
         # Same staleness rule for queued checkpoint blocks: drop the pair
         # AND release any record waiting on its hash — the record still
         # writes (covering what did reach the store); a resume's onboard
@@ -198,6 +208,9 @@ class OffloadManager:
                     (b, h) for b, h in self._ckpt_pending if b != block_id]
                 for _, _, waiting in self._ckpt_records:
                     waiting -= dropped
+                if self._mled.enabled:
+                    for h in dropped:
+                        self._mled.unpin("stream_ckpt", str(h))
         if not getattr(top, "shared", False) and seq_hash in top:
             return
         self._pending.append((block_id, seq_hash))
@@ -214,6 +227,8 @@ class OffloadManager:
         while len(self._published) > self.PUBLISH_MEMORY:
             self._published.popitem(last=False)
         self._publish_pending.append((block_id, seq_hash))
+        if self._mled.enabled:
+            self._mled.pin("prefix_publish", str(seq_hash), 1)
 
     def flush_pending(self) -> int:
         """Extract all queued evictions — plus this flush's publish-on-commit
@@ -225,6 +240,11 @@ class OffloadManager:
         self._publish_pending = self._publish_pending[self.PUBLISH_PER_FLUSH:]
         ckpt = self._ckpt_pending[: self.CKPT_PER_FLUSH]
         self._ckpt_pending = self._ckpt_pending[self.CKPT_PER_FLUSH:]
+        if self._mled.enabled:
+            for _, h in publish:
+                self._mled.unpin("prefix_publish", str(h))
+            for _, h in ckpt:
+                self._mled.unpin("stream_ckpt", str(h))
         if not self._pending and not publish and not ckpt:
             self._flush_ckpt_records(frozenset())
             return 0
@@ -270,8 +290,11 @@ class OffloadManager:
         if self.ckpt_tier is None:
             return
         queued = {h for _, h in self._ckpt_pending}
-        self._ckpt_pending.extend(
-            (b, h) for b, h in pairs if h not in queued)
+        fresh = [(b, h) for b, h in pairs if h not in queued]
+        self._ckpt_pending.extend(fresh)
+        if self._mled.enabled:
+            for _, h in fresh:
+                self._mled.pin("stream_ckpt", str(h), 1)
         self._ckpt_records.append(
             (request_id, record, {h for _, h in pairs}))
 
@@ -377,6 +400,15 @@ class OffloadManager:
                 block_size=self.pool.block_size,
                 seconds=time.perf_counter() - t0)
         return n
+
+    def queue_live_ids(self) -> dict[str, set[str]]:
+        """Mem-ledger audit live sets: owner ids currently held by the
+        publish / stream-checkpoint queues (string-keyed sequence hashes,
+        matching the pin tags above)."""
+        return {
+            "prefix_publish": {str(h) for _, h in self._publish_pending},
+            "stream_ckpt": {str(h) for _, h in self._ckpt_pending},
+        }
 
     def snapshot(self) -> dict:
         out = self.stats.to_dict()

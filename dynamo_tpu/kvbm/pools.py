@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.engine.cache import KVCacheSpec
+from dynamo_tpu.obs.mem_ledger import get_mem_ledger
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("kvbm")
@@ -83,12 +84,18 @@ class HostBlockPool:
         self._free: list[int] = list(range(capacity_blocks - 1, -1, -1))
         self._lru: OrderedDict[int, int] = OrderedDict()  # seq_hash -> slot, LRU order
         self.stats = TierStats()
+        self._mled = get_mem_ledger()
 
     def __contains__(self, seq_hash: int) -> bool:
         return seq_hash in self._lru
 
     def __len__(self) -> int:
         return len(self._lru)
+
+    def occupancy(self) -> tuple[int, int]:
+        """(resident blocks, resident bytes) — the mem-ledger tier row."""
+        n = len(self._lru)
+        return n, n * self.spec.bytes_per_block()
 
     def put(self, seq_hash: int, block: np.ndarray) -> None:
         if seq_hash in self._lru:
@@ -100,6 +107,8 @@ class HostBlockPool:
         if not self._free:
             victim_hash, victim_slot = self._lru.popitem(last=False)
             self.stats.evictions += 1
+            if self._mled.enabled:
+                self._mled.record_churn("host", "lru", 1)
             if self.overflow is not None:
                 self.overflow.put(victim_hash, self._arena[victim_slot])
             self._free.append(victim_slot)
@@ -148,6 +157,7 @@ class DiskBlockPool:
         self._block_bytes = int(np.prod(block_shape(spec))) * block_dtype(spec).itemsize
         self._lru: OrderedDict[int, None] = OrderedDict()
         self.stats = TierStats()
+        self._mled = get_mem_ledger()
         # Sequence hashes cover token content only — a directory written by a
         # different model (even one with identical KV geometry) must not be
         # served. The MANIFEST records model identity + layout; any mismatch
@@ -173,6 +183,11 @@ class DiskBlockPool:
     def __len__(self) -> int:
         return len(self._lru)
 
+    def occupancy(self) -> tuple[int, int]:
+        """(resident blocks, resident bytes) — the mem-ledger tier row."""
+        n = len(self._lru)
+        return n, n * self._block_bytes
+
     def _file(self, seq_hash: int) -> Path:
         return self.path / f"{seq_hash:016x}.kvb"
 
@@ -185,6 +200,8 @@ class DiskBlockPool:
         block = ensure_block_format(block, self.spec)
         while (len(self._lru) + 1) * self._block_bytes > self.capacity_bytes and self._lru:
             victim, _ = self._lru.popitem(last=False)
+            if self._mled.enabled:
+                self._mled.record_churn("disk", "byte_budget", 1)
             if self.overflow is not None:
                 # read directly (not self.get — that would touch the LRU)
                 try:

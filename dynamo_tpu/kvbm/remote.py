@@ -412,6 +412,17 @@ class RemoteBlockPool:
             self._last_len = int(resp.get("blocks", 0))
         return self._last_len
 
+    def occupancy(self) -> tuple[int, int]:
+        """(resident blocks, resident bytes) server-wide — the mem-ledger
+        tier row. Last-known/zero on failure, never a stall (the ledger
+        only pulls this at snapshot/debug time, and the circuit breaker
+        bounds the cost of a dead store)."""
+        resp = self._call({"op": "stats"})
+        if resp:
+            self._last_len = int(resp.get("blocks", 0))
+            return self._last_len, int(resp.get("bytes", 0))
+        return self._last_len, 0
+
     def close(self) -> None:
         with self._lock:
             if self._sock is not None:

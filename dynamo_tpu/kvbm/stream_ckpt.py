@@ -52,6 +52,11 @@ CKPT_KEY_DRAWS_KEY = "stream_ckpt.key_draws"
 # lazy TTL, enforced client-side on get (kvbm/remote.py get_stream_ckpt).
 DEFAULT_CKPT_TTL_S = 600.0
 
+# Device blocks sitting in the checkpoint flush queue are pinned under the
+# mem-ledger owner class "stream_ckpt" (obs/mem_ledger.py) — pin at
+# OffloadManager.enqueue_stream_ckpt, unpin at flush or staleness drop.
+MEM_OWNER_CLASS = "stream_ckpt"
+
 
 def build_ckpt_record(request_id: str, generated: list[int],
                       seq_hashes: list[int], *,

@@ -193,6 +193,10 @@ async def run_http(ns: argparse.Namespace) -> None:
     # single-process /metrics endpoint.
     from dynamo_tpu.obs.sched_ledger import install_sched_metrics
     install_sched_metrics(svc.metrics)
+    # The memory ledger (dynamo_mem_*) too — occupancy waterfall, leak
+    # audit, TTX forecast (obs/mem_ledger.py).
+    from dynamo_tpu.obs.mem_ledger import install_mem_metrics
+    install_mem_metrics(svc.metrics)
     if ns.session_ttl > 0:
         from dynamo_tpu.engine.session import install_session_metrics
 

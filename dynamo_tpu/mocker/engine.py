@@ -32,6 +32,7 @@ from dynamo_tpu.obs.compile_ledger import (
     get_compile_ledger,
     sig_for_rows,
 )
+from dynamo_tpu.obs.mem_ledger import get_mem_ledger, live_ids_of
 from dynamo_tpu.obs.sched_ledger import HolStall, get_sched_ledger
 from dynamo_tpu.obs.tracer import get_tracer, trace_context_of
 from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
@@ -220,6 +221,27 @@ class MockEngine:
         # family and the decode_stall SLI without a TPU.
         self._sled = get_sched_ledger()
         self._sled.configure()
+        # Memory-ledger mirror (obs/mem_ledger.py): the same pin taxonomy,
+        # TTX forecast, and leak audit as the JAX engine, device-free —
+        # the pool accounting is real, so occupancy/orphan semantics are
+        # identical. Bytes are 0 (stand-in payloads carry no KV).
+        self._mled = get_mem_ledger()
+        self._mled.configure()
+        self._mled.register_tier("device", lambda: (
+            self.pool.num_blocks - 1 - self.pool.num_free_raw, 0))
+        self._mem_source_key = f"mocker:{id(self):x}"
+        self._mled.register_live_source(self._mem_source_key,
+                                        self._mem_live_ids)
+
+    def _mem_live_ids(self) -> dict:
+        """Live owner ids for the mem-ledger leak audit. The mocker pins
+        only stream (admitted requests) and session classes; the rest are
+        reported empty — nothing in this process should hold them."""
+        return live_ids_of(
+            streams=(s.req.request_id for s in self.running),
+            sessions=(self.sessions.session_ids()
+                      if self.sessions is not None else ()),
+        )
 
     def start(self) -> None:
         if self._task is None:
@@ -228,6 +250,7 @@ class MockEngine:
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()
+        self._mled.unregister_live_source(self._mem_source_key)
 
     def warmup(self) -> dict:
         """Full-mode mirror of EngineCore.warmup: file a warmup-source
@@ -469,6 +492,10 @@ class MockEngine:
                 seq.block_ids = matched + fresh
                 seq.cached_blocks = len(matched)
                 seq.committed = len(matched)
+                if self._mled.enabled:
+                    self._mled.pin("stream", seq.req.request_id,
+                                   len(seq.block_ids))
+                    self._mled.record_alloc(seq.priority, len(fresh))
                 self.prefix_lookups += max(len(hashes), 1)
                 self.prefix_hits += len(matched)
                 if seq.ckpt_offset > 0:
@@ -496,6 +523,15 @@ class MockEngine:
                     and len(self.running) >= a.max_batch_size):
                 self._sled.record_block("batch_full")
             self.steps += 1
+            if self._mled.enabled:
+                # Same per-step record point as the JAX engine: waterfall
+                # rows, TTX forecast fold, and the periodic leak audit.
+                self._mled.observe_device(
+                    free=self.pool.num_free_raw,
+                    cached=self.pool.num_inactive,
+                    total=self.pool.num_blocks - 1)
+                self._mled.observe_free(self.pool.num_free, now=time.time())
+                self._mled.maybe_audit(time.time())
             prefills = [s for s in self.running if not s.prefilled and not s.done]
             decodes = [s for s in self.running if s.prefilled and not s.done]
             if prefills and a.unified_step:
@@ -550,12 +586,16 @@ class MockEngine:
                         continue
                     total = len(dseq.req.token_ids) + dseq.generated + 1
                     need = -(-total // a.block_size)
-                    if need > len(dseq.block_ids):
+                    grow = need - len(dseq.block_ids)
+                    if grow > 0:
                         try:
-                            dseq.block_ids.extend(
-                                self.pool.allocate(need - len(dseq.block_ids)))
+                            dseq.block_ids.extend(self.pool.allocate(grow))
                         except NoFreeBlocks:
                             continue  # starved this step; retried next step
+                        if self._mled.enabled:
+                            self._mled.pin("stream", dseq.req.request_id,
+                                           grow)
+                            self._mled.record_alloc(dseq.priority, grow)
                     self._emit_token(dseq)
                     self._commit(dseq, total - 1)
                 continue
@@ -615,11 +655,16 @@ class MockEngine:
                     # grow blocks as generated tokens fill them
                     total = len(seq.req.token_ids) + seq.generated + 1
                     need = -(-total // a.block_size)
-                    if need > len(seq.block_ids):
+                    grow = need - len(seq.block_ids)
+                    if grow > 0:
                         try:
-                            seq.block_ids.extend(self.pool.allocate(need - len(seq.block_ids)))
+                            seq.block_ids.extend(self.pool.allocate(grow))
                         except NoFreeBlocks:
                             continue  # starved this step; retried next step
+                        if self._mled.enabled:
+                            self._mled.pin("stream", seq.req.request_id,
+                                           grow)
+                            self._mled.record_alloc(seq.priority, grow)
                     self._emit_token(seq)
                     self._commit(seq, total - 1)
                 continue
@@ -728,6 +773,9 @@ class MockEngine:
             hashes = seq.block_seq.sequence_hashes()[: seq.committed]
             self.sessions.retain(seq.session_id, hashes, time.monotonic())
         if seq.block_ids:
+            if self._mled.enabled:
+                self._mled.unpin("stream", seq.req.request_id)
+                self._mled.record_release(seq.priority, len(seq.block_ids))
             self.pool.release(seq.block_ids)
             seq.block_ids = []
 
@@ -810,6 +858,8 @@ class MockEngine:
                if self._ledger.enabled else {}),
             **({"sched": self._sled.snapshot()}
                if self._sled.enabled else {}),
+            **({"mem": self._mled.snapshot()}
+               if self._mled.enabled else {}),
         }
 
     async def clear_kv(self) -> None:

@@ -79,13 +79,21 @@ class SloSpec:
     ``histogram`` at or under ``threshold_s`` (so target=0.95 with
     threshold X reads "p95 ≤ X"). ``kind="availability"``: good/total
     come from terminal-status counters (200 vs 499/500) on the generate
-    routes, cross-checked against ``dynamo_qos_admitted_total``."""
+    routes, cross-checked against ``dynamo_qos_admitted_total``.
+    ``kind="counter_ratio"``: good/total come from one labelled counter
+    family — good is the series where ``good_label == good_value``, total
+    is every series of ``counter`` (the shape behind kv_headroom: each
+    engine-step free-pool observation lands in
+    dynamo_mem_headroom_observations_total{state="ok"|"short"})."""
 
     name: str
-    kind: str                  # "latency" | "availability"
+    kind: str                  # "latency" | "availability" | "counter_ratio"
     target: float              # e.g. 0.95 → error budget 0.05
     histogram: str = ""        # latency only: histogram family name
     threshold_s: float = 0.0   # latency only: SLO bound in seconds
+    counter: str = ""          # counter_ratio only: counter family name
+    good_label: str = ""       # counter_ratio only: label that marks good
+    good_value: str = ""       # counter_ratio only: value of the good label
 
     @property
     def budget(self) -> float:
@@ -108,6 +116,14 @@ DEFAULT_SLO_SPECS = (
     SloSpec(name="decode_stall", kind="latency", target=0.99,
             histogram="dynamo_sched_hol_stall_seconds",
             threshold_s=0.5),
+    # KV capacity headroom (obs/mem_ledger.py): each engine step scores
+    # its free-pool forecast ok/short (short = TTX posture tight or
+    # critical). Sustained short TTX burns this budget and pages through
+    # the same multi-window machinery as the latency SLOs — the "we will
+    # hit no_free_blocks in under two minutes" signal, fleet-wide.
+    SloSpec(name="kv_headroom", kind="counter_ratio", target=0.95,
+            counter="dynamo_mem_headroom_observations_total",
+            good_label="state", good_value="ok"),
 )
 
 
@@ -122,11 +138,19 @@ def parse_slo_specs(text: str) -> tuple[SloSpec, ...]:
             name=raw["name"], kind=raw["kind"],
             target=float(raw["target"]),
             histogram=raw.get("histogram", ""),
-            threshold_s=float(raw.get("threshold_s", 0.0)))
-        if spec.kind not in ("latency", "availability"):
+            threshold_s=float(raw.get("threshold_s", 0.0)),
+            counter=raw.get("counter", ""),
+            good_label=raw.get("good_label", ""),
+            good_value=str(raw.get("good_value", "")))
+        if spec.kind not in ("latency", "availability", "counter_ratio"):
             raise ValueError(f"slo {spec.name!r}: unknown kind {spec.kind!r}")
         if spec.kind == "latency" and not spec.histogram:
             raise ValueError(f"slo {spec.name!r}: latency needs a histogram")
+        if spec.kind == "counter_ratio" and not (
+                spec.counter and spec.good_label and spec.good_value):
+            raise ValueError(
+                f"slo {spec.name!r}: counter_ratio needs counter, "
+                f"good_label, and good_value")
         if not 0.0 < spec.target < 1.0:
             raise ValueError(f"slo {spec.name!r}: target must be in (0, 1)")
         specs.append(spec)
@@ -479,6 +503,15 @@ class FleetAggregator:
                     continue
                 total += v
                 if d.get("status") == "200":
+                    good += v
+            return good, total
+        if spec.kind == "counter_ratio":
+            good = total = 0.0
+            for (name, labels), v in rollup.items():
+                if name != spec.counter:
+                    continue
+                total += v
+                if dict(labels).get(spec.good_label) == spec.good_value:
                     good += v
             return good, total
         # latency: cumulative bucket counts. good = observations at or

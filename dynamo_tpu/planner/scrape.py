@@ -146,3 +146,29 @@ class AggregatorScraper(FrontendScraper):
                     frag += f" burn{w}={d[f'burn_rate_{w}']:.2f}"
             parts.append(frag)
         return f"slo[{'; '.join(parts)}]" if parts else ""
+
+    def mem_reason(self) -> str:
+        """Compact capacity-forecast stamp for Decision.reason:
+        ``mem[ttx=42s posture=tight]``. Reads the mem-ledger gauges
+        (obs/mem_ledger.py) from the last scrape — the worst (minimum)
+        TTX and worst (maximum) posture across per-instance series. The
+        ``_fleet`` rollup rows are skipped: summing gauges across workers
+        would fabricate a TTX no worker reports. Empty when no worker
+        exposes the family (ledger disabled fleet-wide)."""
+        from dynamo_tpu.obs.mem_ledger import POSTURES, TTX_CAP_S
+
+        min_ttx: float | None = None
+        max_posture = 0
+        for (name, labels), v in (self.last_sample or {}).items():
+            if dict(labels).get("instance") == FLEET_INSTANCE:
+                continue
+            if name == "dynamo_mem_ttx_seconds":
+                min_ttx = v if min_ttx is None else min(min_ttx, v)
+            elif name == "dynamo_mem_capacity_posture":
+                max_posture = max(max_posture, int(v))
+        if min_ttx is None:
+            return ""
+        posture = POSTURES[min(max_posture, len(POSTURES) - 1)]
+        ttx = ("inf" if min_ttx >= TTX_CAP_S
+               else f"{min_ttx:.0f}s")
+        return f"mem[ttx={ttx} posture={posture}]"

@@ -56,6 +56,7 @@ class SystemStatusServer:
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/debug/sched", self._debug_sched)
+        app.router.add_get("/debug/mem", self._debug_mem)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, self.port)
@@ -94,6 +95,14 @@ class SystemStatusServer:
 
         return web.json_response(get_sched_ledger().debug_info(
             recorder=get_tracer().recorder))
+
+    async def _debug_mem(self, request: web.Request) -> web.Response:
+        """Worker-local memory ledger (obs/mem_ledger.py): the tier
+        occupancy waterfall, top pin owners, churn trend, consumption
+        rates, TTX forecast, and the last pin-leak audit report."""
+        from dynamo_tpu.obs.mem_ledger import get_mem_ledger
+
+        return web.json_response(get_mem_ledger().debug_info())
 
     async def _metrics(self, request: web.Request) -> web.Response:
         text = self.metrics.expose()

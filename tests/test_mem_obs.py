@@ -1,0 +1,480 @@
+"""KV memory & capacity ledger: taxonomy, leak audit, TTX forecast, wiring.
+
+The load-bearing invariants: (1) the occupancy waterfall is a pure sum of
+tagged pins — every test pins/unpins by hand and checks the gauges against
+arithmetic; (2) the TTX forecast is the documented EWMA fold — the
+scripted-schedule test recomputes every rate by hand (first fold of a QoS
+sets the rate to the instantaneous value exactly, because ``prev`` defaults
+to ``inst``); (3) an orphan is a pin whose owner id no LIVE source knows,
+and a class no source covers is unauditable, not orphaned. The mocker
+mirror runs the whole plane device-free, and the fleet/planner tests pin
+the kv_headroom SLI and the ``mem[...]`` Decision stamp.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.obs.mem_ledger import (
+    MEM_ENV,
+    OWNER_CLASSES,
+    POSTURES,
+    TTX_CAP_S,
+    get_mem_ledger,
+    get_mem_metrics,
+    install_mem_metrics,
+    live_ids_of,
+    mem_enabled,
+)
+from dynamo_tpu.utils.metrics import (
+    MetricsRegistry,
+    metric_sum,
+    parse_prometheus,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_ledger():
+    """Isolate the process-global singleton: fresh pins/rates/sources and
+    a fresh metrics registry per test. Teardown forces enabled=True (not
+    an env re-read: a monkeypatched DYN_MEM_LEDGER may still be set when
+    this finalizer runs)."""
+    led = get_mem_ledger()
+    led.reset()
+    led.configure(True)
+    install_mem_metrics(MetricsRegistry())
+    yield led
+    led.reset()
+    led.configure(True)
+
+
+def _req(tokens, max_tokens=4, rid=None, **annotations):
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    kw = {"request_id": rid} if rid is not None else {}
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens,
+                                       ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0),
+        annotations=annotations or None, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Env gate
+# ---------------------------------------------------------------------------
+
+def test_env_gate(monkeypatch):
+    monkeypatch.delenv(MEM_ENV, raising=False)
+    assert mem_enabled() is True
+    assert mem_enabled(default=False) is False
+    for off in ("0", "false", "no", "off"):
+        monkeypatch.setenv(MEM_ENV, off)
+        assert mem_enabled() is False
+    monkeypatch.setenv(MEM_ENV, "1")
+    assert mem_enabled() is True
+
+
+# ---------------------------------------------------------------------------
+# Pin taxonomy & occupancy waterfall
+# ---------------------------------------------------------------------------
+
+def test_pin_taxonomy_across_all_owner_classes(clean_ledger):
+    led = clean_ledger
+    reg = MetricsRegistry()
+    install_mem_metrics(reg)
+    # one pin per owner class, distinct sizes so sums are unambiguous
+    led.pin("stream", "req-1", 4)
+    led.pin("stream", "req-2", 8)
+    led.pin("session", "sess-a", 16)
+    led.pin("prefix_publish", 12345, 2)   # int id coerced to str
+    led.pin("stream_ckpt", "67890", 3)
+    led.pin("staging", "xfer-9", 5)
+    assert led.owner_blocks() == {
+        "stream": 12, "session": 16, "prefix_publish": 2,
+        "stream_ckpt": 3, "staging": 5}
+    # the waterfall gauge mirrors the per-class sums
+    rollup = parse_prometheus(reg.expose())
+    for cls, want in (("stream", 12.0), ("session", 16.0),
+                      ("prefix_publish", 2.0), ("stream_ckpt", 3.0),
+                      ("staging", 5.0)):
+        assert rollup[("dynamo_mem_device_blocks",
+                       frozenset({("owner", cls)}))] == want
+    # top_owners ranks individual holders, largest first
+    top = led.top_owners(top=2)
+    assert top[0] == {"owner": "session", "id": "sess-a", "blocks": 16}
+    assert top[1] == {"owner": "stream", "id": "req-2", "blocks": 8}
+    # partial unpin, then full unpin; over-release clamps at zero
+    led.unpin("stream", "req-2", 3)
+    assert led.owner_blocks()["stream"] == 9
+    led.unpin("stream", "req-2")          # None = all remaining
+    assert led.owner_blocks()["stream"] == 4
+    led.unpin("stream", "req-1", 100)     # clamp, not negative
+    assert led.owner_blocks()["stream"] == 0
+    led.unpin("stream", "never-pinned")   # no-op
+    rollup = parse_prometheus(reg.expose())
+    assert rollup[("dynamo_mem_device_blocks",
+                   frozenset({("owner", "stream")}))] == 0.0
+
+
+def test_device_rows_tiers_and_churn(clean_ledger):
+    led = clean_ledger
+    reg = MetricsRegistry()
+    install_mem_metrics(reg)
+    led.observe_device(free=40, cached=12, total=64)
+    led.register_tier("host", lambda: (7, 7 * 4096))
+    led.register_tier("remote", lambda: (_ for _ in ()).throw(OSError("down")))
+    led.record_churn("device", "allocation_pressure", 3, ts=1.0)
+    led.record_churn("host", "lru", 2, ts=2.0)
+    led.record_churn("host", "lru", 1, ts=3.0)
+    snap = led.snapshot()
+    assert snap["device_blocks"]["free"] == 40
+    assert snap["device_blocks"]["cached"] == 12
+    assert snap["device_total_blocks"] == 64
+    assert snap["churn"] == {"device/allocation_pressure": 3, "host/lru": 3}
+    # a failing tier callback degrades to an error row, never raises
+    assert snap["tiers"]["host"] == {"blocks": 7, "bytes": 7 * 4096}
+    assert "OSError" in snap["tiers"]["remote"]["error"]
+    trend = led.churn_trend()
+    assert [e["tier"] for e in trend] == ["device", "host", "host"]
+    assert trend[0]["cause"] == "allocation_pressure"
+    rollup = parse_prometheus(reg.expose())
+    assert rollup[("dynamo_mem_device_blocks",
+                   frozenset({("owner", "free")}))] == 40.0
+    assert rollup[("dynamo_mem_tier_blocks",
+                   frozenset({("tier", "host")}))] == 7.0
+    assert rollup[("dynamo_mem_churn_blocks_total",
+                   frozenset({("tier", "host"), ("cause", "lru")}))] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# TTX forecast — pinned against hand-computed EWMA math
+# ---------------------------------------------------------------------------
+
+def test_ttx_forecast_scripted_schedule(clean_ledger):
+    led = clean_ledger
+    reg = MetricsRegistry()
+    install_mem_metrics(reg)
+    # t=0: first observation is baseline-only — no rates, cap, ok
+    assert led.observe_free(1000, now=0.0) == (TTX_CAP_S, "ok")
+    # t=10: 100 blocks allocated over 10s. First fold of a QoS sets the
+    # rate to the instantaneous value exactly (prev defaults to inst):
+    # rate = 10 b/s, ttx = 900/10 = 90s -> tight (30 <= 90 < 120).
+    led.record_alloc("interactive", 100)
+    ttx, posture = led.observe_free(900, now=10.0)
+    assert ttx == pytest.approx(90.0)
+    assert posture == "tight"
+    # t=20: alloc 200 (inst 20), release 40 (inst 4, first fold).
+    # alloc rate = 0.3*20 + 0.7*10 = 13; release rate = 4; net = 9.
+    # ttx = 760/9 = 84.44s -> still tight.
+    led.record_alloc("interactive", 200)
+    led.record_release("interactive", 40)
+    ttx, posture = led.observe_free(760, now=20.0)
+    assert ttx == pytest.approx(760.0 / 9.0)
+    assert posture == "tight"
+    assert led.consumption_rates() == {
+        "interactive": {"alloc_bps": 13.0, "release_bps": 4.0,
+                        "net_bps": 9.0}}
+    # t=21: a 2000-block batch burst in 1s. batch rate = 2000 (first
+    # fold); interactive decays: alloc 0.7*13 = 9.1, release 0.7*4 = 2.8.
+    # net = 2000 + 9.1 - 2.8 = 2006.3; ttx = 100/2006.3 ~ 0.05s -> critical.
+    led.record_alloc("batch", 2000)
+    ttx, posture = led.observe_free(100, now=21.0)
+    assert ttx == pytest.approx(100.0 / 2006.3)
+    assert posture == "critical"
+    rollup = parse_prometheus(reg.expose())
+    assert metric_sum(rollup, "dynamo_mem_ttx_seconds") == pytest.approx(
+        100.0 / 2006.3)
+    assert metric_sum(rollup, "dynamo_mem_capacity_posture") == float(
+        POSTURES.index("critical"))
+    # t=22: a 3000-block drain flips net negative -> cap, ok
+    led.record_release("batch", 3000)
+    ttx, posture = led.observe_free(500, now=22.0)
+    assert (ttx, posture) == (TTX_CAP_S, "ok")
+    # kv_headroom counter pair: ok at t=0 and t=22, short in between
+    rollup = parse_prometheus(reg.expose())
+    assert rollup[("dynamo_mem_headroom_observations_total",
+                   frozenset({("state", "ok")}))] == 2.0
+    assert rollup[("dynamo_mem_headroom_observations_total",
+                   frozenset({("state", "short")}))] == 3.0
+    # non-advancing clock re-baselines instead of dividing by zero
+    assert led.observe_free(500, now=22.0) == (TTX_CAP_S, "ok")
+    # cumulative totals survive the folds
+    assert led.alloc_totals == {"interactive": 300, "batch": 2000}
+    assert led.release_totals == {"interactive": 40, "batch": 3000}
+
+
+# ---------------------------------------------------------------------------
+# Leak audit
+# ---------------------------------------------------------------------------
+
+def test_audit_detects_injected_orphan(clean_ledger):
+    led = clean_ledger
+    reg = MetricsRegistry()
+    install_mem_metrics(reg)
+    led.pin("stream", "r-live", 4)
+    led.pin("stream", "r-leaked", 3)
+    led.pin("session", "s-uncovered", 16)
+    # the source covers stream ONLY: the session pin is unauditable, not
+    # an orphan; r-leaked has no live id anywhere -> orphan
+    led.register_live_source("eng-1", lambda: {"stream": ["r-live"]})
+    report = led.audit(now=100.0)
+    assert report["orphan_pins"] == 1
+    assert report["orphans"] == {"stream": [{"id": "r-leaked", "blocks": 3}]}
+    assert report["by_owner"]["stream"] == 1
+    assert report["by_owner"]["session"] == 0
+    assert report["pins_checked"] == 3
+    assert report["classes_covered"] == ["stream"]
+    rollup = parse_prometheus(reg.expose())
+    assert rollup[("dynamo_mem_orphan_pins",
+                   frozenset({("owner", "stream")}))] == 1.0
+    assert rollup[("dynamo_mem_audits_total",
+                   frozenset({("result", "orphans")}))] == 1.0
+    # releasing the leak makes the next audit clean and zeroes the gauge
+    led.unpin("stream", "r-leaked")
+    report = led.audit(now=101.0)
+    assert report["orphan_pins"] == 0
+    rollup = parse_prometheus(reg.expose())
+    assert rollup[("dynamo_mem_orphan_pins",
+                   frozenset({("owner", "stream")}))] == 0.0
+    assert rollup[("dynamo_mem_audits_total",
+                   frozenset({("result", "clean")}))] == 1.0
+
+
+def test_audit_unions_sources_and_survives_dead_ones(clean_ledger):
+    led = clean_ledger
+    led.pin("stream", "r1", 2)
+    led.pin("stream", "r2", 2)
+    led.pin("staging", "x1", 1)
+    # two engines each know half the streams; union covers both. The
+    # live_ids_of payload reports every class (empty = nothing live).
+    led.register_live_source("eng-a", lambda: live_ids_of(streams=["r1"]))
+    led.register_live_source("eng-b", lambda: live_ids_of(
+        streams=["r2"], staging=[]))
+    report = led.audit(now=1.0)
+    # staging IS covered (reported empty) -> x1 is a real orphan
+    assert report["classes_covered"] == sorted(OWNER_CLASSES)
+    assert report["by_owner"]["stream"] == 0
+    assert report["by_owner"]["staging"] == 1
+    # a raising source audits empty instead of failing the sweep
+    led.register_live_source(
+        "eng-dead", lambda: (_ for _ in ()).throw(RuntimeError("gone")))
+    assert led.audit(now=2.0)["by_owner"]["stream"] == 0
+    # unregister drops coverage: with no sources left, nothing is audited
+    for key in ("eng-a", "eng-b", "eng-dead"):
+        led.unregister_live_source(key)
+    report = led.audit(now=3.0)
+    assert report["classes_covered"] == []
+    assert report["orphan_pins"] == 0
+
+
+def test_maybe_audit_interval(clean_ledger):
+    led = clean_ledger
+    led.configure(True, audit_interval_s=30.0)
+    led.register_live_source("e", lambda: live_ids_of())
+    assert led.maybe_audit(now=100.0) is not None   # first is always due
+    assert led.maybe_audit(now=110.0) is None       # inside the interval
+    assert led.maybe_audit(now=129.9) is None
+    report = led.maybe_audit(now=130.0)
+    assert report is not None and report["ts"] == 130.0
+
+
+# ---------------------------------------------------------------------------
+# Disabled mode: zero work, no stats block
+# ---------------------------------------------------------------------------
+
+def test_disabled_mode_records_nothing(clean_ledger, monkeypatch):
+    led = clean_ledger
+    monkeypatch.setenv(MEM_ENV, "0")
+    led.configure()   # re-reads the env gate
+    assert led.enabled is False
+    led.pin("stream", "r1", 4)
+    led.record_churn("host", "lru", 2)
+    led.record_alloc("interactive", 8)
+    led.record_release("interactive", 8)
+    assert led.observe_free(100, now=1.0) == (TTX_CAP_S, "ok")
+    assert led.maybe_audit(now=100.0) is None
+    snap = led.snapshot()
+    assert snap["enabled"] is False
+    assert snap["device_blocks"]["stream"] == 0
+    assert snap["alloc_blocks"] == {} and snap["churn"] == {}
+    assert snap["ttx_seconds"] == TTX_CAP_S and snap["posture"] == "ok"
+
+
+def test_mocker_disabled_omits_stats_block(clean_ledger, monkeypatch):
+    from dynamo_tpu.mocker.engine import MockEngine
+
+    monkeypatch.setenv(MEM_ENV, "0")
+    eng = MockEngine(_mock_args())
+    asyncio.run(_gen_mock(eng, _req(range(5, 29), max_tokens=2)))
+    assert "mem" not in eng.stats()
+    assert clean_ledger.owner_blocks()["stream"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Mocker mirror: device-free parity for the whole plane
+# ---------------------------------------------------------------------------
+
+def _mock_args(**kw):
+    from dynamo_tpu.mocker.engine import MockEngineArgs
+
+    defaults = dict(block_size=4, speedup_ratio=1000.0, max_model_len=256,
+                    num_blocks=128, compile_s=0.0)
+    defaults.update(kw)
+    return MockEngineArgs(**defaults)
+
+
+async def _gen_mock(engine, req):
+    toks = []
+    async for out in engine.generate(req):
+        toks.extend(out.token_ids)
+    return toks
+
+
+def test_mocker_mem_parity(clean_ledger):
+    from dynamo_tpu.mocker.engine import MockEngine
+
+    led = clean_ledger
+    eng = MockEngine(_mock_args())
+    asyncio.run(_gen_mock(eng, _req(range(5, 29), max_tokens=4)))
+    mem = eng.stats()["mem"]
+    assert mem["enabled"] is True
+    # blocks were consumed and the finished stream released its pins
+    assert sum(mem["alloc_blocks"].values()) > 0
+    assert 0 < sum(mem["release_blocks"].values()) <= \
+        sum(mem["alloc_blocks"].values())
+    assert mem["device_blocks"]["stream"] == 0
+    assert set(mem["device_blocks"]) == set(OWNER_CLASSES) | {"free",
+                                                              "cached"}
+    # the mocker registers a device tier callback against its real pool
+    assert mem["tiers"]["device"]["blocks"] >= 0
+    # zero orphans at steady state: every pin maps to a live owner id
+    report = led.audit()
+    assert report["orphan_pins"] == 0
+    assert "stream" in report["classes_covered"]
+
+
+# ---------------------------------------------------------------------------
+# /debug/mem document & metric republication
+# ---------------------------------------------------------------------------
+
+def test_debug_info_schema(clean_ledger):
+    led = clean_ledger
+    led.pin("stream", "r1", 4)
+    led.record_churn("host", "lru", 1, ts=1.0)
+    led.observe_free(100, now=0.0)   # baseline (clears accumulators)
+    led.record_alloc("interactive", 10)
+    led.observe_free(90, now=1.0)
+    led.register_live_source("e", lambda: live_ids_of(streams=["r1"]))
+    led.audit(now=2.0)
+    info = led.debug_info()
+    assert info["enabled"] is True
+    assert info["env"] == MEM_ENV
+    assert info["totals"]["device_blocks"]["stream"] == 4
+    assert info["top_owners"][0]["id"] == "r1"
+    assert info["churn_trend"][0]["tier"] == "host"
+    assert "interactive" in info["rates"]
+    assert set(info["ttx"]) == {"seconds", "posture", "tight_s",
+                                "critical_s"}
+    assert info["last_audit"]["orphan_pins"] == 0
+
+
+def test_install_republishes_gauges(clean_ledger):
+    led = clean_ledger
+    led.pin("session", "s1", 6)
+    led.observe_device(free=10, cached=2, total=32)
+    led.register_live_source("e", lambda: live_ids_of())
+    led.audit(now=1.0)   # s1 not live anywhere -> one session orphan
+    # a registry installed AFTER the activity still exposes current gauges
+    reg = MetricsRegistry()
+    install_mem_metrics(reg)
+    rollup = parse_prometheus(reg.expose())
+    assert rollup[("dynamo_mem_device_blocks",
+                   frozenset({("owner", "session")}))] == 6.0
+    assert rollup[("dynamo_mem_device_blocks",
+                   frozenset({("owner", "free")}))] == 10.0
+    assert rollup[("dynamo_mem_orphan_pins",
+                   frozenset({("owner", "session")}))] == 1.0
+    assert metric_sum(rollup, "dynamo_mem_ttx_seconds") == TTX_CAP_S
+    assert get_mem_metrics().registry is reg
+
+
+# ---------------------------------------------------------------------------
+# Fleet kv_headroom SLI & planner Decision stamp
+# ---------------------------------------------------------------------------
+
+def test_fleet_kv_headroom_sli():
+    from dynamo_tpu.obs.fleet import (
+        DEFAULT_SLO_SPECS,
+        FleetAggregator,
+        SloEngine,
+    )
+
+    spec = next(s for s in DEFAULT_SLO_SPECS if s.name == "kv_headroom")
+    assert spec.kind == "counter_ratio"
+    assert spec.counter == "dynamo_mem_headroom_observations_total"
+    assert (spec.good_label, spec.good_value) == ("state", "ok")
+    rollup = parse_prometheus("\n".join([
+        'dynamo_mem_headroom_observations_total{state="ok"} 95',
+        'dynamo_mem_headroom_observations_total{state="short"} 5',
+    ]) + "\n")
+    agg = FleetAggregator(None, registry=MetricsRegistry())
+    assert agg._slo_counts(spec, rollup) == (95.0, 100.0)
+    # sustained short TTX pages: 90% short against a 5% budget is burn 18,
+    # above the 14.4 page threshold on both fast windows
+    eng = SloEngine([spec], registry=MetricsRegistry())
+    eng.observe("kv_headroom", 0.0, 0.0, t=0.0)
+    eng.observe("kv_headroom", 10.0, 100.0, t=300.0)
+    out = eng.evaluate()
+    assert out["kv_headroom"]["kind"] == "counter_ratio"
+    assert out["kv_headroom"]["burn_rates"]["5m"] == pytest.approx(18.0)
+    assert out["kv_headroom"]["page"] is True
+    assert eng.burn_rate("kv_headroom", "5m") == pytest.approx(18.0)
+
+
+def test_parse_slo_specs_counter_ratio_validation():
+    from dynamo_tpu.obs.fleet import parse_slo_specs
+
+    specs = parse_slo_specs(
+        '{"slos": [{"name": "kv", "kind": "counter_ratio", "target": 0.9,'
+        ' "counter": "dynamo_mem_headroom_observations_total",'
+        ' "good_label": "state", "good_value": "ok"}]}')
+    assert specs[0].counter == "dynamo_mem_headroom_observations_total"
+    with pytest.raises(ValueError, match="counter_ratio"):
+        parse_slo_specs(
+            '{"slos": [{"name": "kv", "kind": "counter_ratio",'
+            ' "target": 0.9}]}')
+
+
+def test_planner_mem_reason():
+    from dynamo_tpu.planner.scrape import FLEET_INSTANCE, AggregatorScraper
+
+    scraper = AggregatorScraper("http://agg:9100")
+    assert scraper.mem_reason() == ""   # no scrape yet
+    # worst (min) TTX and worst (max) posture across per-instance series;
+    # the _fleet rollup rows must be skipped (summed gauges are fiction)
+    scraper.last_sample = {
+        ("dynamo_mem_ttx_seconds",
+         frozenset({("instance", "a:1")})): 42.4,
+        ("dynamo_mem_ttx_seconds",
+         frozenset({("instance", "b:2")})): 400.0,
+        ("dynamo_mem_ttx_seconds",
+         frozenset({("instance", FLEET_INSTANCE)})): 1.0,
+        ("dynamo_mem_capacity_posture",
+         frozenset({("instance", "a:1")})): 1.0,
+        ("dynamo_mem_capacity_posture",
+         frozenset({("instance", "b:2")})): 0.0,
+    }
+    assert scraper.mem_reason() == "mem[ttx=42s posture=tight]"
+    # an idle fleet reports the cap, rendered as "inf"
+    scraper.last_sample = {
+        ("dynamo_mem_ttx_seconds",
+         frozenset({("instance", "a:1")})): TTX_CAP_S,
+    }
+    assert scraper.mem_reason() == "mem[ttx=inf posture=ok]"

@@ -132,6 +132,27 @@ def test_stream_ckpt_drift_detected(tmp_path: Path):
                for p in problems)
 
 
+def test_mem_drift_detected(tmp_path: Path):
+    """Bidirectional drift on the memory-ledger family: a registration the
+    MEM_METRICS declaration doesn't know about AND every
+    declared-but-unregistered name must each produce a violation."""
+    (tmp_path / "obs").mkdir()
+    (tmp_path / "obs" / "mem_ledger.py").write_text(textwrap.dedent("""
+        def bind(reg):
+            reg.gauge("mem_device_blocks", "occupancy waterfall")
+            reg.counter("mem_surprise", "undeclared registration")
+    """))
+    problems = lint_tree(tmp_path)
+    assert any("mem_surprise" in p and "MEM_METRICS" in p
+               for p in problems)
+    assert any("mem_ttx_seconds" in p and "does not register" in p
+               for p in problems)
+    # the kv_headroom SLI counter pair is part of the declared family:
+    # dropping its registration must trip the same drift check
+    assert any("mem_headroom_observations_total" in p
+               and "does not register" in p for p in problems)
+
+
 def test_prefix_cache_drift_detected(tmp_path: Path):
     """Bidirectional drift on the prefix-cache family: a registration the
     declaration doesn't know about AND every declared-but-unregistered name

@@ -204,6 +204,24 @@ STREAM_CKPT_METRICS = (
     "stream_ckpt_expired",
 )
 
+# The KV memory & capacity ledger family (obs/mem_ledger.py MemMetrics):
+# per-owner device occupancy, tier waterfall, churn/alloc/release counters,
+# the pin-leak audit gauges, and the TTX forecast pair. Same bidirectional
+# drift rule as KV_TRANSFER_METRICS.
+MEM_METRICS = (
+    "mem_device_blocks",
+    "mem_tier_blocks",
+    "mem_tier_bytes",
+    "mem_churn_blocks_total",
+    "mem_orphan_pins",
+    "mem_audits_total",
+    "mem_ttx_seconds",
+    "mem_capacity_posture",
+    "mem_alloc_blocks_total",
+    "mem_release_blocks_total",
+    "mem_headroom_observations_total",
+)
+
 # The failure-recovery family: health canaries (runtime/health.py),
 # migration re-dispatch (frontend/migration.py), and chaos injection
 # (chaos/metrics.py). Same bidirectional drift rule as KV_TRANSFER_METRICS:
@@ -562,6 +580,23 @@ def _lint_stream_ckpt_metrics(root: Path, problems: list[str]) -> None:
             "does not register it")
 
 
+def _lint_mem_metrics(root: Path, problems: list[str]) -> None:
+    """The memory-ledger family must match what obs/mem_ledger.py actually
+    registers — same no-silent-drift rule as KV_TRANSFER_METRICS."""
+    actual = _registered_names(root / "obs" / "mem_ledger.py")
+    if actual is None:
+        return
+    declared = set(MEM_METRICS)
+    for key in sorted(actual - declared):
+        problems.append(
+            f"obs/mem_ledger.py registers {key!r} but it is missing from "
+            "tools/lint_metrics.py MEM_METRICS")
+    for key in sorted(declared - actual):
+        problems.append(
+            f"MEM_METRICS declares {key!r} but obs/mem_ledger.py "
+            "does not register it")
+
+
 def _lint_family_overlap(problems: list[str]) -> None:
     """No metric name may appear in two declared families: a duplicate
     means two modules would register (or two dashboards would grep) the
@@ -577,6 +612,7 @@ def _lint_family_overlap(problems: list[str]) -> None:
         "COMPILE_METRICS": COMPILE_METRICS,
         "SCHED_METRICS": SCHED_METRICS,
         "STREAM_CKPT_METRICS": STREAM_CKPT_METRICS,
+        "MEM_METRICS": MEM_METRICS,
         "FLEET_METRICS": FLEET_METRICS,
         "SLO_METRICS": SLO_METRICS,
         **{f"RECOVERY_METRICS[{'/'.join(parts)}]": names
@@ -657,6 +693,7 @@ def lint_tree(root: Path | None = None) -> list[str]:
     _lint_compile_metrics(root, problems)
     _lint_sched_metrics(root, problems)
     _lint_stream_ckpt_metrics(root, problems)
+    _lint_mem_metrics(root, problems)
     _lint_fleet_metrics(root, problems)
     _lint_recovery_metrics(root, problems)
     _lint_family_overlap(problems)
